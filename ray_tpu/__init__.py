@@ -1,0 +1,49 @@
+"""ray_tpu: a TPU-native distributed runtime and ML library stack.
+
+Core primitives (tasks, actors, objects) mirror the reference's contract
+(reference: python/ray/__init__.py) while the compute path is JAX/XLA/Pallas
+and collectives ride ICI/DCN via jax.sharding meshes.
+"""
+
+__version__ = "0.1.0"
+
+from ray_tpu._private.worker import init, shutdown, is_initialized
+from ray_tpu.api import (
+    ActorClass,
+    ActorDiedError,
+    ActorHandle,
+    GetTimeoutError,
+    ObjectRef,
+    RayTpuError,
+    RemoteFunction,
+    TaskError,
+    WorkerCrashedError,
+    get,
+    get_actor,
+    kill,
+    put,
+    remote,
+    wait,
+)
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "get_actor",
+    "ObjectRef",
+    "ActorHandle",
+    "ActorClass",
+    "RemoteFunction",
+    "RayTpuError",
+    "TaskError",
+    "ActorDiedError",
+    "GetTimeoutError",
+    "WorkerCrashedError",
+    "__version__",
+]

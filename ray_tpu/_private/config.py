@@ -1,0 +1,110 @@
+"""Typed, env-overridable config registry.
+
+Mirrors the reference's RayConfig design (reference: src/ray/common/ray_config_def.h,
+ray_config.h:67-74): every entry has a typed default, can be overridden by an
+environment variable ``RAYTPU_<NAME>``, and can be overridden programmatically via a
+``_system_config`` dict passed to ``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAYTPU_"
+
+
+def _coerce(value: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, (list, dict)):
+        return json.loads(value)
+    return value
+
+
+class _Config:
+    _DEFAULTS: Dict[str, Any] = {
+        # --- object store ---
+        "object_store_memory_bytes": 2 * 1024**3,
+        "object_store_inline_max_bytes": 100 * 1024,  # small results returned inline
+        "object_store_native": True,  # use the C++ shm allocator when built
+        "object_spilling_enabled": True,
+        "object_spilling_dir": "",
+        "object_store_full_retry_s": 10.0,
+        # --- scheduling ---
+        "worker_lease_timeout_s": 30.0,
+        "worker_pool_prestart": 0,
+        "worker_idle_timeout_s": 60.0,
+        "max_workers_per_node": 64,
+        "scheduler_spread_threshold": 0.5,
+        "scheduler_top_k_fraction": 0.2,
+        # --- health / fault tolerance ---
+        "health_check_period_s": 1.0,
+        "health_check_failure_threshold": 5,
+        "task_max_retries_default": 3,
+        "actor_max_restarts_default": 0,
+        "gcs_rpc_timeout_s": 30.0,
+        # --- rpc ---
+        "rpc_connect_timeout_s": 10.0,
+        "rpc_max_frame_bytes": 512 * 1024**2,
+        # --- task events / observability ---
+        "task_events_enabled": True,
+        "task_events_buffer_size": 100_000,
+        "metrics_report_period_s": 5.0,
+        "log_dir": "",
+        # --- TPU topology ---
+        "tpu_slice_gang_scheduling": True,
+        "tpu_topology_env": "",  # override detected topology, e.g. "v5e-8"
+        # --- train ---
+        "train_heartbeat_period_s": 5.0,
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, Any] = {}
+        self._load_env()
+
+    def _load_env(self):
+        for name, default in self._DEFAULTS.items():
+            env = os.environ.get(_ENV_PREFIX + name.upper())
+            if env is not None:
+                self._values[name] = _coerce(env, default)
+
+    def initialize(self, system_config: Dict[str, Any] | None):
+        """Apply a _system_config dict (wins over env)."""
+        if not system_config:
+            return
+        with self._lock:
+            for k, v in system_config.items():
+                if k not in self._DEFAULTS:
+                    raise ValueError(f"Unknown config entry: {k}")
+                self._values[k] = v
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name in self._values:
+                return self._values[name]
+        try:
+            return self._DEFAULTS[name]
+        except KeyError:
+            raise ValueError(f"Unknown config entry: {name}") from None
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._DEFAULTS)
+            out.update(self._values)
+            return out
+
+
+GlobalConfig = _Config()

@@ -1,0 +1,826 @@
+"""Core worker: the in-process runtime linked into every driver and worker.
+
+Responsibilities (reference: src/ray/core_worker/core_worker.cc — SubmitTask
+:1893, Get :1322, Put :1110, ExecuteTask :2553; task_manager.h ownership and
+retries; transport/direct_task_transport.cc lease-based direct submission;
+transport/direct_actor_task_submitter.cc per-handle actor ordering):
+
+- owns objects created by its tasks/puts (inline results live in the
+  in-process memory store; large results in the node's shm plasma store)
+- submits normal tasks by leasing workers from the raylet and pushing the
+  task directly to the leased worker (two-level scheduling)
+- submits actor tasks directly to the actor's worker with per-handle
+  sequence numbers
+- executes tasks when running inside a worker process (the same class serves
+  both roles, like the reference's CoreWorker)
+- retries failed tasks (owner-side) and surfaces failures as exception
+  objects that re-raise at ``get``
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import pickle
+import queue
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import MemoryStore, PlasmaClient
+from ray_tpu._private import rpc as rpc_mod
+from ray_tpu._private.rpc import ConnectionLost, RpcClient, ServerConn, RpcServer
+
+logger = logging.getLogger(__name__)
+
+PLASMA_MARKER = b"\x00__IN_PLASMA__"
+
+
+# ---------------------------------------------------------------------------
+# public exception types
+# ---------------------------------------------------------------------------
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a task; re-raised at ``get``."""
+
+    def __init__(self, cause: BaseException, task_desc: str = "", tb: str = ""):
+        self.cause = cause
+        self.task_desc = task_desc
+        self.tb = tb
+        super().__init__(f"task {task_desc} failed: {cause!r}\n{tb}")
+
+
+class ActorDiedError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# argument capture: collect nested ObjectRefs while serializing
+# ---------------------------------------------------------------------------
+
+
+class _RefCollectingPickler(cloudpickle.Pickler):
+    """Serializes args while recording every nested ObjectID, so the owner can
+    promote inline values to plasma before a borrower needs them (the
+    reference tracks these as 'borrowed' refs, reference_count.h:67)."""
+
+    def __init__(self, file):
+        super().__init__(file, protocol=5)
+        self.refs: List[ObjectID] = []
+
+    def reducer_override(self, obj):
+        if isinstance(obj, ObjectID):
+            self.refs.append(obj)
+            return (ObjectID, (obj.binary(),))
+        return NotImplemented
+
+
+def _serialize_with_refs(obj: Any) -> Tuple[bytes, List[ObjectID]]:
+    buf = io.BytesIO()
+    p = _RefCollectingPickler(buf)
+    p.dump(obj)
+    return buf.getvalue(), p.refs
+
+
+# ---------------------------------------------------------------------------
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        *,
+        mode: str,  # "driver" | "worker"
+        job_id: JobID,
+        gcs_address: Tuple[str, int],
+        raylet_address: Tuple[str, int],
+        worker_id: Optional[WorkerID] = None,
+        session_dir: str = "",
+    ):
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.session_dir = session_dir
+        self.memory_store = MemoryStore()
+        self._task_counter = 0
+        self._put_counter = 0
+        self._counter_lock = threading.Lock()
+        self._current_task_id = TaskID.for_driver_task(job_id)
+        self._task_ctx = threading.local()
+
+        self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
+        self.gcs.call("subscribe", "actors")  # actor address/state updates
+        self.raylet = RpcClient(raylet_address)
+        reg = self.raylet.call(
+            "register_worker",
+            {
+                "worker_id": self.worker_id,
+                "address": ("", 0),  # drivers don't serve tasks
+                "pid": os.getpid(),
+                "is_driver": True,
+            },
+        ) if mode == "driver" else None
+        self.node_id: Optional[NodeID] = reg["node_id"] if reg else None
+        self._store_info = (
+            (reg["store_path"], reg["store_capacity"]) if reg else None
+        )
+        self.plasma: Optional[PlasmaClient] = None
+        if self._store_info:
+            self.plasma = PlasmaClient(self._store_info[0], self._store_info[1], self.raylet.call)
+
+        # function/class import cache
+        self._fn_cache: Dict[bytes, Any] = {}
+        self._fn_exported: set = set()
+        # direct connections to other workers / actors
+        self._worker_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._worker_clients_lock = threading.Lock()
+        # actor bookkeeping (submitter side). Ordered (max_concurrency==1)
+        # actors get caller-side FIFO submission: one in-flight call per
+        # (caller, actor), drained in seq order — this keeps ordering simple
+        # and correct across actor restarts (the reference instead pipelines
+        # with worker-side seq queues, direct_actor_task_submitter.cc).
+        self._actor_info: Dict[ActorID, Dict[str, Any]] = {}
+        self._actor_seq: Dict[ActorID, int] = {}
+        self._actor_pending: Dict[ActorID, List] = {}
+        self._actor_busy: Dict[ActorID, bool] = {}
+        self._actor_next_send: Dict[ActorID, int] = {}
+        self._actor_lock = threading.Lock()
+        # pending normal tasks owned by this worker
+        self._pending: Dict[TaskID, Dict[str, Any]] = {}
+        self._pending_lock = threading.Lock()
+        # local reference counting: when the last local ObjectRef instance
+        # handed out by this worker is GC'd, the owned object is freed
+        # (a single-process slice of the reference's distributed
+        # ReferenceCounter, reference_count.h:61)
+        self._local_refs: Dict[bytes, int] = {}
+        self._local_refs_lock = threading.Lock()
+        # async submission queue + submitter pool (lease-per-task with reuse)
+        self._shutdown = threading.Event()
+        self._submit_queue: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self._submitters = [
+            threading.Thread(target=self._submit_loop, name=f"submitter-{i}", daemon=True)
+            for i in range(8)
+        ]
+        for t in self._submitters:
+            t.start()
+        # task events → GCS
+        self._events: List[Dict[str, Any]] = []
+        self._events_lock = threading.Lock()
+        self._events_thread = threading.Thread(target=self._event_loop, daemon=True)
+        self._events_thread.start()
+
+    def late_register(self, address: Tuple[str, int]):
+        """Worker-mode registration once the task server port is known."""
+        reg = self.raylet.call(
+            "register_worker",
+            {"worker_id": self.worker_id, "address": address, "pid": os.getpid()},
+        )
+        self.node_id = reg["node_id"]
+        self._store_info = (reg["store_path"], reg["store_capacity"])
+        self.plasma = PlasmaClient(self._store_info[0], self._store_info[1], self.raylet.call)
+
+    # ------------------------------------------------------------------
+    # id helpers
+    # ------------------------------------------------------------------
+
+    def _next_task_id(self, actor_id: Optional[ActorID] = None) -> TaskID:
+        with self._counter_lock:
+            self._task_counter += 1
+            counter = self._task_counter
+        parent = getattr(self._task_ctx, "task_id", self._current_task_id)
+        if actor_id is not None:
+            return TaskID.for_actor_task(self.job_id, parent, counter, actor_id)
+        return TaskID.for_normal_task(self.job_id, parent, counter)
+
+    def _next_put_id(self) -> ObjectID:
+        with self._counter_lock:
+            self._put_counter += 1
+            counter = self._put_counter
+        parent = getattr(self._task_ctx, "task_id", self._current_task_id)
+        return ObjectID.from_put(parent, counter)
+
+    # ------------------------------------------------------------------
+    # put / get / wait
+    # ------------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectID:
+        object_id = self._next_put_id()
+        sobj = serialization.serialize(value)
+        self.plasma.put_serialized(object_id, sobj)
+        self._register_ref(object_id)
+        return object_id
+
+    def _register_ref(self, ref: ObjectID):
+        import weakref
+
+        binary = ref.binary()
+        with self._local_refs_lock:
+            self._local_refs[binary] = self._local_refs.get(binary, 0) + 1
+        weakref.finalize(ref, self._on_ref_deleted, binary)
+
+    def _on_ref_deleted(self, binary: bytes):
+        with self._local_refs_lock:
+            n = self._local_refs.get(binary, 0) - 1
+            if n > 0:
+                self._local_refs[binary] = n
+                return
+            self._local_refs.pop(binary, None)
+        if self._shutdown.is_set():
+            return
+        oid = ObjectID(binary)
+        self.memory_store.delete(oid)
+        try:
+            if self.plasma is not None:
+                self.plasma.delete(oid)
+        except Exception:
+            pass
+
+    def put_exception(self, object_id: ObjectID, exc: BaseException):
+        sobj = serialization.serialize(exc, is_exception=True)
+        self.plasma.put_serialized(object_id, sobj)
+
+    def _promote_to_plasma(self, object_id: ObjectID):
+        """Copy an owner-inline object into plasma so borrowers can read it."""
+        data = self.memory_store.get(object_id, timeout=0)
+        if data is None or data == PLASMA_MARKER:
+            return
+        if self.plasma.contains(object_id):
+            return
+        size = len(data)
+        try:
+            offset = self.raylet.call("store_create", (object_id, size))
+        except ValueError:
+            return  # another thread promoted it concurrently
+        self.plasma._view[offset : offset + size] = data
+        self.raylet.call("store_seal", object_id)
+
+    def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results: Dict[ObjectID, Any] = {}
+        plasma_ids: List[ObjectID] = []
+        for oid in object_ids:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            data = self.memory_store.get(oid, timeout=0)
+            if data is None and self._owns(oid):
+                # owned but still pending: wait for the reply
+                data = self.memory_store.get(oid, timeout=remaining)
+                if data is None:
+                    raise GetTimeoutError(f"timed out waiting for {oid.hex()[:16]}")
+            if data is None or data == PLASMA_MARKER:
+                plasma_ids.append(oid)
+            else:
+                results[oid] = self._deserialize(memoryview(data))
+        if plasma_ids:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            views = self.plasma.get_views(plasma_ids, timeout=remaining)
+            if views is None:
+                raise GetTimeoutError(
+                    f"timed out waiting for {[o.hex()[:16] for o in plasma_ids]}"
+                )
+            for oid, view in views.items():
+                try:
+                    value = self._deserialize(view)
+                except BaseException:
+                    self._release_plasma(oid.binary())
+                    raise
+                self._schedule_release(oid, view, value)
+                results[oid] = value
+        return [results[oid] for oid in object_ids]
+
+    def _schedule_release(self, oid: ObjectID, view: memoryview, value: Any):
+        """Unpin a plasma object once the deserialized value can no longer
+        reference its shared-memory buffers."""
+        import weakref
+
+        try:
+            nbuf = serialization.num_buffers(view)
+        except Exception:
+            nbuf = 1
+        if nbuf == 0:
+            # no out-of-band buffers: the value is a full copy
+            self._release_plasma(oid.binary())
+            return
+        try:
+            weakref.finalize(value, self._release_plasma, oid.binary())
+        except TypeError:
+            # not weakref-able (e.g. a dict of arrays): stays pinned for the
+            # process lifetime — safe, but unevictable
+            pass
+
+    def _release_plasma(self, binary: bytes):
+        if self._shutdown.is_set() or self.plasma is None:
+            return
+        try:
+            self.plasma.release(ObjectID(binary))
+        except Exception:
+            pass
+
+    def _deserialize(self, view: memoryview) -> Any:
+        return serialization.deserialize_from(view)
+
+    def _owns(self, oid: ObjectID) -> bool:
+        with self._pending_lock:
+            return oid.task_id() in self._pending
+
+    def ready(self, oid: ObjectID) -> bool:
+        data = self.memory_store.get(oid, timeout=0)
+        if data is not None:
+            return True
+        return self.plasma.contains(oid)
+
+    def wait(
+        self,
+        object_ids: Sequence[ObjectID],
+        num_returns: int,
+        timeout: Optional[float],
+        fetch_local: bool = True,
+    ) -> Tuple[List[ObjectID], List[ObjectID]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [o for o in object_ids if self.ready(o)]
+            if len(ready) >= num_returns:
+                ready = ready[:num_returns]
+                not_ready = [o for o in object_ids if o not in ready]
+                return ready, not_ready
+            if deadline is not None and time.monotonic() >= deadline:
+                not_ready = [o for o in object_ids if o not in ready]
+                return ready, not_ready
+            time.sleep(0.002)
+
+    # ------------------------------------------------------------------
+    # function export/import (GCS KV is the function table)
+    # ------------------------------------------------------------------
+
+    def export_function(self, fn: Any) -> bytes:
+        data = cloudpickle.dumps(fn)
+        fn_id = hashlib.sha1(data).digest()
+        if fn_id not in self._fn_exported:
+            self.gcs.call("kv_put", ("fn", fn_id.hex(), data, True))
+            self._fn_exported.add(fn_id)
+        self._fn_cache.setdefault(fn_id, fn)
+        return fn_id
+
+    def import_function(self, fn_id: bytes) -> Any:
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            data = self.gcs.call("kv_get", ("fn", fn_id.hex()))
+            if data is None:
+                raise RayTpuError(f"function {fn_id.hex()[:12]} not found in GCS")
+            fn = cloudpickle.loads(data)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # argument marshalling
+    # ------------------------------------------------------------------
+
+    def _serialize_args(self, args, kwargs) -> Tuple[bytes, List[ObjectID], List[ObjectID]]:
+        """Returns (payload, top_level_deps, nested_refs).
+
+        Top-level ObjectRef args are replaced by ("ref", oid) descriptors and
+        resolved by the executing worker; nested refs are promoted to plasma.
+        """
+        desc_args = []
+        deps: List[ObjectID] = []
+        for a in args:
+            if isinstance(a, ObjectID):
+                desc_args.append(("ref", a))
+                deps.append(a)
+            else:
+                desc_args.append(("val", a))
+        desc_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ObjectID):
+                desc_kwargs[k] = ("ref", v)
+                deps.append(v)
+            else:
+                desc_kwargs[k] = ("val", v)
+        payload, nested = _serialize_with_refs((desc_args, desc_kwargs))
+        nested = [r for r in nested if r not in deps]
+        return payload, deps, nested
+
+    def _resolve_deps(self, deps: List[ObjectID], nested: List[ObjectID]):
+        """Owner-side dependency resolution: make every dep readable by the
+        executing worker. Inline values get promoted to plasma."""
+        for oid in list(deps) + list(nested):
+            data = self.memory_store.get(oid, timeout=0)
+            if data is None and self._owns(oid):
+                # still in flight: wait for the reply, then re-read
+                data = self.memory_store.get(oid, timeout=None)
+            if data is not None and data != PLASMA_MARKER:
+                self._promote_to_plasma(oid)
+            # refs in plasma (markers, puts, other owners): the executing
+            # worker's blocking plasma get provides the wait.
+
+    # ------------------------------------------------------------------
+    # normal task submission
+    # ------------------------------------------------------------------
+
+    def submit_task(
+        self,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        max_retries: Optional[int] = None,
+        name: str = "",
+        scheduling_node: Optional[NodeID] = None,
+    ) -> List[ObjectID]:
+        task_id = self._next_task_id()
+        fn_id = self.export_function(fn)
+        payload, deps, nested = self._serialize_args(args, kwargs)
+        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        spec = {
+            "task_id": task_id,
+            "job_id": self.job_id,
+            "name": name or getattr(fn, "__name__", "task"),
+            "fn_id": fn_id,
+            "args": payload,
+            "deps": deps,
+            "nested": nested,
+            "num_returns": num_returns,
+            "resources": resources or {"CPU": 1.0},
+            "retries_left": (
+                max_retries if max_retries is not None else GlobalConfig.task_max_retries_default
+            ),
+            "caller_id": self.worker_id,
+            "scheduling_node": scheduling_node,
+        }
+        with self._pending_lock:
+            self._pending[task_id] = spec
+        for r in return_ids:
+            self._register_ref(r)
+        self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"])
+        self._submit_queue.put(spec)
+        return return_ids
+
+    def _submit_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                spec = self._submit_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if spec is None:
+                return
+            try:
+                if spec.get("__action__") == "send_actor":
+                    self._send_actor_task(spec["spec"])
+                elif spec.get("actor_id") is not None and spec.get("method") is not None:
+                    if spec.get("ordered", True):
+                        self._enqueue_actor_task(spec)
+                    else:
+                        self._send_actor_task(spec)
+                else:
+                    self._submit_one(spec)
+            except Exception as e:  # noqa: BLE001
+                self._fail_task(spec.get("spec", spec), e)
+
+    def _submit_one(self, spec: Dict[str, Any]):
+        """Lease a worker and push the task asynchronously. The submitter
+        thread is released as soon as the push is on the wire; completion
+        (reply handling, lease return, retries) runs on the rpc callback
+        executor, so in-flight task count is bounded by leases, not by the
+        submitter pool size."""
+        self._resolve_deps(spec["deps"], spec["nested"])
+        while not self._shutdown.is_set():
+            lease = self.raylet.call(
+                "request_worker_lease",
+                {"resources": spec["resources"], "job_id": spec["job_id"]},
+                timeout=GlobalConfig.worker_lease_timeout_s * 2,
+            )
+            if lease is None:
+                continue
+            try:
+                client = self._get_worker_client(tuple(lease["address"]))
+            except (ConnectionLost, OSError):
+                self._return_lease(lease)
+                continue
+
+            def on_done(kind, payload, spec=spec, lease=lease):
+                self._return_lease(lease)
+                if kind == rpc_mod.RESPONSE:
+                    self._handle_reply(spec, payload)
+                elif isinstance(payload, (ConnectionLost, OSError)):
+                    # worker died mid-task: owner-side retry (task_manager.h:277)
+                    if spec["retries_left"] > 0:
+                        spec["retries_left"] -= 1
+                        logger.warning(
+                            "task %s lost worker, retrying (%d left)",
+                            spec["name"],
+                            spec["retries_left"],
+                        )
+                        self._submit_queue.put(spec)
+                    else:
+                        self._fail_task(
+                            spec,
+                            WorkerCrashedError(
+                                f"worker died running {spec['name']}: {payload}"
+                            ),
+                        )
+                else:
+                    self._fail_task(spec, payload)
+
+            client.call_async("push_task", spec, on_done)
+            return
+
+    def _return_lease(self, lease):
+        try:
+            self.raylet.call("return_worker", {"worker_id": lease["worker_id"]})
+        except Exception:
+            pass
+
+    def _get_worker_client(self, addr: Tuple[str, int]) -> RpcClient:
+        with self._worker_clients_lock:
+            client = self._worker_clients.get(addr)
+            if client is not None and not client.closed:
+                return client
+            client = RpcClient(addr)
+            self._worker_clients[addr] = client
+            return client
+
+    def _handle_reply(self, spec: Dict[str, Any], reply: Dict[str, Any]):
+        task_id = spec["task_id"]
+        if reply["status"] == "retry":  # application asked for retry (unused yet)
+            raise RayTpuError("unexpected retry status")
+        for oid, kind, data in reply["results"]:
+            with self._local_refs_lock:
+                wanted = oid.binary() in self._local_refs
+            if not wanted:
+                continue  # every local ref was dropped before completion
+            if kind == "inline":
+                self.memory_store.put(oid, data)
+            else:
+                self.memory_store.put(oid, PLASMA_MARKER)
+        with self._pending_lock:
+            self._pending.pop(task_id, None)
+        self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"])
+
+    def _fail_task(self, spec: Dict[str, Any], exc: BaseException):
+        task_id = spec["task_id"]
+        err = serialization.serialize(
+            exc if isinstance(exc, RayTpuError) else TaskError(exc, spec["name"]),
+            is_exception=True,
+        ).to_bytes()
+        for i in range(spec["num_returns"]):
+            self.memory_store.put(ObjectID.for_task_return(task_id, i + 1), err)
+        with self._pending_lock:
+            self._pending.pop(task_id, None)
+        self._emit_event(task_id, "FAILED", spec["name"])
+
+    # ------------------------------------------------------------------
+    # actor submission
+    # ------------------------------------------------------------------
+
+    def create_actor(
+        self,
+        cls: type,
+        args: tuple,
+        kwargs: dict,
+        options: Dict[str, Any],
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        class_id = self.export_function(cls)
+        payload, deps, nested = self._serialize_args(args, kwargs)
+        self._resolve_deps(deps, nested)
+        spec = {
+            "actor_id": actor_id,
+            "job_id": self.job_id,
+            "class_id": class_id,
+            "class_name": getattr(cls, "__name__", "Actor"),
+            "args": payload,
+            "deps": deps,
+            "options": options,
+        }
+        self.gcs.call("register_actor", (actor_id, spec))
+        with self._actor_lock:
+            self._actor_info[actor_id] = {"address": None, "state": "PENDING"}
+            self._actor_seq[actor_id] = 0
+        return actor_id
+
+    def _resolve_actor(self, actor_id: ActorID, timeout: Optional[float] = None) -> Tuple[str, int]:
+        with self._actor_lock:
+            info = self._actor_info.get(actor_id)
+            if info and info.get("address") and info.get("state") == "ALIVE":
+                return info["address"]
+        view = self.gcs.call(
+            "wait_for_actor", (actor_id, timeout or GlobalConfig.worker_lease_timeout_s * 4)
+        )
+        if view is None:
+            raise GetTimeoutError(f"actor {actor_id.hex()[:8]} not ready")
+        if view["state"] == "DEAD":
+            raise ActorDiedError(
+                f"actor {actor_id.hex()[:8]} is dead: {view.get('death_cause')}"
+            )
+        with self._actor_lock:
+            self._actor_info[actor_id] = {"address": tuple(view["address"]), "state": "ALIVE"}
+        return tuple(view["address"])
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        *,
+        num_returns: int = 1,
+        ordered: bool = True,
+    ) -> List[ObjectID]:
+        task_id = self._next_task_id(actor_id)
+        payload, deps, nested = self._serialize_args(args, kwargs)
+        with self._actor_lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        spec = {
+            "task_id": task_id,
+            "job_id": self.job_id,
+            "actor_id": actor_id,
+            "method": method_name,
+            "name": method_name,
+            "args": payload,
+            "deps": deps,
+            "nested": nested,
+            "num_returns": num_returns,
+            "seq_no": seq,
+            "ordered": ordered,
+            "caller_id": self.worker_id,
+            "retries_left": 0,
+        }
+        with self._pending_lock:
+            self._pending[task_id] = spec
+        for r in return_ids:
+            self._register_ref(r)
+        self._submit_queue.put(spec)
+        return return_ids
+
+    def _enqueue_actor_task(self, spec: Dict[str, Any]):
+        import heapq
+
+        actor_id = spec["actor_id"]
+        with self._actor_lock:
+            heapq.heappush(
+                self._actor_pending.setdefault(actor_id, []), (spec["seq_no"], id(spec), spec)
+            )
+        self._pump_actor(actor_id)
+
+    def _pump_actor(self, actor_id: ActorID):
+        """Send the next in-order actor task if none is in flight. May run on
+        a submitter thread or the rpc callback executor."""
+        import heapq
+
+        with self._actor_lock:
+            if self._actor_busy.get(actor_id):
+                return
+            heap = self._actor_pending.get(actor_id) or []
+            nxt = self._actor_next_send.get(actor_id, 0)
+            if not (heap and heap[0][0] == nxt):
+                return
+            _, _, spec = heapq.heappop(heap)
+            self._actor_busy[actor_id] = True
+        # hop back to a submitter thread: address resolution can block
+        self._submit_queue.put({"__action__": "send_actor", "spec": spec})
+
+    def _actor_task_done(self, spec: Dict[str, Any]):
+        if not spec.get("ordered", True):
+            return
+        actor_id = spec["actor_id"]
+        with self._actor_lock:
+            self._actor_next_send[actor_id] = spec["seq_no"] + 1
+            self._actor_busy[actor_id] = False
+        self._pump_actor(actor_id)
+
+    def _send_actor_task(self, spec: Dict[str, Any]):
+        """Resolve the actor address (blocking, submitter thread) and push
+        asynchronously; completion runs on the callback executor."""
+        self._resolve_deps(spec["deps"], spec["nested"])
+        actor_id = spec["actor_id"]
+        attempts = 0
+        while not self._shutdown.is_set():
+            attempts += 1
+            try:
+                addr = self._resolve_actor(actor_id)
+            except ActorDiedError as e:
+                self._fail_task(spec, e)
+                self._actor_task_done(spec)
+                return
+            except GetTimeoutError as e:
+                self._fail_task(spec, e)
+                self._actor_task_done(spec)
+                return
+            try:
+                client = self._get_worker_client(addr)
+            except (ConnectionLost, OSError):
+                # couldn't even connect: address stale (restart in flight)
+                with self._actor_lock:
+                    self._actor_info.pop(actor_id, None)
+                if attempts > 50:
+                    self._fail_task(
+                        spec, ActorDiedError(f"actor {actor_id.hex()[:8]} unreachable")
+                    )
+                    self._actor_task_done(spec)
+                    return
+                time.sleep(0.1)
+                continue
+
+            def on_done(kind, payload, spec=spec, actor_id=actor_id):
+                if kind == rpc_mod.RESPONSE:
+                    self._handle_reply(spec, payload)
+                elif isinstance(payload, (ConnectionLost, OSError)):
+                    # The call may have executed before the worker died, so
+                    # the default is at-most-once: fail rather than resend
+                    # (the reference's actor tasks also fail here unless
+                    # max_task_retries is set).
+                    with self._actor_lock:
+                        self._actor_info.pop(actor_id, None)
+                    self._fail_task(
+                        spec,
+                        ActorDiedError(
+                            f"actor {actor_id.hex()[:8]} died while running "
+                            f"{spec['name']}: {payload}"
+                        ),
+                    )
+                else:
+                    self._fail_task(spec, payload)
+                self._actor_task_done(spec)
+
+            client.call_async("push_task", spec, on_done)
+            return
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.gcs.call("kill_actor", (actor_id, no_restart))
+
+    # ------------------------------------------------------------------
+    # task events
+    # ------------------------------------------------------------------
+
+    def _emit_event(self, task_id: TaskID, state: str, name: str):
+        if not GlobalConfig.task_events_enabled:
+            return
+        with self._events_lock:
+            self._events.append(
+                {
+                    "task_id": task_id.hex(),
+                    "state": state,
+                    "name": name,
+                    "ts": time.time(),
+                    "worker_id": self.worker_id.hex(),
+                }
+            )
+
+    def _event_loop(self):
+        while not self._shutdown.wait(1.0):
+            with self._events_lock:
+                batch, self._events = self._events, []
+            if batch:
+                try:
+                    self.gcs.call("add_task_events", batch, timeout=5.0)
+                except Exception:
+                    pass
+
+    def _on_gcs_notify(self, channel: str, message: Any):
+        if channel == "actors" or channel.startswith("actor:"):
+            actor_id = message["actor_id"]
+            with self._actor_lock:
+                if message["state"] == "ALIVE":
+                    self._actor_info[actor_id] = {
+                        "address": tuple(message["address"]),
+                        "state": "ALIVE",
+                    }
+                else:
+                    self._actor_info.pop(actor_id, None)
+
+    # ------------------------------------------------------------------
+
+    def shutdown(self):
+        self._shutdown.set()
+        for _ in self._submitters:
+            self._submit_queue.put(None)
+        with self._worker_clients_lock:
+            for c in self._worker_clients.values():
+                c.close()
+        if self.plasma is not None:
+            self.plasma.close()
+        self.gcs.close()
+        self.raylet.close()

@@ -1,0 +1,53 @@
+"""Worker process entry point.
+
+Spawned by the raylet (reference: python/ray/_private/workers/default_worker.py).
+Connects back to its raylet, registers, serves the direct task transport, and
+hosts the per-process CoreWorker so tasks can themselves call
+``ray_tpu.get/put/remote`` (nested tasks).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RAYTPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import JobID, WorkerID
+    from ray_tpu._private.rpc import RpcServer
+    from ray_tpu._private.task_executor import TaskExecutor
+    import ray_tpu._private.worker as worker_mod
+
+    worker_id = WorkerID.from_hex(os.environ["RAYTPU_WORKER_ID"])
+    raylet_addr = (os.environ["RAYTPU_RAYLET_HOST"], int(os.environ["RAYTPU_RAYLET_PORT"]))
+    gcs_addr = (os.environ["RAYTPU_GCS_HOST"], int(os.environ["RAYTPU_GCS_PORT"]))
+    session_dir = os.environ.get("RAYTPU_SESSION_DIR", "/tmp")
+
+    core = CoreWorker(
+        mode="worker",
+        job_id=JobID.from_int(0),
+        gcs_address=gcs_addr,
+        raylet_address=raylet_addr,
+        worker_id=worker_id,
+        session_dir=session_dir,
+    )
+    server = RpcServer(f"worker-{worker_id.hex()[:8]}")
+    TaskExecutor(core, server)
+    core.late_register(server.address)
+
+    # expose the runtime to user code running in tasks
+    worker_mod.global_worker = worker_mod.Worker(core, session_dir, is_driver=False)
+
+    # park the main thread; the raylet kills us via SIGTERM
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,464 @@
+"""GCS: the cluster metadata authority.
+
+Hosts node membership + health, the actor table and its fault-tolerance state
+machine, the internal KV (also the function/class export table), pubsub, and
+job state (reference: src/ray/gcs/gcs_server/ — GcsActorManager restart logic
+at gcs_actor_manager.cc:1100, GcsHealthCheckManager, GcsKvManager).
+
+Runs as an RpcServer inside the head node process. Raylets register and
+heartbeat; actor creation leases workers from raylets exactly like normal
+tasks (the reference's ScheduleByRaylet default, gcs_actor_scheduler.h:355).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ActorID, NodeID, WorkerID
+from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: gcs.proto ActorTableData.ActorState)
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, spec: Dict[str, Any]):
+        self.actor_id = actor_id
+        self.spec = spec  # creation spec: serialized class, args, options
+        self.state = PENDING_CREATION
+        self.address: Optional[Tuple[str, int]] = None
+        self.node_id: Optional[NodeID] = None
+        self.worker_id: Optional[WorkerID] = None
+        self.num_restarts = 0
+        self.max_restarts = spec["options"].get("max_restarts", 0)
+        self.name = spec["options"].get("name")
+        self.death_cause: Optional[str] = None
+
+    def public_view(self) -> Dict[str, Any]:
+        return {
+            "actor_id": self.actor_id,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "name": self.name,
+            "death_cause": self.death_cause,
+            "class_name": self.spec.get("class_name", ""),
+        }
+
+
+class NodeInfo:
+    def __init__(self, node_id: NodeID, address: Tuple[str, int], resources: Dict[str, float], labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address  # raylet rpc address
+        self.total_resources = dict(resources)
+        self.available_resources = dict(resources)
+        self.labels = labels
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+        self.store_path: str = labels.get("store_path", "")
+        self.store_capacity: int = int(labels.get("store_capacity", "0"))
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer("gcs", host, port)
+        self._lock = threading.RLock()
+        self._kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._named_actors: Dict[str, ActorID] = {}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._subscribers: Dict[str, List[ServerConn]] = {}
+        self._raylet_clients: Dict[NodeID, RpcClient] = {}
+        self._task_events: List[Dict[str, Any]] = []
+        self._stopped = threading.Event()
+        self.server.register_all(self)
+        self.server.on_disconnect = self._on_disconnect
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="gcs-health", daemon=True
+        )
+        self._health_thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+
+    def rpc_subscribe(self, conn: ServerConn, channel: str):
+        with self._lock:
+            self._subscribers.setdefault(channel, []).append(conn)
+        return True
+
+    def _publish(self, channel: str, message: Any):
+        with self._lock:
+            subs = list(self._subscribers.get(channel, ()))
+        for conn in subs:
+            conn.notify(channel, message)
+
+    def rpc_publish(self, conn: ServerConn, payload):
+        channel, message = payload
+        self._publish(channel, message)
+        return True
+
+    def _on_disconnect(self, conn: ServerConn):
+        with self._lock:
+            for subs in self._subscribers.values():
+                if conn in subs:
+                    subs.remove(conn)
+
+    # ------------------------------------------------------------------
+    # KV (also the function table: namespace "fn")
+    # ------------------------------------------------------------------
+
+    def rpc_kv_put(self, conn, payload):
+        ns, key, value, overwrite = payload
+        with self._lock:
+            space = self._kv.setdefault(ns, {})
+            if not overwrite and key in space:
+                return False
+            space[key] = value
+        return True
+
+    def rpc_kv_get(self, conn, payload):
+        ns, key = payload
+        with self._lock:
+            return self._kv.get(ns, {}).get(key)
+
+    def rpc_kv_multi_get(self, conn, payload):
+        ns, keys = payload
+        with self._lock:
+            space = self._kv.get(ns, {})
+            return {k: space[k] for k in keys if k in space}
+
+    def rpc_kv_del(self, conn, payload):
+        ns, key = payload
+        with self._lock:
+            return self._kv.get(ns, {}).pop(key, None) is not None
+
+    def rpc_kv_keys(self, conn, payload):
+        ns, prefix = payload
+        with self._lock:
+            return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # nodes
+    # ------------------------------------------------------------------
+
+    def rpc_register_node(self, conn, payload):
+        node_id, address, resources, labels = payload
+        info = NodeInfo(node_id, address, resources, labels)
+        with self._lock:
+            self._nodes[node_id] = info
+        conn.meta["node_id"] = node_id
+        self._publish("nodes", {"event": "added", "node": self._node_view(info)})
+        logger.info("node %s registered at %s resources=%s", node_id.hex()[:8], address, resources)
+        return True
+
+    def rpc_heartbeat(self, conn, payload):
+        node_id, available = payload
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None:
+                return False
+            info.last_heartbeat = time.monotonic()
+            info.available_resources = available
+            info.alive = True
+        return True
+
+    def rpc_get_nodes(self, conn, payload=None):
+        with self._lock:
+            return [self._node_view(n) for n in self._nodes.values()]
+
+    def _node_view(self, n: NodeInfo) -> Dict[str, Any]:
+        return {
+            "node_id": n.node_id,
+            "address": n.address,
+            "resources": n.total_resources,
+            "available": n.available_resources,
+            "labels": n.labels,
+            "alive": n.alive,
+            "store_path": n.store_path,
+            "store_capacity": n.store_capacity,
+        }
+
+    def _health_loop(self):
+        period = GlobalConfig.health_check_period_s
+        threshold = GlobalConfig.health_check_failure_threshold
+        while not self._stopped.wait(period):
+            now = time.monotonic()
+            dead: List[NodeInfo] = []
+            with self._lock:
+                for info in self._nodes.values():
+                    if info.alive and now - info.last_heartbeat > period * threshold:
+                        info.alive = False
+                        dead.append(info)
+            for info in dead:
+                logger.warning("node %s failed health check", info.node_id.hex()[:8])
+                self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
+                self._handle_node_death(info.node_id)
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    def rpc_register_actor(self, conn, payload):
+        """Register + schedule an actor; returns once scheduling has started.
+
+        The creation task is pushed to a leased worker asynchronously; callers
+        learn the address via the actor pubsub channel or rpc_get_actor.
+        """
+        actor_id, spec = payload
+        info = ActorInfo(actor_id, spec)
+        with self._lock:
+            if info.name:
+                if info.name in self._named_actors:
+                    raise ValueError(f"actor name {info.name!r} already taken")
+                self._named_actors[info.name] = actor_id
+            self._actors[actor_id] = info
+        threading.Thread(
+            target=self._schedule_actor, args=(info,), name="gcs-actor-sched", daemon=True
+        ).start()
+        return True
+
+    def rpc_get_actor(self, conn, payload):
+        actor_id = payload
+        with self._lock:
+            info = self._actors.get(actor_id)
+            return None if info is None else info.public_view()
+
+    def rpc_get_actor_by_name(self, conn, payload):
+        name = payload
+        with self._lock:
+            actor_id = self._named_actors.get(name)
+            if actor_id is None:
+                return None
+            return self._actors[actor_id].public_view()
+
+    def rpc_list_actors(self, conn, payload=None):
+        with self._lock:
+            return [a.public_view() for a in self._actors.values()]
+
+    def rpc_wait_for_actor(self, conn, payload):
+        """Long-poll until the actor is ALIVE or DEAD; returns its view."""
+        actor_id, timeout = payload
+        deadline = time.monotonic() + (timeout if timeout is not None else 1e9)
+        while time.monotonic() < deadline:
+            with self._lock:
+                info = self._actors.get(actor_id)
+                if info is not None and info.state in (ALIVE, DEAD):
+                    return info.public_view()
+            time.sleep(0.005)
+        return None
+
+    def rpc_kill_actor(self, conn, payload):
+        actor_id, no_restart = payload
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return False
+            if no_restart:
+                info.max_restarts = 0
+            address, worker_id, node_id = info.address, info.worker_id, info.node_id
+        if address is not None:
+            try:
+                client = RpcClient(address, connect_timeout=2.0)
+                client.call("kill_self", None, timeout=2.0)
+                client.close()
+            except Exception:
+                pass
+        return True
+
+    def _pick_node(self, resources: Dict[str, float]) -> Optional[NodeInfo]:
+        with self._lock:
+            candidates = [
+                n
+                for n in self._nodes.values()
+                if n.alive
+                and all(n.total_resources.get(k, 0) >= v for k, v in resources.items())
+            ]
+            if not candidates:
+                return None
+            # prefer most-available (spread-ish)
+            return max(
+                candidates,
+                key=lambda n: min(
+                    (n.available_resources.get(k, 0) - v for k, v in resources.items()),
+                    default=0,
+                ),
+            )
+
+    def _raylet_client(self, node: NodeInfo) -> RpcClient:
+        with self._lock:
+            client = self._raylet_clients.get(node.node_id)
+            if client is not None and not client.closed:
+                return client
+            client = RpcClient(node.address)
+            self._raylet_clients[node.node_id] = client
+            return client
+
+    def _schedule_actor(self, info: ActorInfo):
+        spec = info.spec
+        resources = spec["options"].get("resources_spec", {"CPU": 1.0})
+        deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
+        while time.monotonic() < deadline:
+            node = self._pick_node(resources)
+            if node is None:
+                time.sleep(0.1)
+                continue
+            lease = None
+            client = None
+            try:
+                client = self._raylet_client(node)
+                lease = client.call(
+                    "request_worker_lease",
+                    {"resources": resources, "actor_id": info.actor_id, "job_id": spec["job_id"]},
+                    timeout=GlobalConfig.worker_lease_timeout_s,
+                )
+                if lease is None:
+                    time.sleep(0.05)
+                    continue
+                worker_addr = tuple(lease["address"])
+                wclient = RpcClient(worker_addr)
+                try:
+                    wclient.call(
+                        "create_actor",
+                        {
+                            "actor_id": info.actor_id,
+                            "spec": spec,
+                            "num_restarts": info.num_restarts,
+                        },
+                        timeout=GlobalConfig.gcs_rpc_timeout_s * 10,
+                    )
+                finally:
+                    wclient.close()
+                with self._lock:
+                    info.state = ALIVE
+                    info.address = worker_addr
+                    info.node_id = node.node_id
+                    info.worker_id = lease["worker_id"]
+                self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
+                self._publish("actors", info.public_view())
+                return
+            except Exception as e:  # noqa: BLE001
+                # return the lease so a failed creation doesn't leak resources
+                if lease is not None and client is not None:
+                    try:
+                        client.call("return_worker", {"worker_id": lease["worker_id"]})
+                    except Exception:
+                        pass
+                from ray_tpu._private.rpc import ConnectionLost, RpcError
+
+                if not isinstance(e, (ConnectionLost, TimeoutError, OSError, RpcError)):
+                    # the actor constructor itself raised: surface the real
+                    # error instead of retrying (the user's bug won't go away)
+                    with self._lock:
+                        info.state = DEAD
+                        info.death_cause = f"actor constructor failed: {e!r}"
+                    self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
+                    self._publish("actors", info.public_view())
+                    return
+                logger.warning(
+                    "actor %s scheduling attempt failed: %r", info.actor_id.hex()[:8], e
+                )
+                time.sleep(0.2)
+        with self._lock:
+            info.state = DEAD
+            info.death_cause = "scheduling failed: no feasible node in time"
+        self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
+        self._publish("actors", info.public_view())
+
+    def rpc_report_worker_death(self, conn, payload):
+        """Raylet tells us a worker died; restart or mark-dead its actors
+        (reference: gcs_actor_manager.cc:1100 ReconstructActor)."""
+        node_id, worker_id, actor_ids, cause = (
+            payload["node_id"],
+            payload["worker_id"],
+            payload["actor_ids"],
+            payload.get("cause", "worker died"),
+        )
+        for actor_id in actor_ids:
+            self._reconstruct_actor(actor_id, cause)
+        return True
+
+    def _reconstruct_actor(self, actor_id: ActorID, cause: str):
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == DEAD:
+                return
+            if info.num_restarts < info.max_restarts or info.max_restarts < 0:
+                info.num_restarts += 1
+                info.state = RESTARTING
+                info.address = None
+                restart = True
+            else:
+                info.state = DEAD
+                info.death_cause = cause
+                restart = False
+        self._publish(f"actor:{actor_id.hex()}", info.public_view())
+        self._publish("actors", info.public_view())
+        if restart:
+            logger.info(
+                "restarting actor %s (%d/%s)",
+                actor_id.hex()[:8],
+                info.num_restarts,
+                info.max_restarts,
+            )
+            threading.Thread(
+                target=self._schedule_actor, args=(info,), daemon=True
+            ).start()
+
+    def _handle_node_death(self, node_id: NodeID):
+        with self._lock:
+            affected = [a.actor_id for a in self._actors.values() if a.node_id == node_id and a.state == ALIVE]
+        for actor_id in affected:
+            self._reconstruct_actor(actor_id, f"node {node_id.hex()[:8]} died")
+
+    # ------------------------------------------------------------------
+    # jobs + task events
+    # ------------------------------------------------------------------
+
+    def rpc_add_job(self, conn, payload):
+        with self._lock:
+            self._jobs[payload["job_id"].hex()] = payload
+        return True
+
+    def rpc_get_jobs(self, conn, payload=None):
+        with self._lock:
+            return list(self._jobs.values())
+
+    def rpc_add_task_events(self, conn, payload):
+        with self._lock:
+            self._task_events.extend(payload)
+            limit = GlobalConfig.task_events_buffer_size
+            if len(self._task_events) > limit:
+                del self._task_events[: len(self._task_events) - limit]
+        return True
+
+    def rpc_get_task_events(self, conn, payload=None):
+        with self._lock:
+            return list(self._task_events)
+
+    def rpc_get_config(self, conn, payload=None):
+        return GlobalConfig.dump()
+
+    def stop(self):
+        self._stopped.set()
+        self.server.stop()
+        with self._lock:
+            for c in self._raylet_clients.values():
+                c.close()

@@ -1,0 +1,180 @@
+"""Hierarchical binary IDs for jobs, tasks, actors, objects, nodes.
+
+Design mirrors the reference's ID hierarchy (reference: src/ray/common/id.h):
+ObjectIDs embed the TaskID that created them plus a return-index, TaskIDs embed
+the JobID (and ActorID for actor tasks), so ownership and lineage can be
+recovered from an ID alone without a directory lookup.
+
+Sizes (bytes): JobID=4, ActorID=16, TaskID=24, ObjectID=28, NodeID=28,
+WorkerID=28, PlacementGroupID=18.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+_rand_lock = threading.Lock()
+
+
+def _random_bytes(n: int) -> bytes:
+    return os.urandom(n)
+
+
+class BaseID:
+    SIZE = 28
+
+    __slots__ = ("_binary", "__weakref__")
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, bytes) or len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got "
+                f"{len(binary) if isinstance(binary, bytes) else type(binary)}"
+            )
+        self._binary = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(_random_bytes(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._binary == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._binary))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __lt__(self, other):
+        return self._binary < other._binary
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    @classmethod
+    def from_int(cls, value: int):
+        return cls(struct.pack(">I", value))
+
+    def int_value(self) -> int:
+        return struct.unpack(">I", self._binary)[0]
+
+
+class NodeID(BaseID):
+    SIZE = 28
+
+
+class WorkerID(BaseID):
+    SIZE = 28
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 18
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(_random_bytes(cls.SIZE - JobID.SIZE) + job_id.binary())
+
+
+class ActorID(BaseID):
+    SIZE = 16
+    UNIQUE_BYTES = SIZE - JobID.SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(_random_bytes(cls.UNIQUE_BYTES) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[self.UNIQUE_BYTES :])
+
+
+class TaskID(BaseID):
+    SIZE = 24
+    UNIQUE_BYTES = SIZE - ActorID.SIZE
+
+    @classmethod
+    def for_driver_task(cls, job_id: JobID):
+        return cls(
+            _random_bytes(cls.UNIQUE_BYTES) + ActorID.nil().binary()[: ActorID.SIZE - JobID.SIZE] + job_id.binary()
+        )
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID, parent: "TaskID", counter: int):
+        seed = parent.binary() + struct.pack(">Q", counter)
+        import hashlib
+
+        digest = hashlib.sha1(seed).digest()[: cls.UNIQUE_BYTES]
+        return cls(digest + ActorID.nil().binary()[: ActorID.SIZE - JobID.SIZE] + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, job_id: JobID, parent: "TaskID", counter: int, actor_id: ActorID):
+        seed = parent.binary() + struct.pack(">Q", counter)
+        import hashlib
+
+        digest = hashlib.sha1(seed).digest()[: cls.UNIQUE_BYTES]
+        return cls(digest + actor_id.binary())
+
+    @classmethod
+    def for_actor_creation_task(cls, actor_id: ActorID):
+        return cls(b"\x00" * cls.UNIQUE_BYTES + actor_id.binary())
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._binary[self.UNIQUE_BYTES :])
+
+    def job_id(self) -> JobID:
+        return self.actor_id().job_id()
+
+
+class ObjectID(BaseID):
+    """TaskID (24) + big-endian return-index (4)."""
+
+    SIZE = 28
+    INDEX_BYTES = 4
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int):
+        return cls(task_id.binary() + struct.pack(">I", index))
+
+    @classmethod
+    def from_put(cls, task_id: TaskID, put_counter: int):
+        # Put objects use the high bit of the index to avoid colliding with
+        # task returns.
+        return cls(task_id.binary() + struct.pack(">I", 0x80000000 | put_counter))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._binary[: TaskID.SIZE])
+
+    def return_index(self) -> int:
+        return struct.unpack(">I", self._binary[TaskID.SIZE :])[0]
+
+    def is_put(self) -> bool:
+        return bool(self.return_index() & 0x80000000)
+
+    def job_id(self) -> JobID:
+        return self.task_id().job_id()
+
+
+ObjectRef = ObjectID  # public alias used throughout the API layer

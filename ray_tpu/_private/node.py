@@ -1,0 +1,89 @@
+"""Node bootstrap: starts and supervises the per-node services.
+
+A head node hosts the GCS and one raylet; additional nodes (in tests, the
+in-process ``Cluster`` fixture; in production, other TPU-VM hosts) host one
+raylet each pointing at the head's GCS (reference: python/ray/_private/
+node.py:37, services.py — here the services are in-process servers rather
+than spawned binaries; worker processes are real subprocesses).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu._private.raylet import Raylet
+
+
+def _detect_tpu_resources() -> Dict[str, float]:
+    """Surface TPU chips as a first-class resource (the reference has no TPU
+    resource at all — util/accelerators/accelerators.py is GPU-only).
+
+    Detection is env-based, NOT via ``import jax``: initializing the TPU
+    runtime claims the chip for this process, and the driver must leave it
+    free for TPU-leased workers.
+    """
+    topo = os.environ.get("RAYTPU_TPU_TOPOLOGY") or os.environ.get("PALLAS_AXON_TPU_GEN")
+    if not topo:
+        return {}
+    # e.g. "v5e" (one chip tunnel) or "v5e-8" → 8 chips on this host
+    if "-" in topo:
+        try:
+            return {"TPU": float(int(topo.rsplit("-", 1)[1]))}
+        except ValueError:
+            pass
+    return {"TPU": 1.0}
+
+
+class Node:
+    def __init__(
+        self,
+        head: bool = True,
+        gcs_address: Optional[Tuple[str, int]] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        store_capacity: Optional[int] = None,
+        session_dir: Optional[str] = None,
+        num_cpus: Optional[float] = None,
+        detect_tpu: bool = True,
+        node_name: str = "head",
+    ):
+        if session_dir is None:
+            session_dir = os.path.join(
+                tempfile.gettempdir(), f"raytpu_session_{uuid.uuid4().hex[:12]}"
+            )
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        self.session_dir = session_dir
+        self.gcs: Optional[GcsServer] = None
+        if head:
+            assert gcs_address is None
+            self.gcs = GcsServer()
+            gcs_address = self.gcs.address
+        self.gcs_address = gcs_address
+
+        res = dict(resources or {})
+        if "CPU" not in res:
+            res["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+        if detect_tpu and "TPU" not in res:
+            res.update(_detect_tpu_resources())
+        self.raylet = Raylet(
+            session_dir,
+            gcs_address,
+            resources=res,
+            labels=labels,
+            store_capacity=store_capacity,
+            node_name=node_name,
+        )
+
+    @property
+    def raylet_address(self) -> Tuple[str, int]:
+        return self.raylet.address
+
+    def stop(self):
+        self.raylet.stop()
+        if self.gcs is not None:
+            self.gcs.stop()

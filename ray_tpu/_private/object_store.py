@@ -1,0 +1,332 @@
+"""Object plane: in-process memory store + plasma-style shared-memory store.
+
+Mirrors the reference's two-tier object plane (reference:
+src/ray/core_worker/store_provider/memory_store/, src/ray/object_manager/plasma/):
+small/inline objects live in the owner's in-process memory store; large objects
+live in a node-wide shared-memory arena, written and read zero-copy by every
+worker process on the node via mmap. Allocation/seal metadata is coordinated by
+the raylet's store service; the data plane never crosses a socket.
+
+The arena allocator is native C++ when built (ray_tpu/native/object_store.cc),
+with a Python first-fit fallback so the runtime works before compilation.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ObjectLostError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# In-process memory store (inline results, small puts)
+# ---------------------------------------------------------------------------
+
+
+class MemoryStore:
+    """Per-process store for inline objects; supports blocking gets."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, bytes] = {}
+        self._cv = threading.Condition()
+
+    def put(self, object_id: ObjectID, data: bytes):
+        with self._cv:
+            self._objects[object_id] = data
+            self._cv.notify_all()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            return object_id in self._objects
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Optional[bytes]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while object_id not in self._objects:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining if remaining is not None else 1.0)
+            return self._objects[object_id]
+
+    def delete(self, object_id: ObjectID):
+        with self._cv:
+            self._objects.pop(object_id, None)
+
+
+# ---------------------------------------------------------------------------
+# Arena allocators
+# ---------------------------------------------------------------------------
+
+
+class _PyArena:
+    """First-fit free-list allocator (fallback when native lib not built)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # sorted list of (offset, size) free ranges
+        self._free: List[Tuple[int, int]] = [(0, capacity)]
+        self._allocated: Dict[int, int] = {}
+
+    def allocate(self, size: int) -> int:
+        size = max(64, (size + 63) & ~63)
+        for i, (off, sz) in enumerate(self._free):
+            if sz >= size:
+                if sz == size:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (off + size, sz - size)
+                self._allocated[off] = size
+                return off
+        return -1
+
+    def free(self, offset: int):
+        size = self._allocated.pop(offset, None)
+        if size is None:
+            return
+        self._free.append((offset, size))
+        self._free.sort()
+        merged: List[Tuple[int, int]] = []
+        for off, sz in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == off:
+                merged[-1] = (merged[-1][0], merged[-1][1] + sz)
+            else:
+                merged.append((off, sz))
+        self._free = merged
+
+    def allocated_bytes(self) -> int:
+        return sum(self._allocated.values())
+
+
+def _make_arena(capacity: int):
+    if GlobalConfig.object_store_native:
+        try:
+            from ray_tpu.native import native_store
+
+            return native_store.NativeArena(capacity)
+        except Exception:
+            pass
+    return _PyArena(capacity)
+
+
+# ---------------------------------------------------------------------------
+# Plasma-style node store (server side; embedded in the raylet)
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("offset", "size", "sealed", "pin_count", "last_used", "creating_worker")
+
+    def __init__(self, offset: int, size: int, creating_worker=None):
+        self.offset = offset
+        self.size = size
+        self.sealed = False
+        self.pin_count = 0
+        self.last_used = time.monotonic()
+        self.creating_worker = creating_worker
+
+
+class PlasmaStore:
+    """Node-wide shm object store, metadata side. Lives in the raylet process.
+
+    Data plane: a single file in /dev/shm mapped by every process on the node.
+    This class owns allocation, seal notification, pinning, and LRU eviction
+    (reference: src/ray/object_manager/plasma/object_lifecycle_manager.cc,
+    eviction_policy.cc).
+    """
+
+    def __init__(self, session_dir: str, capacity: Optional[int] = None, name: str = "store"):
+        self.capacity = capacity or GlobalConfig.object_store_memory_bytes
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
+        self.path = os.path.join(
+            shm_dir, f"raytpu_{os.path.basename(session_dir)}_{name}_{os.getpid()}"
+        )
+        self._fd = os.open(self.path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        os.ftruncate(self._fd, self.capacity)
+        self._map = mmap.mmap(self._fd, self.capacity)
+        self._view = memoryview(self._map)
+        self._arena = _make_arena(self.capacity)
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._cv = threading.Condition()
+
+    # -- server-side API (called via raylet RPC handlers or locally) --
+
+    def create(self, object_id: ObjectID, size: int, creating_worker=None) -> int:
+        with self._cv:
+            if object_id in self._entries:
+                raise ValueError(f"object {object_id.hex()} already exists")
+            offset = self._arena.allocate(size)
+            if offset < 0:
+                self._evict_locked(size)
+                offset = self._arena.allocate(size)
+            if offset < 0:
+                raise ObjectStoreFullError(
+                    f"cannot allocate {size} bytes (capacity {self.capacity})"
+                )
+            self._entries[object_id] = _Entry(offset, size, creating_worker)
+            return offset
+
+    def seal(self, object_id: ObjectID):
+        with self._cv:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                raise KeyError(f"seal of unknown object {object_id.hex()}")
+            entry.sealed = True
+            entry.last_used = time.monotonic()
+            self._cv.notify_all()
+
+    def abort(self, object_id: ObjectID):
+        with self._cv:
+            entry = self._entries.pop(object_id, None)
+            if entry is not None and not entry.sealed:
+                self._arena.free(entry.offset)
+
+    def get_locations(
+        self, object_ids: List[ObjectID], timeout: Optional[float], pin: bool = True
+    ) -> Optional[Dict[ObjectID, Tuple[int, int]]]:
+        """Block until all objects are sealed; returns {oid: (offset, size)}."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                if all(
+                    (e := self._entries.get(o)) is not None and e.sealed for o in object_ids
+                ):
+                    result = {}
+                    for o in object_ids:
+                        entry = self._entries[o]
+                        entry.last_used = time.monotonic()
+                        if pin:
+                            entry.pin_count += 1
+                        result[o] = (entry.offset, entry.size)
+                    return result
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(min(remaining, 1.0) if remaining is not None else 1.0)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def release(self, object_id: ObjectID):
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None and e.pin_count > 0:
+                e.pin_count -= 1
+
+    def delete(self, object_id: ObjectID):
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is not None and e.pin_count == 0:
+                self._entries.pop(object_id)
+                self._arena.free(e.offset)
+
+    def _evict_locked(self, needed: int):
+        """LRU-evict unpinned sealed objects until ``needed`` could fit."""
+        candidates = sorted(
+            (o for o, e in self._entries.items() if e.sealed and e.pin_count == 0),
+            key=lambda o: self._entries[o].last_used,
+        )
+        freed = 0
+        for o in candidates:
+            e = self._entries.pop(o)
+            self._arena.free(e.offset)
+            freed += e.size
+            if freed >= needed:
+                break
+
+    def stats(self) -> Dict[str, int]:
+        with self._cv:
+            return {
+                "capacity": self.capacity,
+                "num_objects": len(self._entries),
+                "allocated_bytes": sum(e.size for e in self._entries.values()),
+            }
+
+    # -- local data-plane access (for the raylet process itself) --
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return self._view[offset : offset + size]
+
+    def close(self):
+        try:
+            self._view.release()
+            self._map.close()
+            os.close(self._fd)
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class PlasmaClient:
+    """Worker-side client: RPC for metadata, direct mmap for data.
+
+    ``rpc_call(method, payload)`` is provided by the worker's raylet
+    connection; methods are ``store_create/store_seal/...``.
+    """
+
+    def __init__(self, store_path: str, capacity: int, rpc_call):
+        self._rpc = rpc_call
+        fd = os.open(store_path, os.O_RDWR)
+        try:
+            self._map = mmap.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+        self._view = memoryview(self._map)
+
+    def put_serialized(self, object_id: ObjectID, sobj: serialization.SerializedObject):
+        size = sobj.total_size()
+        deadline = time.monotonic() + GlobalConfig.object_store_full_retry_s
+        while True:
+            try:
+                offset = self._rpc("store_create", (object_id, size))
+                break
+            except ValueError:
+                # object already exists (e.g. a retried task re-creating the
+                # result its first attempt already sealed): nothing to do
+                return
+            except ObjectStoreFullError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        sobj.write_to(self._view[offset : offset + size])
+        self._rpc("store_seal", object_id)
+
+    def get_views(
+        self, object_ids: List[ObjectID], timeout: Optional[float] = None
+    ) -> Optional[Dict[ObjectID, memoryview]]:
+        locs = self._rpc("store_get", (object_ids, timeout))
+        if locs is None:
+            return None
+        return {o: self._view[off : off + size] for o, (off, size) in locs.items()}
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return self._rpc("store_contains", object_id)
+
+    def release(self, object_id: ObjectID):
+        self._rpc("store_release", object_id)
+
+    def delete(self, object_id: ObjectID):
+        self._rpc("store_delete", object_id)
+
+    def close(self):
+        try:
+            self._view.release()
+            self._map.close()
+        except (OSError, BufferError):
+            pass

@@ -1,0 +1,353 @@
+"""Raylet: the per-node manager.
+
+Owns the worker pool, grants lease-based worker leases against the node's
+resource view, embeds the plasma store's metadata service, heartbeats
+resources to the GCS, and reports worker deaths (reference: src/ray/raylet/
+node_manager.cc:1848 HandleRequestWorkerLease, worker_pool.h:156,
+local_task_manager.cc:101).
+
+One raylet == one node. The in-process ``Cluster`` test fixture starts
+several raylets against one GCS to simulate multi-node (reference:
+python/ray/cluster_utils.py:99).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import object_store
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ActorID, NodeID, WorkerID
+from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, proc: Optional[subprocess.Popen], tpu: bool = False):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.tpu = tpu
+        self.address: Optional[Tuple[str, int]] = None
+        self.registered = threading.Event()
+        self.idle = True
+        self.actor_ids: List[ActorID] = []
+        self.conn: Optional[ServerConn] = None
+        self.last_idle_at = time.monotonic()
+        self.lease_resources: Dict[str, float] = {}
+
+
+class Raylet:
+    def __init__(
+        self,
+        session_dir: str,
+        gcs_address: Tuple[str, int],
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        store_capacity: Optional[int] = None,
+        node_name: str = "node",
+    ):
+        self.node_id = NodeID.from_random()
+        self.session_dir = session_dir
+        self.gcs_address = gcs_address
+        self.server = RpcServer(f"raylet-{node_name}")
+        self.store = object_store.PlasmaStore(
+            session_dir, capacity=store_capacity, name=node_name
+        )
+        if resources is None:
+            resources = {"CPU": float(os.cpu_count() or 1)}
+        resources.setdefault("node", 1.0)
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.labels = dict(labels or {})
+        self.labels["store_path"] = self.store.path
+        self.labels["store_capacity"] = str(self.store.capacity)
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._res_cv = threading.Condition()
+        self._stopped = threading.Event()
+        self.server.register_all(self)
+        self.server.on_disconnect = self._on_disconnect
+        self.gcs = RpcClient(gcs_address)
+        self.gcs.call(
+            "register_node",
+            (self.node_id, self.server.address, self.total_resources, self.labels),
+        )
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+        for _ in range(GlobalConfig.worker_pool_prestart):
+            self._spawn_worker()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    # ------------------------------------------------------------------
+    # worker pool
+    # ------------------------------------------------------------------
+
+    def _spawn_worker(self, tpu: bool = False) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env["RAYTPU_WORKER_ID"] = worker_id.hex()
+        env["RAYTPU_RAYLET_HOST"] = self.server.host
+        env["RAYTPU_RAYLET_PORT"] = str(self.server.port)
+        env["RAYTPU_GCS_HOST"] = self.gcs_address[0]
+        env["RAYTPU_GCS_PORT"] = str(self.gcs_address[1])
+        env["RAYTPU_SESSION_DIR"] = self.session_dir
+        env["RAYTPU_NODE_ID"] = self.node_id.hex()
+        if not tpu:
+            # CPU workers must not claim the TPU runtime: force the CPU
+            # platform and disable the TPU PJRT plugin registration.
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        # ensure the worker can import ray_tpu regardless of the driver's cwd
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
+        )
+        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        logfile = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.default_worker"],
+                env=env,
+                stdout=logfile,
+                stderr=subprocess.STDOUT,
+            )
+        finally:
+            logfile.close()  # the child holds its own inherited fd
+        handle = WorkerHandle(worker_id, proc, tpu=tpu)
+        with self._res_cv:
+            self._workers[worker_id] = handle
+        return handle
+
+    def rpc_register_worker(self, conn: ServerConn, payload):
+        worker_id, address, pid = payload["worker_id"], tuple(payload["address"]), payload["pid"]
+        is_driver = payload.get("is_driver", False)
+        with self._res_cv:
+            handle = self._workers.get(worker_id)
+            if handle is None:  # driver or externally started worker
+                handle = WorkerHandle(worker_id, None)
+                self._workers[worker_id] = handle
+            handle.address = address
+            handle.conn = conn
+            handle.registered.set()
+            handle.idle = not is_driver  # drivers are never leased out
+            handle.last_idle_at = time.monotonic()
+            self._res_cv.notify_all()
+        conn.meta["worker_id"] = worker_id
+        return {"store_path": self.store.path, "store_capacity": self.store.capacity,
+                "node_id": self.node_id}
+
+    def _on_disconnect(self, conn: ServerConn):
+        worker_id = conn.meta.get("worker_id")
+        if worker_id is None:
+            return
+        with self._res_cv:
+            handle = self._workers.pop(worker_id, None)
+            if handle is None:
+                return
+            for k, v in handle.lease_resources.items():
+                self.available[k] = self.available.get(k, 0) + v
+            handle.lease_resources = {}
+            self._res_cv.notify_all()
+        if handle.proc is not None and handle.proc.poll() is None:
+            handle.proc.terminate()
+        logger.info("worker %s died (actors=%d)", worker_id.hex()[:8], len(handle.actor_ids))
+        try:
+            self.gcs.call(
+                "report_worker_death",
+                {
+                    "node_id": self.node_id,
+                    "worker_id": worker_id,
+                    "actor_ids": handle.actor_ids,
+                    "cause": "worker process died",
+                },
+            )
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # leases (two-level scheduling: callers lease workers from this node)
+    # ------------------------------------------------------------------
+
+    def rpc_request_worker_lease(self, conn: ServerConn, payload) -> Optional[Dict[str, Any]]:
+        resources: Dict[str, float] = dict(payload.get("resources") or {"CPU": 1.0})
+        actor_id: Optional[ActorID] = payload.get("actor_id")
+        timeout = payload.get("timeout", GlobalConfig.worker_lease_timeout_s)
+        deadline = time.monotonic() + timeout
+        with self._res_cv:
+            # infeasible check against total
+            for k, v in resources.items():
+                if v > 0 and self.total_resources.get(k, 0) < v:
+                    raise ValueError(
+                        f"resource request {resources} infeasible on node with "
+                        f"{self.total_resources}"
+                    )
+            need_tpu = resources.get("TPU", 0) > 0
+            while not self._stopped.is_set():
+                have_resources = all(
+                    self.available.get(k, 0) >= v for k, v in resources.items()
+                )
+                idle = self._pop_idle_locked(need_tpu) if have_resources else None
+                if have_resources and idle is not None:
+                    for k, v in resources.items():
+                        self.available[k] = self.available.get(k, 0) - v
+                    idle.idle = False
+                    idle.lease_resources = dict(resources)
+                    if actor_id is not None:
+                        idle.actor_ids.append(actor_id)
+                    return {"worker_id": idle.worker_id, "address": idle.address}
+                if have_resources and idle is None:
+                    self._reap_dead_locked()
+                    spawning = sum(
+                        1
+                        for h in self._workers.values()
+                        if not h.registered.is_set() and h.tpu == need_tpu
+                    )
+                    if (
+                        spawning == 0
+                        and len(self._workers) < GlobalConfig.max_workers_per_node
+                    ):
+                        self._res_cv.release()
+                        try:
+                            self._spawn_worker(tpu=need_tpu)
+                        finally:
+                            self._res_cv.acquire()
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._res_cv.wait(min(remaining, 0.5))
+        return None
+
+    def _reap_dead_locked(self):
+        """Remove workers whose process exited before registering (e.g. the
+        worker crashed at import); otherwise they'd count as 'spawning'
+        forever and starve the lease loop."""
+        dead = [
+            wid
+            for wid, h in self._workers.items()
+            if not h.registered.is_set() and h.proc is not None and h.proc.poll() is not None
+        ]
+        for wid in dead:
+            h = self._workers.pop(wid)
+            logger.warning(
+                "worker %s exited with code %s before registering (see %s/logs)",
+                wid.hex()[:8],
+                h.proc.returncode,
+                self.session_dir,
+            )
+
+    def _pop_idle_locked(self, need_tpu: bool = False) -> Optional[WorkerHandle]:
+        for handle in self._workers.values():
+            if (
+                handle.idle
+                and handle.registered.is_set()
+                and not handle.actor_ids
+                and handle.tpu == need_tpu
+            ):
+                return handle
+        return None
+
+    def rpc_return_worker(self, conn: ServerConn, payload):
+        worker_id = payload["worker_id"]
+        kill = payload.get("kill", False)
+        with self._res_cv:
+            handle = self._workers.get(worker_id)
+            if handle is None:
+                return False
+            for k, v in handle.lease_resources.items():
+                self.available[k] = self.available.get(k, 0) + v
+            handle.lease_resources = {}
+            # a worker returned to the pool hosts no actors (failed actor
+            # creation must not leave the worker marked as an actor host)
+            handle.actor_ids = []
+            handle.idle = True
+            handle.last_idle_at = time.monotonic()
+            self._res_cv.notify_all()
+        if kill and handle.proc is not None:
+            handle.proc.terminate()
+        return True
+
+    def rpc_get_node_info(self, conn, payload=None):
+        with self._res_cv:
+            return {
+                "node_id": self.node_id,
+                "resources": self.total_resources,
+                "available": self.available,
+                "store_path": self.store.path,
+                "store_capacity": self.store.capacity,
+                "num_workers": len(self._workers),
+                "labels": self.labels,
+            }
+
+    # ------------------------------------------------------------------
+    # store metadata service (data plane is direct shm)
+    # ------------------------------------------------------------------
+
+    def rpc_store_create(self, conn, payload):
+        object_id, size = payload
+        return self.store.create(object_id, size)
+
+    def rpc_store_seal(self, conn, payload):
+        self.store.seal(payload)
+        return True
+
+    def rpc_store_get(self, conn, payload):
+        object_ids, timeout = payload
+        return self.store.get_locations(object_ids, timeout)
+
+    def rpc_store_contains(self, conn, payload):
+        return self.store.contains(payload)
+
+    def rpc_store_release(self, conn, payload):
+        self.store.release(payload)
+        return True
+
+    def rpc_store_delete(self, conn, payload):
+        self.store.delete(payload)
+        return True
+
+    def rpc_store_abort(self, conn, payload):
+        self.store.abort(payload)
+        return True
+
+    def rpc_store_stats(self, conn, payload=None):
+        return self.store.stats()
+
+    # ------------------------------------------------------------------
+
+    def _heartbeat_loop(self):
+        period = GlobalConfig.health_check_period_s
+        while not self._stopped.wait(period / 2):
+            try:
+                with self._res_cv:
+                    available = dict(self.available)
+                self.gcs.call("heartbeat", (self.node_id, available), timeout=5.0)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stopped.set()
+        with self._res_cv:
+            workers = list(self._workers.values())
+            self._res_cv.notify_all()
+        for handle in workers:
+            if handle.proc is not None and handle.proc.poll() is None:
+                handle.proc.terminate()
+        for handle in workers:
+            if handle.proc is not None:
+                try:
+                    handle.proc.wait(timeout=3)
+                except subprocess.TimeoutExpired:
+                    handle.proc.kill()
+        self.server.stop()
+        self.gcs.close()
+        self.store.close()

@@ -1,0 +1,366 @@
+"""Minimal message RPC over localhost TCP sockets.
+
+The control plane of the runtime (GCS services, raylet leases, direct
+worker-to-worker task push) runs on this layer. Frames are length-prefixed
+pickled tuples ``(kind, msg_id, method, payload)``. The server runs a thread
+per connection; the client multiplexes request/response by ``msg_id`` and
+routes unsolicited frames (pubsub pushes) to a notification callback.
+
+This fills the role of the reference's gRPC wrappers (reference:
+src/ray/rpc/grpc_server.h, client_call.h) with a dependency-free transport;
+the wire protocol is an implementation detail hidden behind ``RpcServer`` /
+``RpcClient`` so a gRPC/C++ transport can replace it without touching
+call sites.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.config import GlobalConfig
+
+_HEADER = struct.Struct(">I")
+
+REQUEST = 0
+RESPONSE = 1
+ERROR = 2
+NOTIFY = 3
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _send_frame(sock: socket.socket, obj: Any, lock: threading.Lock):
+    data = pickle.dumps(obj, protocol=5)
+    with lock:
+        sock.sendall(_HEADER.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionLost("socket closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > GlobalConfig.rpc_max_frame_bytes:
+        raise RpcError(f"frame too large: {length}")
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class ServerConn:
+    """Server-side view of one client connection; supports push (NOTIFY)."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.addr = addr
+        self.send_lock = threading.Lock()
+        self.closed = threading.Event()
+        self.meta: Dict[str, Any] = {}  # handler-attached state (e.g. worker id)
+
+    def notify(self, method: str, payload: Any):
+        try:
+            _send_frame(self.sock, (NOTIFY, 0, method, payload), self.send_lock)
+        except OSError:
+            self.closed.set()
+
+    def close(self):
+        self.closed.set()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RpcServer:
+    """Thread-per-connection RPC server.
+
+    Handlers: ``fn(conn: ServerConn, payload) -> reply``. Raising inside a
+    handler sends an ERROR frame carrying the exception.
+    """
+
+    def __init__(self, name: str = "rpc", host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self._handlers: Dict[str, Callable[[ServerConn, Any], Any]] = {}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()
+        self._conns: Dict[int, ServerConn] = {}
+        self._conns_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self.on_disconnect: Optional[Callable[[ServerConn], None]] = None
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{name}-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def register(self, method: str, fn: Callable[[ServerConn, Any], Any]):
+        self._handlers[method] = fn
+
+    def register_all(self, obj: Any, prefix: str = ""):
+        """Register every ``rpc_<name>`` method of obj as handler ``<name>``."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self.register(prefix + attr[4:], getattr(obj, attr))
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = ServerConn(sock, addr)
+            with self._conns_lock:
+                self._conns[id(conn)] = conn
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), name=f"{self.name}-conn", daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: ServerConn):
+        # Each request runs in its own thread so blocking handlers (long-poll
+        # store gets, worker leases) never head-of-line-block a connection.
+        # Ordering guarantees (e.g. actor task seq-no ordering) are enforced
+        # by the handlers themselves, as in the reference's scheduling queues.
+        try:
+            while not self._stopped.is_set():
+                kind, msg_id, method, payload = _recv_frame(conn.sock)
+                if kind != REQUEST:
+                    continue
+                threading.Thread(
+                    target=self._dispatch,
+                    args=(conn, msg_id, method, payload),
+                    name=f"{self.name}-h-{method}",
+                    daemon=True,
+                ).start()
+        except (ConnectionLost, OSError):
+            pass
+        finally:
+            with self._conns_lock:
+                self._conns.pop(id(conn), None)
+            conn.closed.set()
+            if self.on_disconnect is not None:
+                try:
+                    self.on_disconnect(conn)
+                except Exception:
+                    pass
+
+    def _dispatch(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
+        handler = self._handlers.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for {method!r} on {self.name}")
+            reply = handler(conn, payload)
+            _send_frame(conn.sock, (RESPONSE, msg_id, method, reply), conn.send_lock)
+        except (ConnectionLost, OSError):
+            conn.closed.set()
+        except Exception as e:  # noqa: BLE001 - forwarded to caller
+            try:
+                _send_frame(conn.sock, (ERROR, msg_id, method, e), conn.send_lock)
+            except (ConnectionLost, OSError):
+                conn.closed.set()
+            except Exception:
+                _send_frame(
+                    conn.sock, (ERROR, msg_id, method, RpcError(repr(e))), conn.send_lock
+                )
+
+    def stop(self):
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()
+
+
+class _CallbackExecutor:
+    """Small shared pool that runs RPC completion callbacks off the reader
+    threads, so a slow callback can't stall response demultiplexing."""
+
+    def __init__(self, num_threads: int = 2):
+        import queue as _q
+
+        self._q: "_q.Queue" = _q.Queue()
+        for i in range(num_threads):
+            threading.Thread(
+                target=self._loop, name=f"rpc-cb-{i}", daemon=True
+            ).start()
+
+    def _loop(self):
+        while True:
+            fn, args = self._q.get()
+            try:
+                fn(*args)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("rpc callback failed")
+
+    def submit(self, fn, *args):
+        self._q.put((fn, args))
+
+
+_callback_executor: Optional[_CallbackExecutor] = None
+_callback_executor_lock = threading.Lock()
+
+
+def _get_callback_executor() -> _CallbackExecutor:
+    global _callback_executor
+    with _callback_executor_lock:
+        if _callback_executor is None:
+            _callback_executor = _CallbackExecutor()
+        return _callback_executor
+
+
+class RpcClient:
+    """Blocking RPC client with response multiplexing and notify routing."""
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        on_notify: Optional[Callable[[str, Any], None]] = None,
+        connect_timeout: Optional[float] = None,
+    ):
+        timeout = connect_timeout or GlobalConfig.rpc_connect_timeout_s
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection(address, timeout=timeout)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"cannot connect to {address}: {e}") from e
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self.address = address
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, Any] = {}
+        self._pending_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._on_notify = on_notify
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        try:
+            while True:
+                kind, msg_id, method, payload = _recv_frame(self._sock)
+                if kind == NOTIFY:
+                    if self._on_notify is not None:
+                        try:
+                            self._on_notify(method, payload)
+                        except Exception:
+                            pass
+                    continue
+                with self._pending_lock:
+                    slot = self._pending.pop(msg_id, None)
+                if slot is None:
+                    continue
+                if "callback" in slot:
+                    _get_callback_executor().submit(slot["callback"], kind, payload)
+                else:
+                    slot["result"] = (kind, payload)
+                    slot["event"].set()
+        except (ConnectionLost, OSError, EOFError):
+            pass
+        finally:
+            self._closed.set()
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            err = ConnectionLost(f"connection to {self.address} lost")
+            for slot in pending.values():
+                if "callback" in slot:
+                    _get_callback_executor().submit(slot["callback"], ERROR, err)
+                else:
+                    slot["result"] = (ERROR, err)
+                    slot["event"].set()
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        if self._closed.is_set():
+            raise ConnectionLost(f"connection to {self.address} closed")
+        msg_id = next(self._ids)
+        slot = {"event": threading.Event(), "result": None}
+        with self._pending_lock:
+            self._pending[msg_id] = slot
+        try:
+            _send_frame(self._sock, (REQUEST, msg_id, method, payload), self._send_lock)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise ConnectionLost(str(e)) from e
+        if not slot["event"].wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise TimeoutError(f"rpc {method} to {self.address} timed out after {timeout}s")
+        with self._pending_lock:
+            self._pending.pop(msg_id, None)
+        kind, payload = slot["result"]
+        if kind == ERROR:
+            raise payload
+        return payload
+
+    def call_async(self, method: str, payload: Any, callback: Callable[[int, Any], None]):
+        """Fire a request; ``callback(kind, payload)`` runs on the shared
+        callback executor when the response (or connection error) arrives."""
+        if self._closed.is_set():
+            _get_callback_executor().submit(
+                callback, ERROR, ConnectionLost(f"connection to {self.address} closed")
+            )
+            return
+        msg_id = next(self._ids)
+        with self._pending_lock:
+            self._pending[msg_id] = {"callback": callback}
+        try:
+            _send_frame(self._sock, (REQUEST, msg_id, method, payload), self._send_lock)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            _get_callback_executor().submit(callback, ERROR, ConnectionLost(str(e)))
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,157 @@
+"""Object serialization: cloudpickle protocol-5 with out-of-band buffers.
+
+Large contiguous payloads (numpy arrays, jax host arrays, arrow buffers) are
+captured as out-of-band PickleBuffers and laid out in a single aligned region
+so they can live directly in the shared-memory object store and be
+reconstructed as zero-copy views (reference: python/ray/_private/
+serialization.py:108,207 — same pickle5+buffers design, different container).
+
+Wire layout of a stored object:
+
+    [u32 magic][u32 flags][u64 meta_len][u32 nbuf]
+    [u64 buf_len, pad-to-64, buf bytes] * nbuf
+    [meta bytes]              # the pickle5 stream referencing buffers by index
+
+Buffers come first (64-byte aligned) so device DMA / numpy views get aligned
+pointers; the pickle stream trails.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Sequence, Tuple
+
+import cloudpickle
+
+MAGIC = 0x52545055  # "RTPU"
+FLAG_EXCEPTION = 1
+
+_HDR = struct.Struct("<IIQI")
+_BUF_HDR = struct.Struct("<Q")
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class SerializedObject:
+    __slots__ = ("meta", "buffers", "flags")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview], flags: int = 0):
+        self.meta = meta
+        self.buffers = buffers
+        self.flags = flags
+
+    def total_size(self) -> int:
+        size = _HDR.size
+        for b in self.buffers:
+            size = _align(size + _BUF_HDR.size) + b.nbytes
+        return size + len(self.meta)
+
+    def write_to(self, dest: memoryview) -> int:
+        """Write the full wire form into dest; returns bytes written."""
+        offset = _HDR.size
+        buf_count = len(self.buffers)
+        for b in self.buffers:
+            _BUF_HDR.pack_into(dest, offset, b.nbytes)
+            offset = _align(offset + _BUF_HDR.size)
+            dest[offset : offset + b.nbytes] = b
+            offset += b.nbytes
+        dest[offset : offset + len(self.meta)] = self.meta
+        total = offset + len(self.meta)
+        _HDR.pack_into(dest, 0, MAGIC, self.flags, len(self.meta), buf_count)
+        return total
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size())
+        n = self.write_to(memoryview(out))
+        return bytes(out[:n])
+
+
+def serialize(obj: Any, *, is_exception: bool = False) -> SerializedObject:
+    buffers: List[memoryview] = []
+
+    def callback(pb: pickle.PickleBuffer):
+        view = pb.raw()
+        if not view.contiguous:
+            return True  # serialize in-band
+        buffers.append(view)
+        return False
+
+    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
+    return SerializedObject(meta, buffers, FLAG_EXCEPTION if is_exception else 0)
+
+
+def serialize_and_collect_refs(obj: Any, *, is_exception: bool = False):
+    """Like ``serialize`` but also returns every ObjectID embedded in obj, so
+    the producing worker can promote its owned inline objects to plasma
+    before handing the value to another process."""
+    import io as _io
+
+    import cloudpickle as _cp
+
+    from ray_tpu._private.ids import ObjectID
+
+    buffers: List[memoryview] = []
+    refs = []
+
+    class _P(_cp.Pickler):
+        def reducer_override(self, o):
+            if isinstance(o, ObjectID):
+                refs.append(o)
+                return (type(o), (o.binary(),))
+            return NotImplemented
+
+    def callback(pb: pickle.PickleBuffer):
+        view = pb.raw()
+        if not view.contiguous:
+            return True
+        buffers.append(view)
+        return False
+
+    f = _io.BytesIO()
+    _P(f, protocol=5, buffer_callback=callback).dump(obj)
+    return SerializedObject(f.getvalue(), buffers, FLAG_EXCEPTION if is_exception else 0), refs
+
+
+def deserialize_from(view: memoryview) -> Any:
+    """Zero-copy deserialize from the wire form. The returned object may hold
+    views into ``view`` (e.g. numpy arrays over shared memory)."""
+    magic, flags, meta_len, nbuf = _HDR.unpack_from(view, 0)
+    if magic != MAGIC:
+        raise ValueError("corrupt object header")
+    offset = _HDR.size
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = _BUF_HDR.unpack_from(view, offset)
+        offset = _align(offset + _BUF_HDR.size)
+        buffers.append(view[offset : offset + blen])
+        offset += blen
+    meta = bytes(view[offset : offset + meta_len])
+    obj = pickle.loads(meta, buffers=buffers)
+    if flags & FLAG_EXCEPTION:
+        raise obj
+    return obj
+
+
+def deserialize_maybe_exception(view: memoryview) -> Tuple[Any, bool]:
+    magic, flags, meta_len, nbuf = _HDR.unpack_from(view, 0)
+    if flags & FLAG_EXCEPTION:
+        try:
+            deserialize_from(view)
+        except Exception as e:  # noqa: BLE001
+            return e, True
+    return deserialize_from(view), False
+
+
+def object_is_exception(view: memoryview) -> bool:
+    _, flags, _, _ = _HDR.unpack_from(view, 0)
+    return bool(flags & FLAG_EXCEPTION)
+
+
+def num_buffers(view: memoryview) -> int:
+    """Out-of-band buffer count; 0 means deserialization fully copies."""
+    _, _, _, nbuf = _HDR.unpack_from(view, 0)
+    return nbuf

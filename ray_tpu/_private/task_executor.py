@@ -1,0 +1,182 @@
+"""Worker-side task execution: the server half of the direct task transport.
+
+Handles push_task / create_actor on a worker's RPC server (reference:
+src/ray/core_worker/core_worker.cc:2553 ExecuteTask and the scheduling queues
+in transport/actor_scheduling_queue.cc — in-order per caller via sequence
+numbers; concurrency capped per actor by max_concurrency,
+transport/concurrency_group_manager.h:37).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.core_worker import CoreWorker, PLASMA_MARKER, TaskError
+from ray_tpu._private.ids import ActorID, ObjectID, WorkerID
+from ray_tpu._private.rpc import RpcServer, ServerConn
+
+logger = logging.getLogger(__name__)
+
+
+class _ActorState:
+    def __init__(self, instance: Any, max_concurrency: int):
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.sem = threading.Semaphore(max_concurrency)
+
+
+class TaskExecutor:
+    def __init__(self, core: CoreWorker, server: RpcServer):
+        self.core = core
+        self.server = server
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._actors_lock = threading.Lock()
+        server.register("push_task", self.rpc_push_task)
+        server.register("create_actor", self.rpc_create_actor)
+        server.register("kill_self", self.rpc_kill_self)
+        server.register("health", lambda conn, p: "ok")
+
+    # ------------------------------------------------------------------
+
+    def _deserialize_args(self, payload: bytes) -> Tuple[list, dict]:
+        import pickle
+
+        desc_args, desc_kwargs = pickle.loads(payload)
+        args = []
+        ref_ids = [d[1] for d in desc_args if d[0] == "ref"]
+        ref_ids += [d[1] for d in desc_kwargs.values() if d[0] == "ref"]
+        resolved: Dict[ObjectID, Any] = {}
+        if ref_ids:
+            values = self.core.get(ref_ids)
+            resolved = dict(zip(ref_ids, values))
+        for kind, v in desc_args:
+            args.append(resolved[v] if kind == "ref" else v)
+        kwargs = {
+            k: (resolved[v] if kind == "ref" else v) for k, (kind, v) in desc_kwargs.items()
+        }
+        return args, kwargs
+
+    def _package_results(
+        self, task_id, num_returns: int, value: Any, is_exception: bool
+    ) -> List[Tuple[ObjectID, str, Optional[bytes]]]:
+        if is_exception:
+            values = [value] * num_returns
+        elif num_returns == 1:
+            values = [value]
+        else:
+            values = list(value)
+            if len(values) != num_returns:
+                err = TaskError(
+                    ValueError(
+                        f"task declared num_returns={num_returns} but returned "
+                        f"{len(values)} values"
+                    )
+                )
+                return self._package_results(task_id, num_returns, err, True)
+        out = []
+        inline_max = GlobalConfig.object_store_inline_max_bytes
+        for i, v in enumerate(values):
+            oid = ObjectID.for_task_return(task_id, i + 1)
+            sobj, refs = serialization.serialize_and_collect_refs(
+                v, is_exception=is_exception
+            )
+            if refs:
+                # returned ObjectRefs: the caller will resolve them from
+                # plasma, so promote this worker's inline results first
+                try:
+                    self.core._resolve_deps([], refs)
+                except Exception:
+                    logger.exception("failed to promote returned refs")
+            if sobj.total_size() <= inline_max:
+                out.append((oid, "inline", sobj.to_bytes()))
+            else:
+                self.core.plasma.put_serialized(oid, sobj)
+                out.append((oid, "plasma", None))
+        return out
+
+    def _run(self, fn, args, kwargs, task_id, name: str):
+        token_tid = getattr(self.core._task_ctx, "task_id", None)
+        token_name = getattr(self.core._task_ctx, "task_name", None)
+        self.core._task_ctx.task_id = task_id
+        self.core._task_ctx.task_name = name
+        try:
+            return fn(*args, **kwargs), False
+        except Exception as e:  # noqa: BLE001
+            return TaskError(e, name, traceback.format_exc()), True
+        finally:
+            self.core._task_ctx.task_id = token_tid
+            self.core._task_ctx.task_name = token_name
+
+    # ------------------------------------------------------------------
+
+    def rpc_push_task(self, conn: ServerConn, spec: Dict[str, Any]) -> Dict[str, Any]:
+        if spec.get("actor_id") is not None and spec.get("method") is not None:
+            return self._execute_actor_task(spec)
+        return self._execute_normal_task(spec)
+
+    def _execute_normal_task(self, spec) -> Dict[str, Any]:
+        task_id = spec["task_id"]
+        self.core._emit_event(task_id, "RUNNING", spec["name"])
+        try:
+            fn = self.core.import_function(spec["fn_id"])
+            args, kwargs = self._deserialize_args(spec["args"])
+        except Exception as e:  # noqa: BLE001
+            value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
+        else:
+            value, is_exc = self._run(fn, args, kwargs, task_id, spec["name"])
+        results = self._package_results(task_id, spec["num_returns"], value, is_exc)
+        return {"status": "ok" if not is_exc else "error", "results": results}
+
+    def _execute_actor_task(self, spec) -> Dict[str, Any]:
+        # Per-caller ordering is guaranteed by the caller-side FIFO drain
+        # (core_worker._enqueue_actor_task); here we only bound concurrency.
+        task_id = spec["task_id"]
+        actor_id = spec["actor_id"]
+        with self._actors_lock:
+            state = self._actors.get(actor_id)
+        if state is None:
+            raise RuntimeError(f"actor {actor_id.hex()[:8]} not hosted on this worker")
+        if spec["method"] == "__ray_terminate__":
+            self.rpc_kill_self(None, None)
+            results = self._package_results(task_id, spec["num_returns"], None, False)
+            return {"status": "ok", "results": results}
+        with state.sem:
+            self.core._emit_event(task_id, "RUNNING", spec["name"])
+            try:
+                method = getattr(state.instance, spec["method"])
+                args, kwargs = self._deserialize_args(spec["args"])
+            except Exception as e:  # noqa: BLE001
+                value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
+            else:
+                value, is_exc = self._run(method, args, kwargs, task_id, spec["name"])
+        results = self._package_results(task_id, spec["num_returns"], value, is_exc)
+        return {"status": "ok" if not is_exc else "error", "results": results}
+
+    def rpc_create_actor(self, conn: ServerConn, payload) -> bool:
+        spec = payload["spec"]
+        actor_id = payload["actor_id"]
+        cls = self.core.import_function(spec["class_id"])
+        args, kwargs = self._deserialize_args(spec["args"])
+        options = spec["options"]
+        creation_task = spec.get("creation_task_id") or actor_id
+        instance = cls(*args, **kwargs)
+        max_concurrency = int(options.get("max_concurrency", 1) or 1)
+        with self._actors_lock:
+            self._actors[actor_id] = _ActorState(instance, max_concurrency)
+        logger.info("actor %s (%s) created", actor_id.hex()[:8], spec.get("class_name"))
+        return True
+
+    def rpc_kill_self(self, conn: ServerConn, payload) -> bool:
+        def _die():
+            time.sleep(0.05)
+            os._exit(0)
+
+        threading.Thread(target=_die, daemon=True).start()
+        return True

@@ -1,0 +1,123 @@
+"""Process-global driver/worker state and the init/connect lifecycle.
+
+(reference: python/ray/_private/worker.py:1123 init, connect:2025 — the
+module-level ``global_worker`` is the same pattern.)
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.ids import JobID, ObjectID
+from ray_tpu._private.node import Node
+
+logger = logging.getLogger(__name__)
+
+
+class Worker:
+    """Thin facade over CoreWorker plus session bookkeeping."""
+
+    def __init__(self, core: CoreWorker, session_dir: str, is_driver: bool, node: Optional[Node] = None):
+        self.core = core
+        self.session_dir = session_dir
+        self.is_driver = is_driver
+        self.node = node  # only for the head driver that started the cluster
+
+
+global_worker: Optional[Worker] = None
+_init_lock = threading.Lock()
+_job_counter = 0
+
+
+def is_initialized() -> bool:
+    return global_worker is not None
+
+
+def get_global_worker() -> Worker:
+    if global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first"
+        )
+    return global_worker
+
+
+def init(
+    *,
+    num_cpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    labels: Optional[Dict[str, str]] = None,
+    address: Optional[str] = None,
+    _system_config: Optional[Dict[str, Any]] = None,
+    log_level: str = "INFO",
+) -> Worker:
+    """Start (or connect to) a cluster and connect this process as a driver."""
+    global global_worker, _job_counter
+    with _init_lock:
+        if global_worker is not None:
+            return global_worker
+        logging.basicConfig(level=log_level)
+        GlobalConfig.initialize(_system_config)
+        if address is None:
+            node = Node(
+                head=True,
+                resources=resources,
+                num_cpus=num_cpus,
+                store_capacity=object_store_memory,
+                labels=labels,
+            )
+            gcs_address = node.gcs_address
+            raylet_address = node.raylet_address
+            session_dir = node.session_dir
+        else:
+            host, port = address.split(":")
+            gcs_address = (host, int(port))
+            node = None
+            # connect to an existing cluster: ask GCS for a local raylet
+            from ray_tpu._private.rpc import RpcClient
+
+            gcs = RpcClient(gcs_address)
+            nodes = gcs.call("get_nodes")
+            gcs.close()
+            if not nodes:
+                raise RuntimeError(f"no alive nodes in cluster at {address}")
+            raylet_address = tuple(nodes[0]["address"])
+            session_dir = os.path.join("/tmp", "raytpu_connected")
+            os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        _job_counter += 1
+        job_id = JobID.from_int(os.getpid() % 2**16 * 100 + _job_counter)
+        core = CoreWorker(
+            mode="driver",
+            job_id=job_id,
+            gcs_address=gcs_address,
+            raylet_address=raylet_address,
+            session_dir=session_dir,
+        )
+        core.gcs.call("add_job", {"job_id": job_id, "driver_pid": os.getpid()})
+        global_worker = Worker(core, session_dir, is_driver=True, node=node)
+        atexit.register(shutdown)
+        return global_worker
+
+
+def shutdown():
+    global global_worker
+    with _init_lock:
+        if global_worker is None:
+            return
+        worker = global_worker
+        global_worker = None
+        try:
+            worker.core.shutdown()
+        except Exception:
+            pass
+        if worker.node is not None:
+            try:
+                worker.node.stop()
+            except Exception:
+                pass

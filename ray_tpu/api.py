@@ -1,0 +1,256 @@
+"""Public task/actor/object API.
+
+(reference: python/ray/remote_function.py:245 RemoteFunction._remote,
+python/ray/actor.py:664 ActorClass._remote, _private/worker.py get/put/wait.)
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.ids import ObjectRef  # re-export
+from ray_tpu._private.core_worker import (  # re-export error types
+    ActorDiedError,
+    GetTimeoutError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+_VALID_OPTIONS = {
+    "num_cpus",
+    "num_tpus",
+    "num_returns",
+    "resources",
+    "max_retries",
+    "max_restarts",
+    "max_concurrency",
+    "name",
+    "lifetime",
+    "scheduling_strategy",
+    "runtime_env",
+    "placement_group",
+    "placement_group_bundle_index",
+}
+
+
+def _resources_from_options(options: Dict[str, Any], default_cpu: float) -> Dict[str, float]:
+    res = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    res["CPU"] = float(num_cpus) if num_cpus is not None else default_cpu
+    if options.get("num_tpus"):
+        res["TPU"] = float(options["num_tpus"])
+    strategy = options.get("scheduling_strategy")
+    if strategy is not None:
+        extra = getattr(strategy, "required_resources", None)
+        if extra:
+            res.update(extra)
+    pg = options.get("placement_group")
+    if pg is not None:
+        index = options.get("placement_group_bundle_index", -1)
+        res.update(pg.bundle_resources(index))
+    return res
+
+
+def _check_options(options: Dict[str, Any]):
+    unknown = set(options) - _VALID_OPTIONS
+    if unknown:
+        raise ValueError(f"unknown options: {sorted(unknown)}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = options or {}
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        _check_options(opts)
+        merged = dict(self._options)
+        merged.update(opts)
+        return RemoteFunction(self._fn, merged)
+
+    def remote(self, *args, **kwargs):
+        core = worker_mod.get_global_worker().core
+        num_returns = self._options.get("num_returns", 1)
+        refs = core.submit_task(
+            self._fn,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            resources=_resources_from_options(self._options, default_cpu=1.0),
+            max_retries=self._options.get("max_retries"),
+            name=self._options.get("name") or self._fn.__name__,
+        )
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._fn.__name__} cannot be called directly; "
+            f"use .remote()"
+        )
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        core = worker_mod.get_global_worker().core
+        refs = core.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            ordered=self._handle._max_concurrency == 1,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(
+        self,
+        actor_id: ActorID,
+        method_names: Sequence[str],
+        class_name: str = "",
+        max_concurrency: int = 1,
+    ):
+        self._actor_id = actor_id
+        self._method_names = tuple(method_names)
+        self._class_name = class_name
+        self._max_concurrency = max_concurrency
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no method {name!r}"
+            )
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._method_names, self._class_name, self._max_concurrency),
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = options or {}
+
+    def options(self, **opts) -> "ActorClass":
+        _check_options(opts)
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        core = worker_mod.get_global_worker().core
+        options = {
+            "max_restarts": self._options.get("max_restarts", 0),
+            "max_concurrency": self._options.get("max_concurrency", 1),
+            "name": self._options.get("name"),
+            "lifetime": self._options.get("lifetime"),
+            "resources_spec": _resources_from_options(self._options, default_cpu=1.0),
+        }
+        actor_id = core.create_actor(self._cls, args, kwargs, options)
+        return ActorHandle(
+            actor_id,
+            self._method_names(),
+            self._cls.__name__,
+            max_concurrency=options["max_concurrency"],
+        )
+
+    def _method_names(self) -> List[str]:
+        return [
+            name
+            for name, m in inspect.getmembers(self._cls, predicate=callable)
+            if not name.startswith("_")
+        ] + ["__ray_terminate__"]
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use .remote()"
+        )
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes."""
+    if len(args) == 1 and callable(args[0]) and not options:
+        target = args[0]
+        if inspect.isclass(target):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    _check_options(options)
+
+    def wrapper(target):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    return wrapper
+
+
+def get(
+    refs: Union[ObjectID, Sequence[ObjectID]], *, timeout: Optional[float] = None
+) -> Any:
+    core = worker_mod.get_global_worker().core
+    if isinstance(refs, ObjectID):
+        return core.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    return core.get(list(refs), timeout=timeout)
+
+
+def put(value: Any) -> ObjectID:
+    return worker_mod.get_global_worker().core.put(value)
+
+
+def wait(
+    refs: Sequence[ObjectID],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectID):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    core = worker_mod.get_global_worker().core
+    return core.wait(list(refs), num_returns, timeout, fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    worker_mod.get_global_worker().core.kill_actor(actor._actor_id, no_restart)
+
+
+def get_actor(name: str) -> ActorHandle:
+    core = worker_mod.get_global_worker().core
+    view = core.gcs.call("get_actor_by_name", name)
+    if view is None:
+        raise ValueError(f"no actor named {name!r}")
+    # method names unknown from the view; allow any attribute
+    return _AnyMethodActorHandle(view["actor_id"], (), view.get("class_name", ""))
+
+
+class _AnyMethodActorHandle(ActorHandle):
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)

@@ -1,0 +1,38 @@
+"""Test fixtures.
+
+JAX runs on a virtual 8-device CPU mesh (the TPU chip stays untouched so
+multi-chip sharding logic is testable anywhere); the runtime fixtures mirror
+the reference's ray_start_regular / ray_start_cluster conftest fixtures
+(reference: python/ray/tests/conftest.py:359,440).
+"""
+
+import os
+
+# Must happen before jax (or anything importing jax) loads.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU plugin registration
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    worker = ray_tpu.init(num_cpus=4, log_level="WARNING")
+    yield worker
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_small_store():
+    import ray_tpu
+
+    worker = ray_tpu.init(
+        num_cpus=2, object_store_memory=64 * 1024 * 1024, log_level="WARNING"
+    )
+    yield worker
+    ray_tpu.shutdown()

@@ -1,0 +1,71 @@
+"""Serialization + ID unit tests (no cluster needed)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def roundtrip(obj):
+    data = serialization.serialize(obj).to_bytes()
+    return serialization.deserialize_from(memoryview(data))
+
+
+def test_scalar_roundtrip():
+    for v in [1, 1.5, "s", b"b", None, True, [1, 2], {"k": (1, 2)}]:
+        assert roundtrip(v) == v
+
+
+def test_numpy_out_of_band():
+    arr = np.random.rand(1000, 10)
+    sobj = serialization.serialize(arr)
+    assert len(sobj.buffers) >= 1  # array payload is out-of-band
+    out = roundtrip(arr)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_zero_copy_view():
+    arr = np.arange(1024, dtype=np.int64)
+    data = serialization.serialize(arr).to_bytes()
+    view = memoryview(bytearray(data))
+    out = serialization.deserialize_from(view)
+    # mutating the backing buffer is visible through the array: it's a view
+    assert out.base is not None
+
+
+def test_exception_flag():
+    sobj = serialization.serialize(ValueError("x"), is_exception=True)
+    data = sobj.to_bytes()
+    with pytest.raises(ValueError):
+        serialization.deserialize_from(memoryview(data))
+
+
+def test_id_hierarchy():
+    job = JobID.from_int(7)
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_actor_creation_task(actor)
+    assert task.actor_id() == actor
+    parent = TaskID.for_driver_task(job)
+    t = TaskID.for_normal_task(job, parent, 1)
+    oid = ObjectID.for_task_return(t, 1)
+    assert oid.task_id() == t
+    assert oid.return_index() == 1
+    assert not oid.is_put()
+    put = ObjectID.from_put(t, 3)
+    assert put.is_put()
+
+
+def test_task_id_deterministic():
+    job = JobID.from_int(1)
+    parent = TaskID.for_driver_task(job)
+    assert TaskID.for_normal_task(job, parent, 5) == TaskID.for_normal_task(job, parent, 5)
+    assert TaskID.for_normal_task(job, parent, 5) != TaskID.for_normal_task(job, parent, 6)
+
+
+def test_id_pickle_roundtrip():
+    import pickle
+
+    oid = ObjectID.from_random()
+    assert pickle.loads(pickle.dumps(oid)) == oid
