@@ -1,0 +1,82 @@
+"""Single-chip training benchmark: GPT tokens/sec and MFU on the real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline normalizes achieved MFU against the 40% north-star from
+BASELINE.json (reference's GPT-J fine-tune target: ≥40% MFU on TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# v5e bf16 peak (TFLOP/s per chip); fall back for cpu smoke runs.
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12}
+TARGET_MFU = 0.40
+
+
+def main():
+    from ray_tpu.models.gpt import gpt_125m, gpt_nano, train_step_flops
+    from ray_tpu.models.training import (
+        default_optimizer,
+        init_sharded_state,
+        make_train_step,
+    )
+    from ray_tpu.parallel.mesh import MeshSpec
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    if on_tpu:
+        cfg = gpt_125m(dtype=jnp.bfloat16)
+        batch, seq = 16, 2048
+        iters = 30
+    else:
+        cfg = gpt_nano()
+        batch, seq = 4, 128
+        iters = 3
+
+    mesh = MeshSpec().build(jax.devices()[:1])
+    opt = default_optimizer(learning_rate=1e-4)
+    state, shardings = init_sharded_state(
+        cfg, mesh, opt, jax.random.PRNGKey(0), (batch, seq)
+    )
+    step = make_train_step(cfg, opt, mesh, state_shardings_tree=shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+
+    import numpy as np
+
+    with mesh:
+        state, m = step(state, tokens)  # compile + warmup
+        float(np.asarray(m["loss"]))  # device_get is the only reliable barrier
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, tokens)
+        # the final loss depends on every preceding step, so fetching it
+        # synchronizes the whole chain (block_until_ready is not a reliable
+        # barrier on tunneled backends)
+        final_loss = float(np.asarray(m["loss"]))
+        dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * iters / dt
+    flops = train_step_flops(cfg, batch, seq) * iters / dt
+    mfu = flops / PEAK_FLOPS.get(platform, 197e12)
+    print(
+        json.dumps(
+            {
+                "metric": "gpt125m_train_tokens_per_sec_chip",
+                "value": round(tokens_per_s, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / TARGET_MFU, 4),
+                "mfu": round(mfu, 4),
+                "platform": platform,
+                "loss": round(final_loss, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,290 @@
+"""Decoder-only transformer family (the framework's flagship model).
+
+Fills the slot the reference fills with external torch models (GPT-J-6B
+DeepSpeed fine-tune, reference: doc/source/ray-air/examples/
+gptj_deepspeed_fine_tuning.ipynb; release/train_tests) — but TPU-first:
+
+- flax.linen modules whose every parameter carries *logical* axis names
+  (see ray_tpu.parallel.sharding), so one model definition runs DP, FSDP,
+  TP, SP and any mix by switching rule tables;
+- bfloat16 activations/compute, float32 params & optimizer state;
+- `nn.scan` over layers (one XLA While loop, compiles O(1) in depth) with
+  `nn.remat` so long-context activations are rematerialized;
+- fused attention from ray_tpu.ops (Pallas flash kernel on TPU).
+
+`gpt_j_6b()` matches the reference benchmark model's shape (28 layers,
+d_model 4096, 16 heads × 256, rotary_dim 64, vocab 50400, parallel
+residual); `gpt_nano`/`gpt_125m` are for tests and single-chip benches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50400
+    num_layers: int = 28
+    num_heads: int = 16
+    head_dim: int = 256
+    embed_dim: int = 4096
+    mlp_dim: int = 16384
+    max_seq_len: int = 2048
+    rotary_dim: int = 64
+    dtype: Any = jnp.bfloat16          # activation/compute dtype
+    param_dtype: Any = jnp.float32     # master parameter dtype
+    parallel_residual: bool = True     # GPT-J style single-LN parallel block
+    tie_embeddings: bool = False
+    remat: bool = True
+    scan_layers: bool = True
+    attn_use_pallas: Optional[bool] = None  # None → auto (TPU only)
+
+    @property
+    def qkv_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    def num_params(self) -> int:
+        """Exact parameter count (for MFU math)."""
+        d, h, hd, f, v = (
+            self.embed_dim,
+            self.num_heads,
+            self.head_dim,
+            self.mlp_dim,
+            self.vocab_size,
+        )
+        per_layer = (
+            4 * d * h * hd          # q,k,v,o
+            + 2 * d * f + f + d     # mlp + biases
+            + (2 * d if self.parallel_residual else 4 * d)  # ln scale+bias
+        )
+        head = 0 if self.tie_embeddings else d * v + v
+        return v * d + self.num_layers * per_layer + 2 * d + head
+
+
+def gpt_nano(**kw) -> GPTConfig:
+    return GPTConfig(
+        vocab_size=256, num_layers=2, num_heads=4, head_dim=16, embed_dim=64,
+        mlp_dim=256, max_seq_len=128, rotary_dim=16, dtype=jnp.float32, **kw
+    )
+
+
+def gpt_125m(**kw) -> GPTConfig:
+    return GPTConfig(
+        vocab_size=50304, num_layers=12, num_heads=12, head_dim=64,
+        embed_dim=768, mlp_dim=3072, max_seq_len=2048, rotary_dim=32, **kw
+    )
+
+
+def gpt_1b(**kw) -> GPTConfig:
+    return GPTConfig(
+        vocab_size=50304, num_layers=16, num_heads=16, head_dim=128,
+        embed_dim=2048, mlp_dim=8192, max_seq_len=2048, rotary_dim=64, **kw
+    )
+
+
+def gpt_j_6b(**kw) -> GPTConfig:
+    return GPTConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# rotary position embedding
+# ---------------------------------------------------------------------------
+
+
+def _rotary(x: jax.Array, positions: jax.Array, rotary_dim: int) -> jax.Array:
+    """Apply RoPE to the first ``rotary_dim`` features of [b, t, h, d]."""
+    if rotary_dim <= 0:
+        return x
+    rot, keep = x[..., :rotary_dim], x[..., rotary_dim:]
+    half = rotary_dim // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [b, t, half]
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    r1, r2 = rot[..., :half], rot[..., half:]
+    rotated = jnp.concatenate([r1 * cos - r2 * sin, r2 * cos + r1 * sin], axis=-1)
+    return jnp.concatenate([rotated, keep], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# modules
+# ---------------------------------------------------------------------------
+
+
+def _dense(features: Tuple[int, ...], logical_axes: Tuple[str, ...], cfg: GPTConfig,
+           name: str, use_bias: bool = True):
+    return nn.DenseGeneral(
+        features=features,
+        axis=-1 if len(logical_axes) - len(features) == 1 else (-2, -1),
+        use_bias=use_bias,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), logical_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), logical_axes[len(logical_axes) - len(features):]
+        ),
+        name=name,
+    )
+
+
+class Attention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        h, hd = cfg.num_heads, cfg.head_dim
+        q = _dense((h, hd), ("embed", "heads", "kv"), cfg, "q", use_bias=False)(x)
+        k = _dense((h, hd), ("embed", "heads", "kv"), cfg, "k", use_bias=False)(x)
+        v = _dense((h, hd), ("embed", "heads", "kv"), cfg, "v", use_bias=False)(x)
+        q = _rotary(q, positions, cfg.rotary_dim)
+        k = _rotary(k, positions, cfg.rotary_dim)
+        # [b, t, h, d] → [b, h, t, d] for the fused kernel
+        out = dot_product_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=True,
+            use_pallas=cfg.attn_use_pallas,
+        ).transpose(0, 2, 1, 3)
+        return _dense((cfg.embed_dim,), ("heads", "kv", "embed"), cfg, "o", use_bias=False)(
+            out
+        )
+
+
+class Mlp(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        x = _dense((cfg.mlp_dim,), ("embed", "mlp"), cfg, "wi")(x)
+        x = nn.gelu(x)
+        return _dense((cfg.embed_dim,), ("mlp", "embed"), cfg, "wo")(x)
+
+
+def _layer_norm(cfg: GPTConfig, name: str):
+    return nn.LayerNorm(
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+        bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), ("embed",)),
+        name=name,
+    )
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
+        if cfg.parallel_residual:
+            hidden = _layer_norm(cfg, "ln")(x)
+            x = x + Attention(cfg, name="attn")(hidden, positions) + Mlp(
+                cfg, name="mlp"
+            )(hidden)
+        else:
+            x = x + Attention(cfg, name="attn")(_layer_norm(cfg, "ln1")(x), positions)
+            x = x + Mlp(cfg, name="mlp")(_layer_norm(cfg, "ln2")(x))
+        return nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
+
+
+class ScannedBlocks(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=not cfg.scan_layers)
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, positions), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"layer_{i}")(x, positions)
+        return x
+
+
+class GPT(nn.Module):
+    """Returns logits [batch, seq, vocab]."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+            )
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.embed_dim,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("vocab", "embed")
+            ),
+            name="wte",
+        )
+        x = embed(tokens)
+        x = ScannedBlocks(cfg, name="blocks")(x, positions)
+        x = _layer_norm(cfg, "ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(cfg.param_dtype))
+        else:
+            logits = _dense((cfg.vocab_size,), ("embed", "vocab"), cfg, "lm_head")(x)
+        return nn.with_logical_constraint(
+            logits.astype(jnp.float32), ("batch", "seq", "act_vocab")
+        )
+
+
+# ---------------------------------------------------------------------------
+# loss / flops helpers
+# ---------------------------------------------------------------------------
+
+
+def next_token_loss(logits: jax.Array, tokens: jax.Array,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean cross-entropy of predicting tokens[t+1] from position t."""
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+        return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return nll.mean()
+
+
+def train_step_flops(cfg: GPTConfig, batch: int, seq: int) -> float:
+    """Approximate FLOPs of one fwd+bwd step (6·matmul_params·tokens +
+    attention). The input embedding is a gather, not a matmul, so it is
+    excluded; a tied lm_head *is* a matmul, so the table counts once then."""
+    tokens = batch * seq
+    matmul_params = cfg.num_params() - cfg.vocab_size * cfg.embed_dim
+    if cfg.tie_embeddings:
+        matmul_params += cfg.vocab_size * cfg.embed_dim
+    matmul = 6.0 * matmul_params * tokens
+    attn = 12.0 * cfg.num_layers * batch * cfg.num_heads * seq * seq * cfg.head_dim
+    return matmul + attn

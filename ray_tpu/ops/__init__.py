@@ -1,0 +1,5 @@
+"""Fused TPU ops (Pallas kernels with XLA fallbacks)."""
+
+from ray_tpu.ops.attention import dot_product_attention
+
+__all__ = ["dot_product_attention"]

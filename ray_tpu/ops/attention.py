@@ -1,0 +1,268 @@
+"""Fused causal attention for TPU.
+
+A blocked flash-attention (online-softmax) Pallas kernel for the MXU, with a
+pure-XLA fallback for CPU tests and odd shapes. The reference framework has
+no attention kernels at all — its only attention is RLlib's GTrXL model code
+(reference: rllib/models/torch/attention_net.py:37), and long-context work is
+delegated to external libraries (SURVEY.md §5); here fused attention is a
+first-class op that the ring/context-parallel layer composes with.
+
+Layout: [batch, heads, seq, head_dim]. The kernel runs a grid of
+(batch*heads, q_blocks, kv_blocks) with the kv dimension innermost (sequential
+on TPU), keeping the running max/denominator and the output accumulator in
+VMEM scratch across kv steps.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path (CPU tests, fallback, and the vjp reference)
+# ---------------------------------------------------------------------------
+
+
+def _attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    *_, t_q, d = q.shape
+    t_kv = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    mask = None
+    if causal:
+        q_pos = jnp.arange(t_q)[:, None] + (t_kv - t_q)
+        k_pos = jnp.arange(t_kv)[None, :]
+        mask = q_pos >= k_pos
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = seg if mask is None else (mask[None, None] & seg)
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel (forward)
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, causal, scale, block_q, block_k, q_len, kv_len
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if causal:
+            # q row i attends to kv positions <= i + (kv_len - q_len), i.e.
+            # a shorter q block is the *suffix* of the context (chunked
+            # prefill) — matches the XLA fallback's offset mask.
+            q_pos = (
+                qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                + (kv_len - q_len)
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len % block_k != 0:
+            # mask padded kv columns in the ragged last block; v must be
+            # zeroed too (p is 0 there, but 0 * uninitialized = NaN)
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
+            kv_valid = (
+                ki * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+            ) < kv_len
+            v = jnp.where(kv_valid, v, 0.0)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:, 0] = m_new
+        l_ref[:, 0] = l_new
+
+    if causal:
+        # Skip fully-masked kv blocks (the whole block is above the diagonal).
+        first_masked = (qi * block_q + block_q - 1 + (kv_len - q_len)) < ki * block_k
+
+        @pl.when(jnp.logical_not(first_masked))
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        denom = jnp.where(l_ref[:, 0] == 0.0, 1.0, l_ref[:, 0])
+        o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_attention_tpu(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    scale: float,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[-2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    bh = b * h
+    qr = q.reshape(bh, t_q, d)
+    kr = k.reshape(bh, t_kv, d)
+    vr = v.reshape(bh, t_kv, d)
+    grid = (bh, pl.cdiv(t_q, block_q), pl.cdiv(t_kv, block_k))
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        causal=causal,
+        scale=scale,
+        block_q=block_q,
+        block_k=block_k,
+        q_len=t_q,
+        kv_len=t_kv,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t_q, d)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "use_pallas", "block_q", "block_k")
+)
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    use_pallas: Optional[bool] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jax.Array:
+    """Fused attention over [batch, heads, seq, head_dim] inputs.
+
+    Differentiable everywhere: the Pallas path is forward-only, so under
+    grad we use the XLA path (XLA's own flash-style fusion handles the
+    backward pass well on TPU; a custom_vjp pallas backward is future work).
+    """
+    scale_val = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
+    use = use_pallas if use_pallas is not None else _on_tpu()
+    d = q.shape[-1]
+    if (
+        use
+        and segment_ids is None
+        and d % 128 == 0
+        and q.shape[-2] % 8 == 0
+        and k.shape[-2] % 8 == 0
+    ):
+        return _flash_attention_with_xla_grad(
+            q, k, v, causal=causal, scale=scale_val, block_q=block_q, block_k=block_k
+        )
+    return _attention_xla(q, k, v, causal=causal, scale=scale_val, segment_ids=segment_ids)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_with_xla_grad(q, k, v, causal, scale, block_q, block_k):
+    return _flash_attention_tpu(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+    )
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _flash_attention_tpu(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+    )
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    # Backward through the XLA reference implementation (numerically matches
+    # the kernel; XLA fuses this into a memory-efficient backward on TPU).
+    _, vjp = jax.vjp(
+        lambda q, k, v: _attention_xla(q, k, v, causal=causal, scale=scale), q, k, v
+    )
+    return vjp(g)
+
+
+_flash_attention_with_xla_grad.defvjp(_flash_fwd, _flash_bwd)

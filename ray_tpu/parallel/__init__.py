@@ -1,0 +1,33 @@
+"""Parallelism: meshes, sharding rules, collectives, long-context."""
+
+from ray_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    MeshSpec,
+    data_axes,
+    make_mesh,
+    mesh_summary,
+    single_device_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    batch_sharding,
+    logical_to_spec,
+    params_shardings,
+    replicated,
+    tree_shardings,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshSpec",
+    "data_axes",
+    "make_mesh",
+    "mesh_summary",
+    "single_device_mesh",
+    "DEFAULT_RULES",
+    "batch_sharding",
+    "logical_to_spec",
+    "params_shardings",
+    "replicated",
+    "tree_shardings",
+]

@@ -4,16 +4,28 @@ JAX runs on a virtual 8-device CPU mesh (the TPU chip stays untouched so
 multi-chip sharding logic is testable anywhere); the runtime fixtures mirror
 the reference's ray_start_regular / ray_start_cluster conftest fixtures
 (reference: python/ray/tests/conftest.py:359,440).
+
+The axon TPU plugin registers itself from sitecustomize before any user code
+runs, so env-var guards alone are too late for *this* process — the platform
+must be forced back to CPU through jax.config (safe because no computation
+has run yet at conftest import time). For worker subprocesses the env-var
+route works: popping PALLAS_AXON_POOL_IPS here means children's
+sitecustomize never registers the axon plugin, and the inherited
+JAX_PLATFORMS/XLA_FLAGS then give them the same virtual 8-device CPU mesh.
 """
 
 import os
 
-# Must happen before jax (or anything importing jax) loads.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # disable TPU plugin registration
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest
 
