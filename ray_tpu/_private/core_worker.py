@@ -168,6 +168,15 @@ class CoreWorker:
         # pending normal tasks owned by this worker
         self._pending: Dict[TaskID, Dict[str, Any]] = {}
         self._pending_lock = threading.Lock()
+        # owner-based object directory: object -> raylet address of a node
+        # whose plasma store holds it (reference:
+        # object_manager/ownership_based_object_directory.cc — locations come
+        # from owners/producers, not from a central service)
+        self._locations: Dict[bytes, Tuple[str, int]] = {}
+        self._locations_lock = threading.Lock()
+        self._pulls_inflight: set = set()
+        # raylet clients for spillback leasing on other nodes
+        self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         # local reference counting: when the last local ObjectRef instance
         # handed out by this worker is GC'd, the owned object is freed
         # (a single-process slice of the reference's distributed
@@ -228,7 +237,57 @@ class CoreWorker:
         sobj = serialization.serialize(value)
         self.plasma.put_serialized(object_id, sobj)
         self._register_ref(object_id)
+        self.register_locations({object_id.binary(): self.raylet.address})
         return object_id
+
+    # -- object directory ------------------------------------------------
+
+    def register_locations(self, locations: Dict[bytes, Tuple[str, int]]):
+        if not locations:
+            return
+        with self._locations_lock:
+            for binary, addr in locations.items():
+                self._locations[binary] = tuple(addr)
+
+    def _location_of(self, oid: ObjectID) -> Optional[Tuple[str, int]]:
+        with self._locations_lock:
+            return self._locations.get(oid.binary())
+
+    def _pull_if_remote(self, oid: ObjectID, timeout: Optional[float] = None) -> None:
+        """Ensure a remotely-located object is present in the local store.
+        Deduplicates concurrent pulls of the same object."""
+        if self.plasma is None or self.plasma.contains(oid):
+            return
+        loc = self._location_of(oid)
+        if loc is None or loc == tuple(self.raylet.address):
+            return
+        binary = oid.binary()
+        with self._locations_lock:
+            if binary in self._pulls_inflight:
+                return  # another caller is pulling; plasma get provides the wait
+            self._pulls_inflight.add(binary)
+        try:
+            self.raylet.call("store_pull", (oid, loc), timeout=timeout or 120.0)
+        except Exception:
+            logger.warning("pull of %s from %s failed", oid.hex()[:12], loc)
+        finally:
+            with self._locations_lock:
+                self._pulls_inflight.discard(binary)
+
+    def _start_pulls(self, object_ids: Sequence[ObjectID], timeout: Optional[float]):
+        """Kick off background pulls for known-remote objects; the blocking
+        plasma get (which waits on the local seal) provides completion."""
+        own = tuple(self.raylet.address)
+        for oid in object_ids:
+            loc = self._location_of(oid)
+            if loc is None or loc == own:
+                continue
+            with self._locations_lock:
+                if oid.binary() in self._pulls_inflight:
+                    continue
+            threading.Thread(
+                target=self._pull_if_remote, args=(oid, timeout), daemon=True
+            ).start()
 
     def _register_ref(self, ref: ObjectID):
         import weakref
@@ -292,6 +351,7 @@ class CoreWorker:
                 results[oid] = self._deserialize(memoryview(data))
         if plasma_ids:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            self._start_pulls(plasma_ids, remaining)
             views = self.plasma.get_views(plasma_ids, timeout=remaining)
             if views is None:
                 raise GetTimeoutError(
@@ -356,6 +416,9 @@ class CoreWorker:
         fetch_local: bool = True,
     ) -> Tuple[List[ObjectID], List[ObjectID]]:
         deadline = None if timeout is None else time.monotonic() + timeout
+        if fetch_local:
+            # kick off pulls for known-remote objects so wait() makes progress
+            self._start_pulls(object_ids, timeout)
         while True:
             ready = [o for o in object_ids if self.ready(o)]
             if len(ready) >= num_returns:
@@ -429,8 +492,26 @@ class CoreWorker:
                 data = self.memory_store.get(oid, timeout=None)
             if data is not None and data != PLASMA_MARKER:
                 self._promote_to_plasma(oid)
+                self.register_locations({oid.binary(): self.raylet.address})
             # refs in plasma (markers, puts, other owners): the executing
             # worker's blocking plasma get provides the wait.
+
+    def _dep_locations(
+        self, deps: List[ObjectID], nested: List[ObjectID]
+    ) -> Dict[bytes, Tuple[str, int]]:
+        """Location hints shipped with the task spec so a worker on another
+        node can pull the arguments (the reference resolves these through the
+        owner's object directory; here the hints ride the spec)."""
+        locs: Dict[bytes, Tuple[str, int]] = {}
+        own = tuple(self.raylet.address)
+        for oid in list(deps) + list(nested):
+            binary = oid.binary()
+            known = self._location_of(oid)
+            if known is not None:
+                locs[binary] = known
+            elif self.plasma is not None and self.plasma.contains(oid):
+                locs[binary] = own
+        return locs
 
     # ------------------------------------------------------------------
     # normal task submission
@@ -504,22 +585,36 @@ class CoreWorker:
         executor, so in-flight task count is bounded by leases, not by the
         submitter pool size."""
         self._resolve_deps(spec["deps"], spec["nested"])
+        spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
+        lease_raylet = self.raylet
+        hops = 0
         while not self._shutdown.is_set():
-            lease = self.raylet.call(
+            lease = lease_raylet.call(
                 "request_worker_lease",
-                {"resources": spec["resources"], "job_id": spec["job_id"]},
+                {
+                    "resources": spec["resources"],
+                    "job_id": spec["job_id"],
+                    # a redirected request must not bounce again (avoids
+                    # spillback ping-pong between two saturated nodes)
+                    "allow_spill": hops == 0,
+                },
                 timeout=GlobalConfig.worker_lease_timeout_s * 2,
             )
             if lease is None:
+                lease_raylet, hops = self.raylet, 0  # restart from our node
+                continue
+            if "retry_at" in lease:
+                lease_raylet = self._get_raylet_client(tuple(lease["retry_at"]))
+                hops += 1
                 continue
             try:
                 client = self._get_worker_client(tuple(lease["address"]))
             except (ConnectionLost, OSError):
-                self._return_lease(lease)
+                self._return_lease(lease, lease_raylet)
                 continue
 
-            def on_done(kind, payload, spec=spec, lease=lease):
-                self._return_lease(lease)
+            def on_done(kind, payload, spec=spec, lease=lease, lease_raylet=lease_raylet):
+                self._return_lease(lease, lease_raylet)
                 if kind == rpc_mod.RESPONSE:
                     self._handle_reply(spec, payload)
                 elif isinstance(payload, (ConnectionLost, OSError)):
@@ -545,11 +640,24 @@ class CoreWorker:
             client.call_async("push_task", spec, on_done)
             return
 
-    def _return_lease(self, lease):
+    def _return_lease(self, lease, lease_raylet=None):
         try:
-            self.raylet.call("return_worker", {"worker_id": lease["worker_id"]})
+            (lease_raylet or self.raylet).call(
+                "return_worker", {"worker_id": lease["worker_id"]}
+            )
         except Exception:
             pass
+
+    def _get_raylet_client(self, addr: Tuple[str, int]) -> RpcClient:
+        if tuple(addr) == tuple(self.raylet.address):
+            return self.raylet
+        with self._worker_clients_lock:
+            client = self._raylet_clients.get(tuple(addr))
+            if client is not None and not client.closed:
+                return client
+            client = RpcClient(tuple(addr))
+            self._raylet_clients[tuple(addr)] = client
+            return client
 
     def _get_worker_client(self, addr: Tuple[str, int]) -> RpcClient:
         with self._worker_clients_lock:
@@ -564,6 +672,8 @@ class CoreWorker:
         task_id = spec["task_id"]
         if reply["status"] == "retry":  # application asked for retry (unused yet)
             raise RayTpuError("unexpected retry status")
+        producer_node = reply.get("node")
+        self.register_locations(reply.get("ref_locations") or {})
         for oid, kind, data in reply["results"]:
             with self._local_refs_lock:
                 wanted = oid.binary() in self._local_refs
@@ -572,6 +682,8 @@ class CoreWorker:
             if kind == "inline":
                 self.memory_store.put(oid, data)
             else:
+                if producer_node is not None:
+                    self.register_locations({oid.binary(): tuple(producer_node)})
                 self.memory_store.put(oid, PLASMA_MARKER)
         with self._pending_lock:
             self._pending.pop(task_id, None)
@@ -611,6 +723,7 @@ class CoreWorker:
             "class_name": getattr(cls, "__name__", "Actor"),
             "args": payload,
             "deps": deps,
+            "locations": self._dep_locations(deps, nested),
             "options": options,
         }
         self.gcs.call("register_actor", (actor_id, spec))
@@ -715,6 +828,7 @@ class CoreWorker:
         """Resolve the actor address (blocking, submitter thread) and push
         asynchronously; completion runs on the callback executor."""
         self._resolve_deps(spec["deps"], spec["nested"])
+        spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
         actor_id = spec["actor_id"]
         attempts = 0
         while not self._shutdown.is_set():
@@ -819,6 +933,8 @@ class CoreWorker:
             self._submit_queue.put(None)
         with self._worker_clients_lock:
             for c in self._worker_clients.values():
+                c.close()
+            for c in self._raylet_clients.values():
                 c.close()
         if self.plasma is not None:
             self.plasma.close()

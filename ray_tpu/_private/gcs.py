@@ -173,11 +173,24 @@ class GcsServer:
         node_id, available = payload
         with self._lock:
             info = self._nodes.get(node_id)
-            if info is None:
+            if info is None or not info.alive:
+                # a dead/drained node stays dead: an in-flight heartbeat must
+                # not resurrect it (it re-registers if it really came back)
                 return False
             info.last_heartbeat = time.monotonic()
             info.available_resources = available
-            info.alive = True
+        return True
+
+    def rpc_unregister_node(self, conn, payload):
+        """Graceful node drain: mark dead immediately (no health-check wait)."""
+        node_id = payload
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info.alive:
+                return False
+            info.alive = False
+        self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
+        self._handle_node_death(node_id)
         return True
 
     def rpc_get_nodes(self, conn, payload=None):
@@ -326,10 +339,17 @@ class GcsServer:
                 client = self._raylet_client(node)
                 lease = client.call(
                     "request_worker_lease",
-                    {"resources": resources, "actor_id": info.actor_id, "job_id": spec["job_id"]},
+                    {
+                        "resources": resources,
+                        "actor_id": info.actor_id,
+                        "job_id": spec["job_id"],
+                        # the GCS picks the node itself; a raylet-side
+                        # spillback redirect would only confuse this loop
+                        "allow_spill": False,
+                    },
                     timeout=GlobalConfig.worker_lease_timeout_s,
                 )
-                if lease is None:
+                if lease is None or "retry_at" in lease:
                     time.sleep(0.05)
                     continue
                 worker_addr = tuple(lease["address"])
@@ -392,6 +412,13 @@ class GcsServer:
             payload.get("cause", "worker died"),
         )
         for actor_id in actor_ids:
+            with self._lock:
+                info = self._actors.get(actor_id)
+                # a stale report (e.g. node drain already restarted the actor
+                # elsewhere, or a restart is in flight) must not burn another
+                # restart
+                if info is None or info.state != ALIVE or info.worker_id != worker_id:
+                    continue
             self._reconstruct_actor(actor_id, cause)
         return True
 
@@ -404,6 +431,7 @@ class GcsServer:
                 info.num_restarts += 1
                 info.state = RESTARTING
                 info.address = None
+                info.worker_id = None  # a stale death report must not match
                 restart = True
             else:
                 info.state = DEAD

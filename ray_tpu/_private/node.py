@@ -83,7 +83,9 @@ class Node:
     def raylet_address(self) -> Tuple[str, int]:
         return self.raylet.address
 
-    def stop(self):
-        self.raylet.stop()
+    def stop(self, graceful: bool = True):
+        """``graceful=False`` simulates a crash: no unregister, the GCS
+        health checker must detect the death."""
+        self.raylet.stop(unregister=graceful)
         if self.gcs is not None:
             self.gcs.stop()

@@ -250,6 +250,17 @@ class PlasmaStore:
             if freed >= needed:
                 break
 
+    def read(self, object_id: ObjectID, offset: int, length: int) -> Optional[bytes]:
+        """Copy out a chunk of a sealed object (node-to-node transfer plane,
+        reference: src/ray/object_manager/object_buffer_pool.cc)."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                return None
+            length = min(length, e.size - offset)
+            base = e.offset
+        return bytes(self._view[base + offset : base + offset + length])
+
     def stats(self) -> Dict[str, int]:
         with self._cv:
             return {

@@ -70,6 +70,8 @@ class Raylet:
         self.labels["store_capacity"] = str(self.store.capacity)
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._res_cv = threading.Condition()
+        self._peers: Dict[Tuple[str, int], RpcClient] = {}
+        self._peers_lock = threading.Lock()
         self._stopped = threading.Event()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
@@ -148,7 +150,9 @@ class Raylet:
 
     def _on_disconnect(self, conn: ServerConn):
         worker_id = conn.meta.get("worker_id")
-        if worker_id is None:
+        if worker_id is None or self._stopped.is_set():
+            # during drain the node death was already reported via
+            # unregister_node; per-worker reports here would double-count
             return
         with self._res_cv:
             handle = self._workers.pop(worker_id, None)
@@ -178,20 +182,53 @@ class Raylet:
     # leases (two-level scheduling: callers lease workers from this node)
     # ------------------------------------------------------------------
 
+    def _find_spill_node(
+        self, resources: Dict[str, float], against: str
+    ) -> Optional[Tuple[str, int]]:
+        """Ask the GCS resource view for another node that fits the request
+        (the reference's spillback reply, direct_task_transport.cc:501)."""
+        try:
+            nodes = self.gcs.call("get_nodes", timeout=5.0)
+        except Exception:
+            return None
+        best = None
+        best_slack = None
+        for n in nodes:
+            if not n["alive"] or n["node_id"] == self.node_id:
+                continue
+            pool = n["resources"] if against == "total" else n["available"]
+            if all(pool.get(k, 0) >= v for k, v in resources.items() if v > 0):
+                slack = min(
+                    (n["available"].get(k, 0) - v for k, v in resources.items()),
+                    default=0.0,
+                )
+                if best_slack is None or slack > best_slack:
+                    best, best_slack = tuple(n["address"]), slack
+        return best
+
     def rpc_request_worker_lease(self, conn: ServerConn, payload) -> Optional[Dict[str, Any]]:
         resources: Dict[str, float] = dict(payload.get("resources") or {"CPU": 1.0})
         actor_id: Optional[ActorID] = payload.get("actor_id")
         timeout = payload.get("timeout", GlobalConfig.worker_lease_timeout_s)
+        allow_spill = payload.get("allow_spill", True)
         deadline = time.monotonic() + timeout
         with self._res_cv:
             # infeasible check against total
             for k, v in resources.items():
                 if v > 0 and self.total_resources.get(k, 0) < v:
+                    self._res_cv.release()
+                    try:
+                        spill = self._find_spill_node(resources, against="total")
+                    finally:
+                        self._res_cv.acquire()
+                    if spill is not None:
+                        return {"retry_at": spill}
                     raise ValueError(
                         f"resource request {resources} infeasible on node with "
-                        f"{self.total_resources}"
+                        f"{self.total_resources} (and on every other alive node)"
                     )
             need_tpu = resources.get("TPU", 0) > 0
+            spill_checked = False
             while not self._stopped.is_set():
                 have_resources = all(
                     self.available.get(k, 0) >= v for k, v in resources.items()
@@ -221,6 +258,16 @@ class Raylet:
                             self._spawn_worker(tpu=need_tpu)
                         finally:
                             self._res_cv.acquire()
+                if not have_resources and allow_spill and not spill_checked:
+                    # locally saturated: redirect to a node with free capacity
+                    spill_checked = True
+                    self._res_cv.release()
+                    try:
+                        spill = self._find_spill_node(resources, against="available")
+                    finally:
+                        self._res_cv.acquire()
+                    if spill is not None:
+                        return {"retry_at": spill}
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return None
@@ -323,6 +370,76 @@ class Raylet:
         return self.store.stats()
 
     # ------------------------------------------------------------------
+    # node-to-node object transfer (pull-based, chunked; reference:
+    # src/ray/object_manager/pull_manager.cc / push_manager.cc)
+    # ------------------------------------------------------------------
+
+    _PULL_CHUNK = 8 * 1024 * 1024
+
+    def _peer_client(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = tuple(addr)
+        with self._peers_lock:
+            client = self._peers.get(addr)
+            if client is not None and not client.closed:
+                return client
+            client = RpcClient(addr)
+            self._peers[addr] = client
+            return client
+
+    def rpc_store_fetch(self, conn, payload):
+        """Serve a chunk of a sealed local object to a peer raylet."""
+        object_id, offset, length = payload
+        return self.store.read(object_id, offset, length)
+
+    def rpc_store_pull(self, conn, payload):
+        """Fetch an object from a peer raylet into the local store.
+
+        Idempotent: returns True once the object is sealed locally. Concurrent
+        pulls of the same object serialize on the store's create/seal states.
+        """
+        object_id, remote_addr = payload[0], tuple(payload[1])
+        if self.store.contains(object_id):
+            return True
+        if remote_addr == self.server.address:
+            return False
+        client = self._peer_client(remote_addr)
+        # pin remotely while we copy (store_get pins; released below)
+        locs = client.call("store_get", ([object_id], 30.0), timeout=60.0)
+        if locs is None:
+            return False
+        try:
+            _, size = locs[object_id]
+            try:
+                offset = self.store.create(object_id, size)
+            except ValueError:
+                # another pull (or a local producer) is creating it: wait for seal
+                return (
+                    self.store.get_locations([object_id], timeout=60.0, pin=False)
+                    is not None
+                )
+            view = self.store.view(offset, size)
+            pos = 0
+            try:
+                while pos < size:
+                    n = min(self._PULL_CHUNK, size - pos)
+                    chunk = client.call("store_fetch", (object_id, pos, n), timeout=60.0)
+                    if chunk is None:
+                        self.store.abort(object_id)
+                        return False
+                    view[pos : pos + len(chunk)] = chunk
+                    pos += len(chunk)
+            except Exception:
+                self.store.abort(object_id)
+                raise
+            self.store.seal(object_id)
+            return True
+        finally:
+            try:
+                client.call("store_release", object_id, timeout=10.0)
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
 
     def _heartbeat_loop(self):
         period = GlobalConfig.health_check_period_s
@@ -334,8 +451,16 @@ class Raylet:
             except Exception:
                 pass
 
-    def stop(self):
+    def stop(self, unregister: bool = True):
+        if unregister:
+            try:
+                self.gcs.call("unregister_node", self.node_id, timeout=5.0)
+            except Exception:
+                pass
         self._stopped.set()
+        with self._peers_lock:
+            for c in self._peers.values():
+                c.close()
         with self._res_cv:
             workers = list(self._workers.values())
             self._res_cv.notify_all()

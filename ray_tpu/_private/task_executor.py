@@ -45,10 +45,12 @@ class TaskExecutor:
 
     # ------------------------------------------------------------------
 
-    def _deserialize_args(self, payload: bytes) -> Tuple[list, dict]:
+    def _deserialize_args(self, spec: Dict[str, Any]) -> Tuple[list, dict]:
         import pickle
 
-        desc_args, desc_kwargs = pickle.loads(payload)
+        # location hints let core.get pull cross-node deps into local plasma
+        self.core.register_locations(spec.get("locations") or {})
+        desc_args, desc_kwargs = pickle.loads(spec["args"])
         args = []
         ref_ids = [d[1] for d in desc_args if d[0] == "ref"]
         ref_ids += [d[1] for d in desc_kwargs.values() if d[0] == "ref"]
@@ -63,9 +65,10 @@ class TaskExecutor:
         }
         return args, kwargs
 
-    def _package_results(
-        self, task_id, num_returns: int, value: Any, is_exception: bool
-    ) -> List[Tuple[ObjectID, str, Optional[bytes]]]:
+    def _package_results(self, task_id, num_returns: int, value: Any, is_exception: bool):
+        """Returns (results, ref_locations): per-return (oid, kind, data)
+        triples plus location hints for any ObjectRefs nested in the values,
+        so a cross-node caller can pull them (ownership-based directory)."""
         if is_exception:
             values = [value] * num_returns
         elif num_returns == 1:
@@ -81,6 +84,7 @@ class TaskExecutor:
                 )
                 return self._package_results(task_id, num_returns, err, True)
         out = []
+        ref_locations: Dict[bytes, Tuple[str, int]] = {}
         inline_max = GlobalConfig.object_store_inline_max_bytes
         for i, v in enumerate(values):
             oid = ObjectID.for_task_return(task_id, i + 1)
@@ -94,12 +98,22 @@ class TaskExecutor:
                     self.core._resolve_deps([], refs)
                 except Exception:
                     logger.exception("failed to promote returned refs")
+                ref_locations.update(self.core._dep_locations([], refs))
             if sobj.total_size() <= inline_max:
                 out.append((oid, "inline", sobj.to_bytes()))
             else:
                 self.core.plasma.put_serialized(oid, sobj)
                 out.append((oid, "plasma", None))
-        return out
+        return out, ref_locations
+
+    def _reply(self, results_and_locs, is_exc: bool) -> Dict[str, Any]:
+        results, ref_locations = results_and_locs
+        return {
+            "status": "ok" if not is_exc else "error",
+            "results": results,
+            "node": tuple(self.core.raylet.address),
+            "ref_locations": ref_locations,
+        }
 
     def _run(self, fn, args, kwargs, task_id, name: str):
         token_tid = getattr(self.core._task_ctx, "task_id", None)
@@ -126,13 +140,14 @@ class TaskExecutor:
         self.core._emit_event(task_id, "RUNNING", spec["name"])
         try:
             fn = self.core.import_function(spec["fn_id"])
-            args, kwargs = self._deserialize_args(spec["args"])
+            args, kwargs = self._deserialize_args(spec)
         except Exception as e:  # noqa: BLE001
             value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
         else:
             value, is_exc = self._run(fn, args, kwargs, task_id, spec["name"])
-        results = self._package_results(task_id, spec["num_returns"], value, is_exc)
-        return {"status": "ok" if not is_exc else "error", "results": results}
+        return self._reply(
+            self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc
+        )
 
     def _execute_actor_task(self, spec) -> Dict[str, Any]:
         # Per-caller ordering is guaranteed by the caller-side FIFO drain
@@ -145,25 +160,27 @@ class TaskExecutor:
             raise RuntimeError(f"actor {actor_id.hex()[:8]} not hosted on this worker")
         if spec["method"] == "__ray_terminate__":
             self.rpc_kill_self(None, None)
-            results = self._package_results(task_id, spec["num_returns"], None, False)
-            return {"status": "ok", "results": results}
+            return self._reply(
+                self._package_results(task_id, spec["num_returns"], None, False), False
+            )
         with state.sem:
             self.core._emit_event(task_id, "RUNNING", spec["name"])
             try:
                 method = getattr(state.instance, spec["method"])
-                args, kwargs = self._deserialize_args(spec["args"])
+                args, kwargs = self._deserialize_args(spec)
             except Exception as e:  # noqa: BLE001
                 value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
             else:
                 value, is_exc = self._run(method, args, kwargs, task_id, spec["name"])
-        results = self._package_results(task_id, spec["num_returns"], value, is_exc)
-        return {"status": "ok" if not is_exc else "error", "results": results}
+        return self._reply(
+            self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc
+        )
 
     def rpc_create_actor(self, conn: ServerConn, payload) -> bool:
         spec = payload["spec"]
         actor_id = payload["actor_id"]
         cls = self.core.import_function(spec["class_id"])
-        args, kwargs = self._deserialize_args(spec["args"])
+        args, kwargs = self._deserialize_args(spec)
         options = spec["options"]
         creation_task = spec.get("creation_task_id") or actor_id
         instance = cls(*args, **kwargs)

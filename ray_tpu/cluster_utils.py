@@ -1,0 +1,97 @@
+"""In-process multi-node test cluster.
+
+Starts several raylets (each with its own shm object store and worker pool)
+against one GCS inside the current process — the same trick the reference
+uses to test distributed behavior on a single host (reference:
+python/ray/cluster_utils.py:99 Cluster, add_node:165, remove_node:238).
+Worker processes are real subprocesses, so task execution, object transfer
+and failure handling cross real process boundaries even in tests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.node import Node
+
+
+class Cluster:
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[Dict[str, Any]] = None,
+    ):
+        self.head_node: Optional[Node] = None
+        self.worker_nodes: List[Node] = []
+        self._node_counter = 0
+        if initialize_head:
+            args = dict(head_node_args or {})
+            args.setdefault("detect_tpu", False)
+            self.head_node = Node(head=True, node_name="head", **args)
+
+    @property
+    def gcs_address(self):
+        return self.head_node.gcs_address
+
+    @property
+    def address(self) -> str:
+        host, port = self.head_node.gcs_address
+        return f"{host}:{port}"
+
+    def add_node(self, wait: bool = True, **node_args) -> Node:
+        """Start another raylet against the head's GCS (a new 'node')."""
+        assert self.head_node is not None, "cluster has no head node"
+        self._node_counter += 1
+        node_args.setdefault("detect_tpu", False)
+        node = Node(
+            head=False,
+            gcs_address=self.head_node.gcs_address,
+            session_dir=self.head_node.session_dir,
+            node_name=f"node{self._node_counter}",
+            **node_args,
+        )
+        self.worker_nodes.append(node)
+        if wait:
+            self.wait_for_nodes()
+        return node
+
+    def remove_node(self, node: Node, graceful: bool = True):
+        """Stop a node. ``graceful=False`` simulates a crash: the raylet goes
+        away without unregistering and the GCS health checker must notice."""
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+        node.stop(graceful=graceful)
+
+    def wait_for_nodes(self, timeout: float = 30.0):
+        """Block until every started node is alive in the GCS view."""
+        from ray_tpu._private.rpc import RpcClient
+
+        expect = 1 + len(self.worker_nodes)
+        client = RpcClient(self.head_node.gcs_address)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                nodes = client.call("get_nodes")
+                if sum(1 for n in nodes if n["alive"]) >= expect:
+                    return
+                time.sleep(0.05)
+            raise TimeoutError(f"cluster did not reach {expect} alive nodes")
+        finally:
+            client.close()
+
+    def list_nodes(self):
+        from ray_tpu._private.rpc import RpcClient
+
+        client = RpcClient(self.head_node.gcs_address)
+        try:
+            return client.call("get_nodes")
+        finally:
+            client.close()
+
+    def shutdown(self):
+        for node in list(self.worker_nodes):
+            self.remove_node(node)
+        if self.head_node is not None:
+            self.head_node.stop()
+            self.head_node = None

@@ -40,6 +40,20 @@ def ray_start_regular():
 
 
 @pytest.fixture
+def ray_start_cluster():
+    """A bare Cluster; tests add nodes and call ray_tpu.init(address=...)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 2, "resources": {"head": 1.0}}
+    )
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+@pytest.fixture
 def ray_start_small_store():
     import ray_tpu
 

@@ -1,0 +1,147 @@
+"""Multi-node behavior on the in-process Cluster fixture.
+
+Covers the surfaces the reference exercises with ray_start_cluster
+(reference: python/ray/tests/test_multi_node*.py, test_object_manager.py):
+cross-node task scheduling via lease spillback, cross-node argument and
+result transfer through the pull-based object plane, actor restart after a
+node death.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_task_runs_on_remote_node(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"special": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(resources={"special": 1.0})
+    def which_node():
+        import os
+
+        return os.environ.get("RAYTPU_NODE_ID")
+
+    node_id = ray_tpu.get(which_node.remote())
+    special_node = next(
+        n for n in cluster.list_nodes() if "special" in n["resources"]
+    )
+    assert node_id == special_node["node_id"].hex()
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"producer": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(resources={"producer": 1.0})
+    def produce():
+        return np.arange(500_000, dtype=np.float32)  # 2 MB → plasma on node 2
+
+    ref = produce.remote()
+    arr = ray_tpu.get(ref)  # driver is on the head node → requires a pull
+    assert arr.shape == (500_000,)
+    assert float(arr[12345]) == 12345.0
+
+
+def test_cross_node_argument_transfer(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"consumer": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    big = ray_tpu.put(np.ones(300_000, dtype=np.float64))  # on head node
+
+    @ray_tpu.remote(resources={"consumer": 1.0})
+    def consume(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(consume.remote(big)) == 300_000.0
+
+
+def test_spillback_load_balancing(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(num_cpus=2)
+    def busy():
+        import os
+        import time
+
+        time.sleep(0.4)
+        return os.environ.get("RAYTPU_NODE_ID")
+
+    # 3 concurrent 2-cpu tasks > head capacity (2 cpus) → some must spill
+    nodes = set(ray_tpu.get([busy.remote() for _ in range(3)]))
+    assert len(nodes) == 2, f"expected both nodes used, got {nodes}"
+
+
+def test_object_passed_between_worker_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"a": 1.0})
+    cluster.add_node(num_cpus=2, resources={"b": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(resources={"a": 1.0})
+    def make():
+        return np.full(200_000, 7.0)
+
+    @ray_tpu.remote(resources={"b": 1.0})
+    def reduce_(x):
+        return float(x.sum())
+
+    # ref produced on node a, consumed on node b; driver never touches data
+    assert ray_tpu.get(reduce_.remote(make.remote())) == 1_400_000.0
+
+
+def test_actor_on_remote_node_and_restart_after_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(max_restarts=1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def node_id(self):
+            import os
+
+            return os.environ.get("RAYTPU_NODE_ID")
+
+    # Pin to the worker node by resource shape: occupy head cpus first? —
+    # simpler: the GCS picks the most-available node, which is the new one
+    # once the driver holds head resources. Force it via spread: create after
+    # loading head.
+    a = Counter.remote()
+    assert ray_tpu.get(a.incr.remote()) == 1
+    where = ray_tpu.get(a.node_id.remote())
+    if where == node.raylet.node_id.hex():
+        # actor landed on the node we are about to kill: restart must move it
+        cluster.remove_node(node, graceful=True)
+        # restarted actor loses state but keeps serving
+        assert ray_tpu.get(a.incr.remote()) == 1
+    else:
+        cluster.remove_node(node, graceful=True)
+        assert ray_tpu.get(a.incr.remote()) == 2
+
+
+def test_wait_fetches_remote(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"far": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(resources={"far": 1.0})
+    def make():
+        return np.zeros(150_000)
+
+    ref = make.remote()
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=30.0)
+    assert ready == [ref] and not_ready == []
