@@ -528,6 +528,7 @@ class CoreWorker:
         max_retries: Optional[int] = None,
         name: str = "",
         scheduling_node: Optional[NodeID] = None,
+        scheduling_soft: bool = False,
     ) -> List[ObjectID]:
         task_id = self._next_task_id()
         fn_id = self.export_function(fn)
@@ -548,6 +549,7 @@ class CoreWorker:
             ),
             "caller_id": self.worker_id,
             "scheduling_node": scheduling_node,
+            "scheduling_soft": scheduling_soft,
         }
         with self._pending_lock:
             self._pending[task_id] = spec
@@ -588,6 +590,16 @@ class CoreWorker:
         spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
         lease_raylet = self.raylet
         hops = 0
+        if spec.get("scheduling_node") is not None:
+            # NodeAffinity: lease directly from the target node's raylet
+            addr = self._node_address(spec["scheduling_node"])
+            if addr is not None:
+                lease_raylet, hops = self._get_raylet_client(addr), 1
+            elif not spec.get("scheduling_soft"):
+                raise RayTpuError(
+                    f"node {spec['scheduling_node'].hex()[:8]} is not alive "
+                    f"(NodeAffinity hard)"
+                )
         while not self._shutdown.is_set():
             lease = lease_raylet.call(
                 "request_worker_lease",
@@ -601,6 +613,10 @@ class CoreWorker:
                 timeout=GlobalConfig.worker_lease_timeout_s * 2,
             )
             if lease is None:
+                if spec.get("scheduling_node") is not None and not spec.get(
+                    "scheduling_soft"
+                ):
+                    continue  # hard affinity: keep waiting on the target node
                 lease_raylet, hops = self.raylet, 0  # restart from our node
                 continue
             if "retry_at" in lease:
@@ -647,6 +663,15 @@ class CoreWorker:
             )
         except Exception:
             pass
+
+    def _node_address(self, node_id: NodeID) -> Optional[Tuple[str, int]]:
+        try:
+            for n in self.gcs.call("get_nodes", timeout=10.0):
+                if n["node_id"] == node_id and n["alive"]:
+                    return tuple(n["address"])
+        except Exception:
+            pass
+        return None
 
     def _get_raylet_client(self, addr: Tuple[str, int]) -> RpcClient:
         if tuple(addr) == tuple(self.raylet.address):

@@ -18,7 +18,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.config import GlobalConfig
-from ray_tpu._private.ids import ActorID, NodeID, WorkerID
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
 
 logger = logging.getLogger(__name__)
@@ -29,6 +29,32 @@ PENDING_CREATION = "PENDING_CREATION"
 ALIVE = "ALIVE"
 RESTARTING = "RESTARTING"
 DEAD = "DEAD"
+
+# Placement group states (reference: gcs.proto PlacementGroupTableData)
+PG_PENDING = "PENDING"
+PG_CREATED = "CREATED"
+PG_REMOVED = "REMOVED"
+PG_RESCHEDULING = "RESCHEDULING"
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: PlacementGroupID, spec: Dict[str, Any]):
+        self.pg_id = pg_id
+        self.spec = spec  # {bundles: [ {res:amount} ], strategy, name, label_equal}
+        self.state = PG_PENDING
+        self.bundle_nodes: List[Optional[NodeID]] = [None] * len(spec["bundles"])
+        self.failure: Optional[str] = None
+
+    def public_view(self) -> Dict[str, Any]:
+        return {
+            "placement_group_id": self.pg_id,
+            "name": self.spec.get("name", ""),
+            "strategy": self.spec["strategy"],
+            "bundles": self.spec["bundles"],
+            "state": self.state,
+            "bundle_nodes": list(self.bundle_nodes),
+            "failure": self.failure,
+        }
 
 
 class ActorInfo:
@@ -80,6 +106,7 @@ class GcsServer:
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._named_actors: Dict[str, ActorID] = {}
         self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self._subscribers: Dict[str, List[ServerConn]] = {}
         self._raylet_clients: Dict[NodeID, RpcClient] = {}
         self._task_events: List[Dict[str, Any]] = []
@@ -170,7 +197,8 @@ class GcsServer:
         return True
 
     def rpc_heartbeat(self, conn, payload):
-        node_id, available = payload
+        node_id, available = payload[0], payload[1]
+        total = payload[2] if len(payload) > 2 else None
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info.alive:
@@ -179,6 +207,9 @@ class GcsServer:
                 return False
             info.last_heartbeat = time.monotonic()
             info.available_resources = available
+            if total is not None:
+                # totals change when placement-group bundles commit/release
+                info.total_resources = total
         return True
 
     def rpc_unregister_node(self, conn, payload):
@@ -296,13 +327,16 @@ class GcsServer:
                 pass
         return True
 
-    def _pick_node(self, resources: Dict[str, float]) -> Optional[NodeInfo]:
+    def _pick_node(
+        self, resources: Dict[str, float], node_id: Optional[NodeID] = None
+    ) -> Optional[NodeInfo]:
         with self._lock:
             candidates = [
                 n
                 for n in self._nodes.values()
                 if n.alive
                 and all(n.total_resources.get(k, 0) >= v for k, v in resources.items())
+                and (node_id is None or n.node_id == node_id)
             ]
             if not candidates:
                 return None
@@ -327,9 +361,13 @@ class GcsServer:
     def _schedule_actor(self, info: ActorInfo):
         spec = info.spec
         resources = spec["options"].get("resources_spec", {"CPU": 1.0})
+        affinity = spec["options"].get("scheduling_node")
+        soft = spec["options"].get("scheduling_soft", False)
         deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
         while time.monotonic() < deadline:
-            node = self._pick_node(resources)
+            node = self._pick_node(resources, node_id=affinity)
+            if node is None and affinity is not None and soft:
+                node = self._pick_node(resources)
             if node is None:
                 time.sleep(0.1)
                 continue
@@ -455,6 +493,267 @@ class GcsServer:
             affected = [a.actor_id for a in self._actors.values() if a.node_id == node_id and a.state == ALIVE]
         for actor_id in affected:
             self._reconstruct_actor(actor_id, f"node {node_id.hex()[:8]} died")
+        # placement groups with a bundle on the dead node: tear down the whole
+        # gang and re-place it (a pod slice is the failure domain — partial
+        # gangs are useless for SPMD meshes)
+        with self._lock:
+            broken = [
+                p
+                for p in self._pgs.values()
+                if p.state == PG_CREATED and node_id in p.bundle_nodes
+            ]
+            survivors: Dict[Any, List[Tuple[int, NodeID]]] = {}
+            for p in broken:
+                p.state = PG_RESCHEDULING
+                survivors[p.pg_id] = [
+                    (i, nid)
+                    for i, nid in enumerate(p.bundle_nodes)
+                    if nid is not None and nid != node_id
+                ]
+                p.bundle_nodes = [None] * len(p.bundle_nodes)
+        for p in broken:
+            logger.warning(
+                "placement group %s lost node %s; rescheduling the gang",
+                p.pg_id.hex()[:8],
+                node_id.hex()[:8],
+            )
+            self._release_bundles(p.pg_id, survivors[p.pg_id])
+            threading.Thread(
+                target=self._schedule_pg, args=(p,), name="gcs-pg-resched", daemon=True
+            ).start()
+
+    # ------------------------------------------------------------------
+    # placement groups (two-phase prepare/commit, reference:
+    # gcs_placement_group_scheduler.cc + node_manager.proto:380-387)
+    # ------------------------------------------------------------------
+
+    def rpc_create_placement_group(self, conn, payload):
+        pg_id, spec = payload
+        info = PlacementGroupInfo(pg_id, spec)
+        with self._lock:
+            self._pgs[pg_id] = info
+        threading.Thread(
+            target=self._schedule_pg, args=(info,), name="gcs-pg-sched", daemon=True
+        ).start()
+        return True
+
+    def rpc_wait_placement_group(self, conn, payload):
+        """Long-poll until the group is CREATED or REMOVED (failed)."""
+        pg_id, timeout = payload
+        deadline = time.monotonic() + (timeout if timeout is not None else 1e9)
+        while time.monotonic() < deadline:
+            with self._lock:
+                info = self._pgs.get(pg_id)
+                if info is not None and info.state in (PG_CREATED, PG_REMOVED):
+                    return info.public_view()
+            time.sleep(0.01)
+        return None
+
+    def rpc_remove_placement_group(self, conn, payload):
+        pg_id = payload
+        with self._lock:
+            info = self._pgs.get(pg_id)
+            if info is None or info.state == PG_REMOVED:
+                return False
+            info.state = PG_REMOVED
+            assignment = [
+                (i, node_id)
+                for i, node_id in enumerate(info.bundle_nodes)
+                if node_id is not None
+            ]
+            info.bundle_nodes = [None] * len(info.bundle_nodes)
+        self._release_bundles(pg_id, assignment)
+        return True
+
+    def rpc_placement_group_table(self, conn, payload=None):
+        with self._lock:
+            return [p.public_view() for p in self._pgs.values()]
+
+    def _candidate_nodes_locked(self, label_equal: Optional[str]) -> List[List[NodeInfo]]:
+        """Groups of candidate nodes. With a label-equality constraint (e.g.
+        tpu_slice_id for gang-scheduling a pod slice) each group shares one
+        label value; otherwise a single group of all alive nodes."""
+        alive = [n for n in self._nodes.values() if n.alive]
+        if not label_equal:
+            return [alive]
+        groups: Dict[str, List[NodeInfo]] = {}
+        for n in alive:
+            value = n.labels.get(label_equal)
+            if value is not None:
+                groups.setdefault(value, []).append(n)
+        return list(groups.values())
+
+    def _plan_bundles(
+        self, bundles: List[Dict[str, float]], strategy: str, label_equal: Optional[str]
+    ) -> Optional[List[NodeID]]:
+        """Pick a node per bundle, respecting the strategy, against the
+        current resource view. Returns None when no feasible plan exists."""
+        with self._lock:
+            for group in self._candidate_nodes_locked(label_equal):
+                avail = {
+                    n.node_id: dict(n.available_resources) for n in group
+                }
+                nodes = {n.node_id: n for n in group}
+                order = sorted(
+                    avail,
+                    key=lambda nid: -min(avail[nid].values(), default=0.0),
+                )
+
+                def fits(nid, bundle):
+                    return all(avail[nid].get(k, 0.0) >= v for k, v in bundle.items())
+
+                def take(nid, bundle):
+                    for k, v in bundle.items():
+                        avail[nid][k] = avail[nid].get(k, 0.0) - v
+
+                plan: List[Optional[NodeID]] = [None] * len(bundles)
+                if strategy in ("STRICT_PACK",):
+                    for nid in order:
+                        trial = dict(avail[nid])
+                        ok = True
+                        for b in bundles:
+                            if all(trial.get(k, 0.0) >= v for k, v in b.items()):
+                                for k, v in b.items():
+                                    trial[k] = trial.get(k, 0.0) - v
+                            else:
+                                ok = False
+                                break
+                        if ok:
+                            return [nid] * len(bundles)
+                    continue
+                if strategy in ("STRICT_SPREAD",):
+                    used: set = set()
+                    ok = True
+                    for i, b in enumerate(bundles):
+                        chosen = next(
+                            (nid for nid in order if nid not in used and fits(nid, b)),
+                            None,
+                        )
+                        if chosen is None:
+                            ok = False
+                            break
+                        used.add(chosen)
+                        take(chosen, b)
+                        plan[i] = chosen
+                    if ok:
+                        return plan  # type: ignore[return-value]
+                    continue
+                # PACK / SPREAD: soft preferences, always succeed if capacity
+                prefer_same = strategy == "PACK"
+                ok = True
+                last: Optional[NodeID] = None
+                used = set()
+                for i, b in enumerate(bundles):
+                    candidates = [nid for nid in order if fits(nid, b)]
+                    if not candidates:
+                        ok = False
+                        break
+                    chosen = None
+                    if prefer_same and last in candidates:
+                        chosen = last
+                    elif not prefer_same:
+                        fresh = [nid for nid in candidates if nid not in used]
+                        chosen = fresh[0] if fresh else candidates[0]
+                    if chosen is None:
+                        chosen = candidates[0]
+                    take(chosen, b)
+                    plan[i] = chosen
+                    last = chosen
+                    used.add(chosen)
+                if ok:
+                    return plan  # type: ignore[return-value]
+            return None
+
+    def _schedule_pg(self, info: PlacementGroupInfo):
+        spec = info.spec
+        bundles = spec["bundles"]
+        deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
+        while time.monotonic() < deadline:
+            with self._lock:
+                if info.state == PG_REMOVED:
+                    return
+            plan = self._plan_bundles(
+                bundles, spec["strategy"], spec.get("label_equal")
+            )
+            if plan is None:
+                time.sleep(0.2)
+                continue
+            # phase 1: prepare every bundle (atomic reservation per node)
+            prepared: List[Tuple[int, NodeID]] = []
+            ok = True
+            for i, node_id in enumerate(plan):
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                if node is None or not node.alive:
+                    ok = False
+                    break
+                try:
+                    granted = self._raylet_client(node).call(
+                        "prepare_bundle", (info.pg_id, i, bundles[i]), timeout=10.0
+                    )
+                except Exception:
+                    granted = False
+                if not granted:
+                    ok = False
+                    break
+                prepared.append((i, node_id))
+            if not ok:
+                self._release_bundles(info.pg_id, prepared)
+                time.sleep(0.2)
+                continue
+            # phase 2: commit (rollback everything on any failure)
+            committed: List[Tuple[int, NodeID]] = []
+            commit_ok = True
+            for i, node_id in prepared:
+                with self._lock:
+                    node = self._nodes.get(node_id)
+                try:
+                    if node is None or not node.alive:
+                        raise RuntimeError("node died between prepare and commit")
+                    self._raylet_client(node).call(
+                        "commit_bundle", (info.pg_id, i), timeout=10.0
+                    )
+                    committed.append((i, node_id))
+                except Exception:
+                    logger.warning(
+                        "commit_bundle(%s, %d) failed; rolling back",
+                        info.pg_id.hex()[:8],
+                        i,
+                    )
+                    commit_ok = False
+                    break
+            if not commit_ok:
+                self._release_bundles(info.pg_id, prepared)
+                time.sleep(0.2)
+                continue
+            with self._lock:
+                if info.state == PG_REMOVED:
+                    # a concurrent remove ran during prepare/commit: undo
+                    removed_race = True
+                else:
+                    info.bundle_nodes = list(plan)
+                    info.state = PG_CREATED
+                    removed_race = False
+            if removed_race:
+                self._release_bundles(info.pg_id, committed)
+                return
+            self._publish(f"pg:{info.pg_id.hex()}", info.public_view())
+            return
+        with self._lock:
+            info.state = PG_REMOVED
+            info.failure = "scheduling failed: no feasible placement in time"
+        self._publish(f"pg:{info.pg_id.hex()}", info.public_view())
+
+    def _release_bundles(self, pg_id, assignment: List[Tuple[int, NodeID]]):
+        for i, node_id in assignment:
+            with self._lock:
+                node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                continue
+            try:
+                self._raylet_client(node).call("return_bundle", (pg_id, i), timeout=10.0)
+            except Exception:
+                logger.warning("return_bundle(%s, %d) failed", pg_id.hex()[:8], i)
 
     # ------------------------------------------------------------------
     # jobs + task events

@@ -70,6 +70,21 @@ class Node:
             res["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
         if detect_tpu and "TPU" not in res:
             res.update(_detect_tpu_resources())
+        labels = dict(labels or {})
+        if res.get("TPU"):
+            # pod-slice topology labels drive gang scheduling (util/tpu.py);
+            # a single host defaults to being its own slice
+            labels.setdefault(
+                "tpu_slice_id",
+                os.environ.get("RAYTPU_TPU_SLICE_ID", f"slice-{node_name}"),
+            )
+            topo = os.environ.get("RAYTPU_TPU_TOPOLOGY") or os.environ.get(
+                "PALLAS_AXON_TPU_GEN", ""
+            )
+            labels.setdefault("tpu_topology", topo)
+            labels.setdefault(
+                "tpu_worker_index", os.environ.get("RAYTPU_TPU_WORKER_INDEX", "0")
+            )
         self.raylet = Raylet(
             session_dir,
             gcs_address,

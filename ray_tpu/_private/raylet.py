@@ -72,6 +72,8 @@ class Raylet:
         self._res_cv = threading.Condition()
         self._peers: Dict[Tuple[str, int], RpcClient] = {}
         self._peers_lock = threading.Lock()
+        self._prepared_bundles: Dict[Tuple[Any, int], Dict[str, float]] = {}
+        self._committed_bundles: Dict[Tuple[Any, int], Dict[str, float]] = {}
         self._stopped = threading.Event()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
@@ -158,9 +160,7 @@ class Raylet:
             handle = self._workers.pop(worker_id, None)
             if handle is None:
                 return
-            for k, v in handle.lease_resources.items():
-                self.available[k] = self.available.get(k, 0) + v
-            handle.lease_resources = {}
+            self._return_lease_resources_locked(handle)
             self._res_cv.notify_all()
         if handle.proc is not None and handle.proc.poll() is None:
             handle.proc.terminate()
@@ -216,29 +216,39 @@ class Raylet:
             # infeasible check against total
             for k, v in resources.items():
                 if v > 0 and self.total_resources.get(k, 0) < v:
-                    self._res_cv.release()
-                    try:
-                        spill = self._find_spill_node(resources, against="total")
-                    finally:
-                        self._res_cv.acquire()
-                    if spill is not None:
-                        return {"retry_at": spill}
+                    if allow_spill:
+                        self._res_cv.release()
+                        try:
+                            spill = self._find_spill_node(resources, against="total")
+                        finally:
+                            self._res_cv.acquire()
+                        if spill is not None:
+                            return {"retry_at": spill}
                     raise ValueError(
                         f"resource request {resources} infeasible on node with "
-                        f"{self.total_resources} (and on every other alive node)"
+                        f"{self.total_resources}"
+                        + (" (and on every other alive node)" if allow_spill else "")
                     )
-            need_tpu = resources.get("TPU", 0) > 0
+            need_tpu = any(
+                v > 0
+                and (
+                    k == "TPU"
+                    or ((p := self._parse_bundle_key(k)) is not None and p[0] == "TPU")
+                )
+                for k, v in resources.items()
+            )
             spill_checked = False
             while not self._stopped.is_set():
-                have_resources = all(
-                    self.available.get(k, 0) >= v for k, v in resources.items()
+                effective = self._expand_pg_request_locked(resources)
+                have_resources = effective is not None and all(
+                    self.available.get(k, 0) >= v for k, v in effective.items()
                 )
                 idle = self._pop_idle_locked(need_tpu) if have_resources else None
                 if have_resources and idle is not None:
-                    for k, v in resources.items():
+                    for k, v in effective.items():
                         self.available[k] = self.available.get(k, 0) - v
                     idle.idle = False
-                    idle.lease_resources = dict(resources)
+                    idle.lease_resources = dict(effective)
                     if actor_id is not None:
                         idle.actor_ids.append(actor_id)
                     return {"worker_id": idle.worker_id, "address": idle.address}
@@ -292,6 +302,16 @@ class Raylet:
                 self.session_dir,
             )
 
+    def _return_lease_resources_locked(self, handle: WorkerHandle):
+        """Return a worker's leased resources, dropping keys whose bundle was
+        released in the meantime (the bundle release already re-credited the
+        physical resources; re-adding here would recreate the dead names)."""
+        for k, v in handle.lease_resources.items():
+            if "_group_" in k and k not in self.total_resources:
+                continue
+            self.available[k] = self.available.get(k, 0) + v
+        handle.lease_resources = {}
+
     def _pop_idle_locked(self, need_tpu: bool = False) -> Optional[WorkerHandle]:
         for handle in self._workers.values():
             if (
@@ -310,9 +330,7 @@ class Raylet:
             handle = self._workers.get(worker_id)
             if handle is None:
                 return False
-            for k, v in handle.lease_resources.items():
-                self.available[k] = self.available.get(k, 0) + v
-            handle.lease_resources = {}
+            self._return_lease_resources_locked(handle)
             # a worker returned to the pool hosts no actors (failed actor
             # creation must not leave the worker marked as an actor host)
             handle.actor_ids = []
@@ -322,6 +340,173 @@ class Raylet:
         if kill and handle.proc is not None:
             handle.proc.terminate()
         return True
+
+    # ------------------------------------------------------------------
+    # placement-group bundles: two-phase reservation (reference:
+    # node_manager.proto:380-387 PrepareBundleResources/CommitBundleResources,
+    # raylet/placement_group_resource_manager.cc)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def bundle_resource_names(pg_id, index: int, resources: Dict[str, float]):
+        """Indexed + wildcard bundle resource names (reference format:
+        ``{resource}_group_{index}_{pg_id}`` / ``{resource}_group_{pg_id}``)."""
+        out: Dict[str, float] = {}
+        hex_id = pg_id.hex()
+        for k, v in resources.items():
+            out[f"{k}_group_{index}_{hex_id}"] = v
+            out[f"{k}_group_{hex_id}"] = out.get(f"{k}_group_{hex_id}", 0.0) + v
+        return out
+
+    @staticmethod
+    def _parse_bundle_key(key: str):
+        """``CPU_group_0_<hex>`` -> ("CPU", 0, hex); ``CPU_group_<hex>`` ->
+        ("CPU", None, hex); plain keys -> None."""
+        if "_group_" not in key:
+            return None
+        base, rest = key.split("_group_", 1)
+        head, _, tail = rest.partition("_")
+        if tail and head.isdigit():
+            return base, int(head), tail
+        return base, None, rest
+
+    def _expand_pg_request_locked(
+        self, resources: Dict[str, float]
+    ) -> Optional[Dict[str, float]]:
+        """Make a lease request consume BOTH the indexed and wildcard pools of
+        its placement-group bundle, so the two names stay one physical
+        reservation. Wildcard-only requests are pinned to a concrete committed
+        bundle here. Returns None when no bundle currently fits."""
+        if not any("_group_" in k for k in resources):
+            return dict(resources)
+        effective: Dict[str, float] = {}
+        wildcard_by_pg: Dict[str, Dict[str, float]] = {}
+        for k, v in resources.items():
+            parsed = self._parse_bundle_key(k)
+            if parsed is None:
+                effective[k] = effective.get(k, 0.0) + v
+                continue
+            base, index, hex_id = parsed
+            if index is not None:
+                effective[k] = effective.get(k, 0.0) + v
+                wk = f"{base}_group_{hex_id}"
+                effective[wk] = effective.get(wk, 0.0) + v
+            else:
+                wildcard_by_pg.setdefault(hex_id, {})[base] = (
+                    wildcard_by_pg.setdefault(hex_id, {}).get(base, 0.0) + v
+                )
+        for hex_id, bases in wildcard_by_pg.items():
+            indices = sorted(
+                i for (pg, i) in self._committed_bundles if pg.hex() == hex_id
+            )
+            chosen = None
+            for i in indices:
+                if all(
+                    self.available.get(f"{b}_group_{i}_{hex_id}", 0.0)
+                    >= v + effective.get(f"{b}_group_{i}_{hex_id}", 0.0)
+                    for b, v in bases.items()
+                ):
+                    chosen = i
+                    break
+            if chosen is None:
+                return None
+            for b, v in bases.items():
+                ik = f"{b}_group_{chosen}_{hex_id}"
+                wk = f"{b}_group_{hex_id}"
+                effective[ik] = effective.get(ik, 0.0) + v
+                effective[wk] = effective.get(wk, 0.0) + v
+        return effective
+
+    def rpc_prepare_bundle(self, conn, payload):
+        """Phase 1: reserve the bundle's resources (revertible)."""
+        pg_id, index, resources = payload
+        with self._res_cv:
+            if (pg_id, index) in self._prepared_bundles or (
+                pg_id,
+                index,
+            ) in self._committed_bundles:
+                return True  # idempotent retry
+            if not all(self.available.get(k, 0.0) >= v for k, v in resources.items()):
+                return False
+            for k, v in resources.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            self._prepared_bundles[(pg_id, index)] = dict(resources)
+        return True
+
+    def rpc_commit_bundle(self, conn, payload):
+        """Phase 2: expose the reservation as bundle-scoped resources that
+        only tasks/actors scheduled into the group can consume."""
+        pg_id, index = payload
+        with self._res_cv:
+            resources = self._prepared_bundles.pop((pg_id, index), None)
+            if resources is None:
+                return (pg_id, index) in self._committed_bundles
+            names = self.bundle_resource_names(pg_id, index, resources)
+            for k, v in names.items():
+                self.total_resources[k] = self.total_resources.get(k, 0.0) + v
+                self.available[k] = self.available.get(k, 0.0) + v
+            self._committed_bundles[(pg_id, index)] = dict(resources)
+            self._res_cv.notify_all()
+        self._heartbeat_now()
+        return True
+
+    def rpc_return_bundle(self, conn, payload):
+        """Release a prepared or committed bundle back to the general pool.
+
+        Workers still leased against the bundle are killed first (the
+        reference also kills tasks when their group is removed) so the
+        physical resources really are free when re-credited."""
+        pg_id, index = payload
+        victims: List[WorkerHandle] = []
+        with self._res_cv:
+            resources = self._prepared_bundles.pop((pg_id, index), None)
+            if resources is not None:
+                for k, v in resources.items():
+                    self.available[k] = self.available.get(k, 0.0) + v
+                self._res_cv.notify_all()
+                return True
+            resources = self._committed_bundles.pop((pg_id, index), None)
+            if resources is None:
+                return False
+            suffix = f"_group_{index}_{pg_id.hex()}"
+            for handle in self._workers.values():
+                if any(k.endswith(suffix) for k in handle.lease_resources):
+                    handle.lease_resources = {}  # disconnect must not re-credit
+                    victims.append(handle)
+            names = self.bundle_resource_names(pg_id, index, resources)
+            for k, v in names.items():
+                parsed = self._parse_bundle_key(k)
+                if parsed is not None and parsed[1] is not None:
+                    # indexed pool: dies with the bundle regardless of leases
+                    self.total_resources.pop(k, None)
+                    self.available.pop(k, None)
+                else:
+                    # wildcard pool: other bundles of the group may remain
+                    self.total_resources[k] = self.total_resources.get(k, 0.0) - v
+                    if self.total_resources.get(k, 0.0) <= 1e-9:
+                        self.total_resources.pop(k, None)
+                        self.available.pop(k, None)
+                    else:
+                        self.available[k] = max(
+                            0.0, self.available.get(k, 0.0) - v
+                        )
+            for k, v in resources.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+            self._res_cv.notify_all()
+        for handle in victims:
+            if handle.proc is not None and handle.proc.poll() is None:
+                handle.proc.terminate()
+        self._heartbeat_now()
+        return True
+
+    def _heartbeat_now(self):
+        try:
+            with self._res_cv:
+                available = dict(self.available)
+                total = dict(self.total_resources)
+            self.gcs.call("heartbeat", (self.node_id, available, total), timeout=5.0)
+        except Exception:
+            pass
 
     def rpc_get_node_info(self, conn, payload=None):
         with self._res_cv:
@@ -444,12 +629,7 @@ class Raylet:
     def _heartbeat_loop(self):
         period = GlobalConfig.health_check_period_s
         while not self._stopped.wait(period / 2):
-            try:
-                with self._res_cv:
-                    available = dict(self.available)
-                self.gcs.call("heartbeat", (self.node_id, available), timeout=5.0)
-            except Exception:
-                pass
+            self._heartbeat_now()
 
     def stop(self, unregister: bool = True):
         if unregister:

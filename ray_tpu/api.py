@@ -44,16 +44,32 @@ def _resources_from_options(options: Dict[str, Any], default_cpu: float) -> Dict
     res["CPU"] = float(num_cpus) if num_cpus is not None else default_cpu
     if options.get("num_tpus"):
         res["TPU"] = float(options["num_tpus"])
+    pg = options.get("placement_group")
+    index = options.get("placement_group_bundle_index", -1)
     strategy = options.get("scheduling_strategy")
     if strategy is not None:
-        extra = getattr(strategy, "required_resources", None)
-        if extra:
-            res.update(extra)
-    pg = options.get("placement_group")
+        from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            index = strategy.placement_group_bundle_index
     if pg is not None:
-        index = options.get("placement_group_bundle_index", -1)
-        res.update(pg.bundle_resources(index))
+        from ray_tpu.util.placement_group import translate_pg_resources
+
+        res = translate_pg_resources(res, pg, index)
     return res
+
+
+def _scheduling_node_from_options(options: Dict[str, Any]):
+    """(node_id, soft) for NodeAffinity, else (None, False)."""
+    strategy = options.get("scheduling_strategy")
+    if strategy is None:
+        return None, False
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return strategy.node_id, strategy.soft
+    return None, False
 
 
 def _check_options(options: Dict[str, Any]):
@@ -77,6 +93,7 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         core = worker_mod.get_global_worker().core
         num_returns = self._options.get("num_returns", 1)
+        node_id, soft = _scheduling_node_from_options(self._options)
         refs = core.submit_task(
             self._fn,
             args,
@@ -85,6 +102,8 @@ class RemoteFunction:
             resources=_resources_from_options(self._options, default_cpu=1.0),
             max_retries=self._options.get("max_retries"),
             name=self._options.get("name") or self._fn.__name__,
+            scheduling_node=node_id,
+            scheduling_soft=soft,
         )
         return refs[0] if num_returns == 1 else refs
 
@@ -162,12 +181,15 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         core = worker_mod.get_global_worker().core
+        node_id, soft = _scheduling_node_from_options(self._options)
         options = {
             "max_restarts": self._options.get("max_restarts", 0),
             "max_concurrency": self._options.get("max_concurrency", 1),
             "name": self._options.get("name"),
             "lifetime": self._options.get("lifetime"),
             "resources_spec": _resources_from_options(self._options, default_cpu=1.0),
+            "scheduling_node": node_id,
+            "scheduling_soft": soft,
         }
         actor_id = core.create_actor(self._cls, args, kwargs, options)
         return ActorHandle(

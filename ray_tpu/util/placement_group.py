@@ -1,0 +1,104 @@
+"""Placement groups: gang reservation of resource bundles across nodes.
+
+API mirror of the reference (reference: python/ray/util/placement_group.py:139
+placement_group(), strategies at :153-157) over the TPU runtime's two-phase
+prepare/commit bundle reservation. On TPU clusters the key use is gang-
+scheduling one worker per host of a pod slice (STRICT_SPREAD + a
+label-equality constraint on the slice id, see ray_tpu/util/tpu.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    """Handle to a (possibly still-pending) placement group."""
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str = "PACK"):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the group is placed; True on success."""
+        core = worker_mod.get_global_worker().core
+        view = core.gcs.call(
+            "wait_placement_group", (self.id, timeout if timeout is not None else 300.0)
+        )
+        return view is not None and view["state"] == "CREATED"
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self.ready(timeout=timeout_seconds)
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self.bundles)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def translate_pg_resources(
+    resources: Dict[str, float], pg: PlacementGroup, bundle_index: int = -1
+) -> Dict[str, float]:
+    """Rewrite a resource request to consume from a placement-group bundle."""
+    hex_id = pg.id.hex()
+    out: Dict[str, float] = {}
+    for k, v in resources.items():
+        if v <= 0:
+            continue
+        if bundle_index >= 0:
+            out[f"{k}_group_{bundle_index}_{hex_id}"] = v
+        else:
+            out[f"{k}_group_{hex_id}"] = v
+    return out
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    label_equal: Optional[str] = None,
+) -> PlacementGroup:
+    """Create a placement group asynchronously; use ``.ready()`` to wait.
+
+    ``label_equal`` constrains all bundles to nodes sharing one value of the
+    given node label (TPU gang scheduling uses ``tpu_slice_id``) — a TPU-first
+    extension the reference lacks.
+    """
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"invalid strategy {strategy!r}; one of {VALID_STRATEGIES}")
+    if not bundles or any(not b for b in bundles):
+        raise ValueError("bundles must be a non-empty list of non-empty dicts")
+    for b in bundles:
+        for k, v in b.items():
+            if v < 0:
+                raise ValueError(f"negative resource {k}={v}")
+    core = worker_mod.get_global_worker().core
+    pg_id = PlacementGroupID.of(core.job_id)
+    spec = {
+        "bundles": [dict(b) for b in bundles],
+        "strategy": strategy,
+        "name": name,
+        "label_equal": label_equal,
+    }
+    core.gcs.call("create_placement_group", (pg_id, spec))
+    return PlacementGroup(pg_id, spec["bundles"], strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    core = worker_mod.get_global_worker().core
+    core.gcs.call("remove_placement_group", pg.id)
+
+
+def placement_group_table() -> List[Dict[str, Any]]:
+    core = worker_mod.get_global_worker().core
+    return core.gcs.call("placement_group_table")

@@ -1,0 +1,40 @@
+"""Scheduling strategies for tasks and actors.
+
+(reference: python/ray/util/scheduling_strategies.py —
+PlacementGroupSchedulingStrategy:15, NodeAffinitySchedulingStrategy:41.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu.util.placement_group import PlacementGroup
+
+
+class PlacementGroupSchedulingStrategy:
+    """Schedule into a placement-group bundle."""
+
+    def __init__(
+        self,
+        placement_group: PlacementGroup,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks
+
+
+class NodeAffinitySchedulingStrategy:
+    """Pin to a specific node. ``soft=True`` falls back to the default
+    scheduler when the node is gone or saturated."""
+
+    def __init__(self, node_id: Union[NodeID, str], soft: bool = False):
+        self.node_id = NodeID.from_hex(node_id) if isinstance(node_id, str) else node_id
+        self.soft = soft
+
+
+SchedulingStrategyT = Union[
+    str, PlacementGroupSchedulingStrategy, NodeAffinitySchedulingStrategy
+]

@@ -1,0 +1,58 @@
+"""TPU pod-slice topology helpers.
+
+The reference has no TPU accelerator support at all (reference:
+python/ray/util/accelerators/accelerators.py is GPU-only; its only TPU code
+is the GCP autoscaler node provider, python/ray/autoscaler/_private/gcp/).
+Here slices are first-class: nodes carry ``tpu_slice_id`` / ``tpu_topology``
+/ ``tpu_worker_index`` labels, and gang scheduling one worker per host of a
+slice is a placement group with a label-equality constraint — the atomic
+prepare/commit makes mesh formation all-or-nothing (a slice is the failure
+domain).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu.util.placement_group import PlacementGroup, placement_group
+
+# chips per host for common machine shapes
+HOST_CHIPS = {"v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+
+def slice_placement_group(
+    num_hosts: int,
+    tpu_per_host: int = 4,
+    cpu_per_host: float = 1.0,
+    name: str = "",
+) -> PlacementGroup:
+    """Reserve one bundle per host of a single TPU slice (gang semantics:
+    STRICT_SPREAD across hosts + all hosts in the same slice; atomic)."""
+    bundle = {"CPU": cpu_per_host, "TPU": float(tpu_per_host)}
+    return placement_group(
+        [dict(bundle) for _ in range(num_hosts)],
+        strategy="STRICT_SPREAD",
+        name=name,
+        label_equal="tpu_slice_id",
+    )
+
+
+def available_slices() -> Dict[str, List[Dict]]:
+    """Map of slice id -> node views, from the GCS resource view."""
+    core = worker_mod.get_global_worker().core
+    slices: Dict[str, List[Dict]] = {}
+    for node in core.gcs.call("get_nodes"):
+        if not node["alive"]:
+            continue
+        slice_id = node["labels"].get("tpu_slice_id")
+        if slice_id is not None:
+            slices.setdefault(slice_id, []).append(node)
+    return slices
+
+
+def current_slice_id() -> Optional[str]:
+    """The slice this process's node belongs to (None off-TPU)."""
+    import os
+
+    return os.environ.get("RAYTPU_TPU_SLICE_ID") or None

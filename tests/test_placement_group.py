@@ -1,0 +1,216 @@
+"""Placement groups, scheduling strategies, and TPU slice gang scheduling.
+
+(reference surfaces: python/ray/tests/test_placement_group*.py,
+util/placement_group.py, scheduling_strategies.py.)
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def test_pg_create_ready_and_task(ray_start_regular):
+    pg = placement_group([{"CPU": 1.0}, {"CPU": 1.0}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    )
+    def f():
+        return "in-bundle"
+
+    assert ray_tpu.get(f.remote()) == "in-bundle"
+    remove_placement_group(pg)
+
+
+def test_pg_reserves_resources(ray_start_regular):
+    # node has 4 CPUs; a 3-CPU bundle leaves 1 for ordinary tasks
+    pg = placement_group([{"CPU": 3.0}])
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=2)
+    def two_cpu():
+        return 1
+
+    ref = two_cpu.remote()
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=1.5)
+    assert not ready, "2-CPU task must not fit outside the 3-CPU bundle"
+    # inside the bundle it fits
+    strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
+
+    @ray_tpu.remote(num_cpus=2, scheduling_strategy=strategy)
+    def inside():
+        return 2
+
+    assert ray_tpu.get(inside.remote(), timeout=30) == 2
+    remove_placement_group(pg)
+    # after removal the general pool is restored
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+
+def test_strict_spread_across_cluster(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    pg = placement_group([{"CPU": 1.0}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    table = placement_group_table()
+    entry = next(t for t in table if t["placement_group_id"] == pg.id)
+    nodes = entry["bundle_nodes"]
+    assert len(set(nodes)) == 3, f"STRICT_SPREAD must use 3 distinct nodes: {nodes}"
+
+
+def test_strict_pack_infeasible_stays_pending(ray_start_regular):
+    # 4-CPU node cannot STRICT_PACK 2x3 CPUs
+    pg = placement_group([{"CPU": 3.0}, {"CPU": 3.0}], strategy="STRICT_PACK")
+    assert not pg.ready(timeout=1.0)
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    target = node.raylet.node_id
+
+    @ray_tpu.remote(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=target, soft=False)
+    )
+    def where():
+        import os
+
+        return os.environ.get("RAYTPU_NODE_ID")
+
+    assert ray_tpu.get(where.remote(), timeout=60) == target.hex()
+
+
+def test_actor_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"pgnode": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    pg = placement_group([{"CPU": 1.0, "pgnode": 0.5}])
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(
+        num_cpus=1,
+        resources={"pgnode": 0.5},
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        ),
+    )
+    class A:
+        def ping(self):
+            import os
+
+            return os.environ.get("RAYTPU_NODE_ID")
+
+    a = A.remote()
+    where = ray_tpu.get(a.ping.remote(), timeout=60)
+    pgnode = next(n for n in cluster.list_nodes() if "pgnode" in n["resources"])
+    assert where == pgnode["node_id"].hex()
+
+
+def test_tpu_slice_placement_group(ray_start_cluster):
+    """Gang-reserve one bundle per host of a fake 2-host TPU slice."""
+    cluster = ray_start_cluster
+    for i in range(2):
+        cluster.add_node(
+            num_cpus=2,
+            resources={"TPU": 4.0},
+            labels={"tpu_slice_id": "slice-A", "tpu_worker_index": str(i)},
+        )
+    # a second slice with only one host: must NOT be chosen
+    cluster.add_node(
+        num_cpus=2, resources={"TPU": 4.0}, labels={"tpu_slice_id": "slice-B"}
+    )
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    from ray_tpu.util.tpu import slice_placement_group
+
+    pg = slice_placement_group(num_hosts=2, tpu_per_host=4, cpu_per_host=1.0)
+    assert pg.ready(timeout=30)
+    entry = next(
+        t for t in placement_group_table() if t["placement_group_id"] == pg.id
+    )
+    chosen = entry["bundle_nodes"]
+    slice_a = {
+        n["node_id"]
+        for n in cluster.list_nodes()
+        if n["labels"].get("tpu_slice_id") == "slice-A"
+    }
+    assert set(chosen) == slice_a, "gang must land on the 2-host slice"
+
+
+def test_wildcard_and_indexed_share_one_reservation(ray_start_regular):
+    """A bundle's indexed and wildcard resource names are one physical pool:
+    consuming via the wildcard must also drain the indexed capacity."""
+    import time
+
+    pg = placement_group([{"CPU": 1.0}])
+    assert pg.ready(timeout=30)
+    strategy_any = PlacementGroupSchedulingStrategy(placement_group=pg)
+    strategy_0 = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0
+    )
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=strategy_any)
+    def hold():
+        time.sleep(1.2)
+        return "held"
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=strategy_0)
+    def second():
+        return "second"
+
+    first_ref = hold.remote()
+    time.sleep(0.3)  # let the wildcard task take the bundle
+    second_ref = second.remote()
+    ready, _ = ray_tpu.wait([second_ref], num_returns=1, timeout=0.4)
+    assert not ready, "indexed request must queue behind the wildcard holder"
+    assert ray_tpu.get([first_ref, second_ref], timeout=30) == ["held", "second"]
+    remove_placement_group(pg)
+
+
+def test_pg_reschedules_after_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"spare": 2.0})
+    spare = cluster.add_node(num_cpus=2, resources={"spare": 2.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    pg = placement_group([{"spare": 1.0}])
+    assert pg.ready(timeout=30)
+    entry = next(t for t in placement_group_table() if t["placement_group_id"] == pg.id)
+    first_node = entry["bundle_nodes"][0]
+    victim = doomed if first_node == doomed.raylet.node_id else spare
+    cluster.remove_node(victim, graceful=True)
+    import time
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        entry = next(
+            t for t in placement_group_table() if t["placement_group_id"] == pg.id
+        )
+        if entry["state"] == "CREATED" and entry["bundle_nodes"][0] not in (
+            None,
+            victim.raylet.node_id,
+        ):
+            break
+        time.sleep(0.1)
+    assert entry["state"] == "CREATED"
+    assert entry["bundle_nodes"][0] != victim.raylet.node_id
+
+
+def test_invalid_pg_args(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1.0}], strategy="BOGUS")
