@@ -177,6 +177,7 @@ class CoreWorker:
         self._pulls_inflight: set = set()
         # raylet clients for spillback leasing on other nodes
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._node_addr_cache: Dict[NodeID, Tuple[str, int]] = {}
         # local reference counting: when the last local ObjectRef instance
         # handed out by this worker is GC'd, the owned object is freed
         # (a single-process slice of the reference's distributed
@@ -601,17 +602,31 @@ class CoreWorker:
                     f"(NodeAffinity hard)"
                 )
         while not self._shutdown.is_set():
-            lease = lease_raylet.call(
-                "request_worker_lease",
-                {
-                    "resources": spec["resources"],
-                    "job_id": spec["job_id"],
-                    # a redirected request must not bounce again (avoids
-                    # spillback ping-pong between two saturated nodes)
-                    "allow_spill": hops == 0,
-                },
-                timeout=GlobalConfig.worker_lease_timeout_s * 2,
-            )
+            try:
+                lease = lease_raylet.call(
+                    "request_worker_lease",
+                    {
+                        "resources": spec["resources"],
+                        "job_id": spec["job_id"],
+                        # a redirected request must not bounce again (avoids
+                        # spillback ping-pong between two saturated nodes)
+                        "allow_spill": hops == 0,
+                    },
+                    timeout=GlobalConfig.worker_lease_timeout_s * 2,
+                )
+            except (ConnectionLost, TimeoutError, OSError) as e:
+                if lease_raylet is self.raylet:
+                    raise  # our own raylet is gone: nothing to fall back to
+                self._node_addr_cache.clear()  # the peer died; addresses stale
+                if spec.get("scheduling_node") is not None and not spec.get(
+                    "scheduling_soft"
+                ):
+                    raise RayTpuError(
+                        f"node {spec['scheduling_node'].hex()[:8]} died "
+                        f"(NodeAffinity hard): {e}"
+                    ) from e
+                lease_raylet, hops = self.raylet, 0
+                continue
             if lease is None:
                 if spec.get("scheduling_node") is not None and not spec.get(
                     "scheduling_soft"
@@ -665,13 +680,16 @@ class CoreWorker:
             pass
 
     def _node_address(self, node_id: NodeID) -> Optional[Tuple[str, int]]:
+        cached = self._node_addr_cache.get(node_id)
+        if cached is not None:
+            return cached
         try:
             for n in self.gcs.call("get_nodes", timeout=10.0):
-                if n["node_id"] == node_id and n["alive"]:
-                    return tuple(n["address"])
+                if n["alive"]:
+                    self._node_addr_cache[n["node_id"]] = tuple(n["address"])
         except Exception:
             pass
-        return None
+        return self._node_addr_cache.get(node_id)
 
     def _get_raylet_client(self, addr: Tuple[str, int]) -> RpcClient:
         if tuple(addr) == tuple(self.raylet.address):

@@ -727,16 +727,28 @@ class GcsServer:
                 time.sleep(0.2)
                 continue
             with self._lock:
+                all_alive = all(
+                    (n := self._nodes.get(nid)) is not None and n.alive for nid in plan
+                )
                 if info.state == PG_REMOVED:
                     # a concurrent remove ran during prepare/commit: undo
-                    removed_race = True
+                    outcome = "removed"
+                elif not all_alive:
+                    # a plan node died during commit and _handle_node_death
+                    # could not see the group (state was still PENDING): undo
+                    # and re-plan (both paths hold _lock, so no window)
+                    outcome = "replan"
                 else:
                     info.bundle_nodes = list(plan)
                     info.state = PG_CREATED
-                    removed_race = False
-            if removed_race:
+                    outcome = "created"
+            if outcome == "removed":
                 self._release_bundles(info.pg_id, committed)
                 return
+            if outcome == "replan":
+                self._release_bundles(info.pg_id, committed)
+                time.sleep(0.2)
+                continue
             self._publish(f"pg:{info.pg_id.hex()}", info.public_view())
             return
         with self._lock:

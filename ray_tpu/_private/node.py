@@ -76,7 +76,12 @@ class Node:
             # a single host defaults to being its own slice
             labels.setdefault(
                 "tpu_slice_id",
-                os.environ.get("RAYTPU_TPU_SLICE_ID", f"slice-{node_name}"),
+                os.environ.get(
+                    "RAYTPU_TPU_SLICE_ID",
+                    # host-unique fallback: unrelated single hosts must never
+                    # look like one ICI-connected slice
+                    f"slice-{node_name}-{uuid.uuid4().hex[:8]}",
+                ),
             )
             topo = os.environ.get("RAYTPU_TPU_TOPOLOGY") or os.environ.get(
                 "PALLAS_AXON_TPU_GEN", ""

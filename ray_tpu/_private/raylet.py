@@ -356,6 +356,10 @@ class Raylet:
         for k, v in resources.items():
             out[f"{k}_group_{index}_{hex_id}"] = v
             out[f"{k}_group_{hex_id}"] = out.get(f"{k}_group_{hex_id}", 0.0) + v
+        # synthetic marker so zero-resource requests can still be pinned to
+        # the bundle (reference: the bundle_group_* marker resource)
+        out[f"bundle_group_{index}_{hex_id}"] = 1000.0
+        out[f"bundle_group_{hex_id}"] = 1000.0
         return out
 
     @staticmethod

@@ -1,0 +1,35 @@
+"""Collective communication for actors/tasks (host + xla backends)."""
+
+from ray_tpu.util.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "ReduceOp",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_collective_group_size",
+    "get_rank",
+    "init_collective_group",
+    "is_group_initialized",
+    "recv",
+    "reducescatter",
+    "send",
+]

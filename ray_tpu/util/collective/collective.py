@@ -1,0 +1,277 @@
+"""Collective communication groups for actors and tasks.
+
+API mirror of the reference's ``ray.util.collective`` (reference:
+python/ray/util/collective/collective.py — init_collective_group:120,
+allreduce:258, barrier:298, broadcast:373, allgather:423, reducescatter:472,
+send:531, recv:594), with TPU-first backends instead of NCCL/GLOO:
+
+- ``"host"`` (default): host-memory tensors (numpy / host jax arrays) move
+  through a rendezvous actor backed by the shared-memory object plane. This
+  is the control-plane path — weight broadcast to rollout workers, metric
+  reduction, small-tensor sync — the role GLOO plays in the reference.
+- ``"xla"``: device tensors inside an SPMD program do NOT use this API at
+  all: jitted code already contains psum/all_gather/ppermute over ICI via
+  pjit/shard_map (see ray_tpu.parallel). The "xla" backend exists for
+  host-driven device arrays: it stages through host memory and device_puts
+  the result back, preserving shardings where possible.
+
+Every rank must call each collective in the same order (the usual SPMD
+contract); operations are matched by a per-group monotonically increasing
+sequence number.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+}
+
+
+class _Group:
+    def __init__(self, group_name: str, world_size: int, rank: int, backend: str, store):
+        self.name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.backend = backend
+        self.store = store  # ActorHandle of the rendezvous actor
+        self.seq = 0
+        self.p2p_seq: Dict[tuple, int] = {}
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def next_p2p_seq(self, src: int, dst: int) -> int:
+        key = (src, dst)
+        self.p2p_seq[key] = self.p2p_seq.get(key, 0) + 1
+        return self.p2p_seq[key]
+
+
+_groups: Dict[str, _Group] = {}
+_groups_lock = threading.Lock()
+
+
+def _store_actor_name(group_name: str) -> str:
+    return f"__collective_store__{group_name}"
+
+
+def _get_or_create_store(group_name: str, world_size: int):
+    import ray_tpu
+    from ray_tpu.util.collective.store import CollectiveStore
+
+    name = _store_actor_name(group_name)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        try:
+            return ray_tpu.get_actor(name)
+        except ValueError:
+            pass
+        try:
+            handle = (
+                ray_tpu.remote(CollectiveStore)
+                .options(name=name, max_concurrency=max(16, 4 * world_size), num_cpus=0)
+                .remote(world_size)
+            )
+            # make sure creation succeeded (name may have raced)
+            ray_tpu.get(handle.world.remote(), timeout=30.0)
+            return handle
+        except Exception:
+            time.sleep(0.05)
+    raise TimeoutError(f"could not create collective store for {group_name!r}")
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Join this process to a named collective group (call once per rank)."""
+    if backend not in ("host", "xla"):
+        raise ValueError(f"unknown backend {backend!r}; use 'host' or 'xla'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    with _groups_lock:
+        if group_name in _groups:
+            raise RuntimeError(f"group {group_name!r} already initialized here")
+    store = _get_or_create_store(group_name, world_size)
+    with _groups_lock:
+        _groups[group_name] = _Group(group_name, world_size, rank, backend, store)
+
+
+def create_collective_group(
+    actors: Sequence[Any],
+    world_size: int,
+    ranks: Sequence[int],
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Declarative form: the driver pre-creates the rendezvous point; each
+    actor must still call ``init_collective_group`` with its rank (the
+    reference's declare_collective_group works the same way underneath)."""
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must align")
+    _get_or_create_store(group_name, world_size)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    import ray_tpu
+
+    with _groups_lock:
+        group = _groups.pop(group_name, None)
+    if group is not None and group.rank == 0:
+        try:
+            ray_tpu.kill(group.store)
+        except Exception:
+            pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get_group(group_name).world_size
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    with _groups_lock:
+        return group_name in _groups
+
+
+def _get_group(group_name: str) -> _Group:
+    with _groups_lock:
+        group = _groups.get(group_name)
+    if group is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this process"
+        )
+    return group
+
+
+# ---------------------------------------------------------------------------
+# tensor marshalling: numpy is the wire format; jax arrays round-trip
+# ---------------------------------------------------------------------------
+
+
+def _to_host(tensor: Any):
+    """Returns (numpy_value, restore_fn)."""
+    try:
+        import jax
+
+        if isinstance(tensor, jax.Array):
+            sharding = tensor.sharding
+            value = np.asarray(tensor)
+
+            def restore(out: np.ndarray):
+                import jax as _jax
+
+                try:
+                    return _jax.device_put(out, sharding)
+                except Exception:
+                    return _jax.numpy.asarray(out)
+
+            return value, restore
+    except Exception:
+        pass
+    value = np.asarray(tensor)
+    return value, lambda out: out
+
+
+def _exchange(group: _Group, tag: str, value: np.ndarray) -> List[np.ndarray]:
+    """All ranks contribute; returns the full list ordered by rank."""
+    import ray_tpu
+
+    key = f"{group.name}:{tag}:{group.next_seq()}"
+    gathered = ray_tpu.get(
+        group.store.exchange.remote(key, group.rank, value),
+        timeout=120.0,
+    )
+    return gathered
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+def allreduce(tensor: Any, group_name: str = "default", op: str = ReduceOp.SUM):
+    group = _get_group(group_name)
+    value, restore = _to_host(tensor)
+    parts = _exchange(group, "allreduce", value)
+    out = _REDUCERS[op](np.stack(parts))
+    return restore(out.astype(value.dtype, copy=False))
+
+
+def allgather(tensor: Any, group_name: str = "default") -> List[Any]:
+    group = _get_group(group_name)
+    value, restore = _to_host(tensor)
+    parts = _exchange(group, "allgather", value)
+    return [restore(p) for p in parts]
+
+
+def reducescatter(tensor: Any, group_name: str = "default", op: str = ReduceOp.SUM):
+    """Reduce across ranks, then each rank keeps its 1/world_size shard along
+    axis 0 (tensor's leading dim must divide evenly)."""
+    group = _get_group(group_name)
+    value, restore = _to_host(tensor)
+    if value.shape[0] % group.world_size != 0:
+        raise ValueError(
+            f"leading dim {value.shape[0]} not divisible by world {group.world_size}"
+        )
+    parts = _exchange(group, "reducescatter", value)
+    reduced = _REDUCERS[op](np.stack(parts))
+    shard = np.split(reduced, group.world_size, axis=0)[group.rank]
+    return restore(shard.astype(value.dtype, copy=False))
+
+
+def broadcast(tensor: Any, src_rank: int = 0, group_name: str = "default"):
+    group = _get_group(group_name)
+    value, restore = _to_host(tensor)
+    if group.rank == src_rank:
+        parts = _exchange(group, "broadcast", value)
+        return restore(value)
+    # non-src contributes a placeholder and takes the src's tensor
+    parts = _exchange(group, "broadcast", np.zeros(0, dtype=np.uint8))
+    return restore(parts[src_rank])
+
+
+def barrier(group_name: str = "default") -> None:
+    group = _get_group(group_name)
+    _exchange(group, "barrier", np.zeros(0, dtype=np.uint8))
+
+
+def send(tensor: Any, dst_rank: int, group_name: str = "default") -> None:
+    import ray_tpu
+
+    group = _get_group(group_name)
+    value, _ = _to_host(tensor)
+    seq = group.next_p2p_seq(group.rank, dst_rank)
+    key = f"{group.name}:p2p:{group.rank}->{dst_rank}:{seq}"
+    ray_tpu.get(group.store.put_one.remote(key, value), timeout=120.0)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    import ray_tpu
+
+    group = _get_group(group_name)
+    seq = group.next_p2p_seq(src_rank, group.rank)
+    key = f"{group.name}:p2p:{src_rank}->{group.rank}:{seq}"
+    return ray_tpu.get(group.store.take_one.remote(key), timeout=120.0)

@@ -1,0 +1,76 @@
+"""Rendezvous actor backing the host collective backend.
+
+One named actor per group; large payloads ride the shared-memory object
+plane automatically (actor args/results > inline threshold go to plasma), so
+an N-rank exchange is N puts + N reads of shm, not N^2 socket copies.
+(Fills the role of the reference's gloo rendezvous store,
+python/ray/util/collective/collective_group/gloo_collective_group.py.)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class CollectiveStore:
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # key -> {rank: value}; completed keys keep a fetch countdown so the
+        # last reader frees the slot
+        self._pending: Dict[str, Dict[int, Any]] = {}
+        self._done: Dict[str, Dict[str, Any]] = {}
+        self._mailbox: Dict[str, Any] = {}
+
+    def world(self) -> int:
+        return self.world_size
+
+    def exchange(self, key: str, rank: int, value: Any) -> List[Any]:
+        """Contribute rank's tensor; blocks until all ranks arrive, returns
+        the rank-ordered list. Runs under the actor's concurrency pool, so
+        all ranks can block here simultaneously."""
+        with self._cv:
+            slot = self._pending.setdefault(key, {})
+            slot[rank] = value
+            if len(slot) == self.world_size:
+                self._done[key] = {
+                    "values": [slot[r] for r in range(self.world_size)],
+                    "remaining": self.world_size,
+                }
+                del self._pending[key]
+                self._cv.notify_all()
+            else:
+                deadline = time.monotonic() + 600.0
+                while key not in self._done:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"collective {key} timed out at rank {rank}: "
+                            f"{len(self._pending.get(key, {}))}/{self.world_size} arrived"
+                        )
+                    self._cv.wait(min(remaining, 1.0))
+            entry = self._done[key]
+            values = entry["values"]
+            entry["remaining"] -= 1
+            if entry["remaining"] == 0:
+                del self._done[key]
+            return values
+
+    def put_one(self, key: str, value: Any) -> bool:
+        with self._cv:
+            self._mailbox[key] = value
+            self._cv.notify_all()
+        return True
+
+    def take_one(self, key: str, timeout: float = 600.0) -> Any:
+        with self._cv:
+            deadline = time.monotonic() + timeout
+            while key not in self._mailbox:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"recv {key} timed out")
+                self._cv.wait(min(remaining, 1.0))
+            return self._mailbox.pop(key)

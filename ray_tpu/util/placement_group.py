@@ -50,6 +50,11 @@ def translate_pg_resources(
     resources: Dict[str, float], pg: PlacementGroup, bundle_index: int = -1
 ) -> Dict[str, float]:
     """Rewrite a resource request to consume from a placement-group bundle."""
+    if bundle_index >= len(pg.bundles):
+        raise ValueError(
+            f"bundle index {bundle_index} out of range: group has "
+            f"{len(pg.bundles)} bundles"
+        )
     hex_id = pg.id.hex()
     out: Dict[str, float] = {}
     for k, v in resources.items():
@@ -59,6 +64,11 @@ def translate_pg_resources(
             out[f"{k}_group_{bundle_index}_{hex_id}"] = v
         else:
             out[f"{k}_group_{hex_id}"] = v
+    if not out:
+        # zero-resource request must still land inside the group: consume a
+        # sliver of the synthetic per-bundle marker resource
+        suffix = f"{bundle_index}_{hex_id}" if bundle_index >= 0 else hex_id
+        out[f"bundle_group_{suffix}"] = 0.001
     return out
 
 

@@ -17,18 +17,21 @@ from typing import Dict, List, Optional
 from ray_tpu._private import worker as worker_mod
 from ray_tpu.util.placement_group import PlacementGroup, placement_group
 
-# chips per host for common machine shapes
+# chips per host for common machine shapes (default for slice_placement_group)
 HOST_CHIPS = {"v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
 
 
 def slice_placement_group(
     num_hosts: int,
-    tpu_per_host: int = 4,
+    tpu_per_host: Optional[int] = None,
+    generation: str = "v5e",
     cpu_per_host: float = 1.0,
     name: str = "",
 ) -> PlacementGroup:
     """Reserve one bundle per host of a single TPU slice (gang semantics:
     STRICT_SPREAD across hosts + all hosts in the same slice; atomic)."""
+    if tpu_per_host is None:
+        tpu_per_host = HOST_CHIPS.get(generation, 4)
     bundle = {"CPU": cpu_per_host, "TPU": float(tpu_per_host)}
     return placement_group(
         [dict(bundle) for _ in range(num_hosts)],

@@ -1,0 +1,103 @@
+"""Collective group API across actor processes.
+
+(reference surfaces: python/ray/util/collective/tests/ —
+test_allreduce/allgather/reducescatter/broadcast/sendrecv.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote(num_cpus=0)
+class Rank:
+    def __init__(self, world_size, rank, group="g"):
+        from ray_tpu.util import collective as col
+
+        self.col = col
+        self.rank = rank
+        col.init_collective_group(world_size, rank, backend="host", group_name=group)
+        self.group = group
+
+    def allreduce(self, value):
+        out = self.col.allreduce(np.asarray(value, dtype=np.float64), self.group)
+        return out
+
+    def allgather(self, value):
+        return self.col.allgather(np.asarray(value), self.group)
+
+    def reducescatter(self, value):
+        return self.col.reducescatter(np.asarray(value, dtype=np.float64), self.group)
+
+    def broadcast(self, value, src):
+        return self.col.broadcast(np.asarray(value), src_rank=src, group_name=self.group)
+
+    def barrier_then(self, value):
+        self.col.barrier(self.group)
+        return value
+
+    def do_send(self, value, dst):
+        self.col.send(np.asarray(value), dst, self.group)
+        return True
+
+    def do_recv(self, src):
+        return self.col.recv(src, self.group)
+
+    def rank_info(self):
+        return (self.col.get_rank(self.group), self.col.get_collective_group_size(self.group))
+
+
+@pytest.fixture
+def world(ray_start_regular):
+    ws = 3
+    ranks = [Rank.remote(ws, r) for r in range(ws)]
+    # wait for all inits to complete (group join is part of __init__)
+    ray_tpu.get([r.rank_info.remote() for r in ranks], timeout=60)
+    yield ranks
+
+
+def test_allreduce(world):
+    outs = ray_tpu.get(
+        [r.allreduce.remote(float(i + 1)) for i, r in enumerate(world)], timeout=60
+    )
+    assert all(float(o) == 6.0 for o in outs)
+
+
+def test_allgather(world):
+    outs = ray_tpu.get(
+        [r.allgather.remote([i, i]) for i, r in enumerate(world)], timeout=60
+    )
+    for o in outs:
+        assert [list(x) for x in o] == [[0, 0], [1, 1], [2, 2]]
+
+
+def test_reducescatter(world):
+    # each rank contributes [1..6]; sum = [3,6,9,12,15,18]; shards of 2
+    outs = ray_tpu.get(
+        [r.reducescatter.remote(np.arange(1, 7)) for r in world], timeout=60
+    )
+    assert [list(o) for o in outs] == [[3.0, 6.0], [9.0, 12.0], [15.0, 18.0]]
+
+
+def test_broadcast(world):
+    outs = ray_tpu.get(
+        [r.broadcast.remote([100 + i], 1) for i, r in enumerate(world)], timeout=60
+    )
+    assert [list(o) for o in outs] == [[101], [101], [101]]
+
+
+def test_barrier(world):
+    assert ray_tpu.get([r.barrier_then.remote(i) for i, r in enumerate(world)], timeout=60) == [0, 1, 2]
+
+
+def test_send_recv(world):
+    send_ref = world[0].do_send.remote([7, 8, 9], 2)
+    out = ray_tpu.get(world[2].do_recv.remote(0), timeout=60)
+    assert list(out) == [7, 8, 9]
+    assert ray_tpu.get(send_ref, timeout=60)
+
+
+def test_rank_info(world):
+    infos = ray_tpu.get([r.rank_info.remote() for r in world], timeout=60)
+    assert infos == [(0, 3), (1, 3), (2, 3)]
