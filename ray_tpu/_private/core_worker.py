@@ -93,7 +93,10 @@ class _RefCollectingPickler(cloudpickle.Pickler):
         if isinstance(obj, ObjectID):
             self.refs.append(obj)
             return (ObjectID, (obj.binary(),))
-        return NotImplemented
+        # cloudpickle implements function/class-by-value in its own
+        # reducer_override — returning NotImplemented here would silently
+        # fall back to by-reference pickling and break closures
+        return super().reducer_override(obj)
 
 
 def _serialize_with_refs(obj: Any) -> Tuple[bytes, List[ObjectID]]:
@@ -130,6 +133,7 @@ class CoreWorker:
 
         self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
         self.gcs.call("subscribe", "actors")  # actor address/state updates
+        self.gcs.call("subscribe", "nodes")  # node death -> drop stale addrs
         self.raylet = RpcClient(raylet_address)
         reg = self.raylet.call(
             "register_worker",
@@ -957,6 +961,11 @@ class CoreWorker:
                     pass
 
     def _on_gcs_notify(self, channel: str, message: Any):
+        if channel == "nodes":
+            if message.get("event") == "removed":
+                node = message["node"]
+                self._node_addr_cache.pop(node["node_id"], None)
+            return
         if channel == "actors" or channel.startswith("actor:"):
             actor_id = message["actor_id"]
             with self._actor_lock:

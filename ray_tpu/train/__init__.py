@@ -1,0 +1,52 @@
+"""Distributed training on TPU: trainers, sessions, checkpoints.
+
+(reference: python/ray/train + python/ray/air — SURVEY.md §3.4.)
+"""
+
+from ray_tpu.train.backend_executor import BackendExecutor, JaxConfig, TrainingFailedError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.result import Result
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_dataset_shard,
+    get_experiment_name,
+    get_local_rank,
+    get_trial_id,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+
+__all__ = [
+    "BackendExecutor",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingFailedError",
+    "WorkerGroup",
+    "get_checkpoint",
+    "get_dataset_shard",
+    "get_experiment_name",
+    "get_local_rank",
+    "get_trial_id",
+    "get_world_rank",
+    "get_world_size",
+    "report",
+]

@@ -1,0 +1,80 @@
+"""Checkpoint: the universal training-state currency.
+
+Dict ⇄ directory interconvertible (reference: python/ray/air/checkpoint.py:66).
+On TPU the dict form typically holds jax pytrees of numpy arrays (host-side);
+sharded on-device state is gathered per-host before checkpointing, or written
+as one orbax-style per-host shard directory.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+_DICT_FILE = "checkpoint.pkl"
+
+
+class Checkpoint:
+    def __init__(
+        self, data: Optional[Dict[str, Any]] = None, path: Optional[str] = None
+    ):
+        if (data is None) == (path is None):
+            raise ValueError("exactly one of data / path required")
+        self._data = data
+        self._path = path
+
+    # -- constructors --
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(path=path)
+
+    # -- converters --
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self._data is not None:
+            return dict(self._data)
+        file = os.path.join(self._path, _DICT_FILE)
+        if os.path.exists(file):
+            with open(file, "rb") as f:
+                return pickle.load(f)
+        # directory checkpoint without a dict payload: expose the file map
+        return {"_directory": self._path}
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="raytpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        if self._path is not None:
+            if os.path.abspath(self._path) != os.path.abspath(path):
+                shutil.copytree(self._path, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, _DICT_FILE), "wb") as f:
+                pickle.dump(self._data, f, protocol=5)
+        return path
+
+    @property
+    def uri(self) -> Optional[str]:
+        return f"file://{self._path}" if self._path else None
+
+    def __reduce__(self):
+        # ship as a dict so cross-node consumers don't need the path
+        # (module-level fn: bound classmethods don't pickle by reference)
+        return (_checkpoint_from_dict, (self.to_dict(),))
+
+    def __repr__(self):
+        src = self._path if self._path else f"dict[{len(self._data)} keys]"
+        return f"Checkpoint({src})"
+
+
+def _checkpoint_from_dict(data: Dict[str, Any]) -> "Checkpoint":
+    return Checkpoint.from_dict(data)

@@ -1,0 +1,68 @@
+"""Checkpoint retention: rank + persist reported checkpoints.
+
+(reference: python/ray/air/_internal/checkpoint_manager.py — keep
+``num_to_keep`` best by score attribute, persist to the run's storage path.)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import CheckpointConfig
+
+
+class CheckpointManager:
+    def __init__(self, storage_path: str, config: CheckpointConfig):
+        self.storage_path = storage_path
+        self.config = config
+        os.makedirs(storage_path, exist_ok=True)
+        self._entries: List[Tuple[float, str]] = []  # (score, dir)
+        self._counter = 0
+        self.latest: Optional[Checkpoint] = None
+        self.latest_path: Optional[str] = None
+        # a pruned-by-score dir that is still the latest stays on disk until
+        # the latest pointer moves past it
+        self._deferred_delete: Optional[str] = None
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> str:
+        self._counter += 1
+        path = os.path.join(self.storage_path, f"checkpoint_{self._counter:06d}")
+        checkpoint.to_directory(path)
+        self.latest = Checkpoint.from_directory(path)
+        self.latest_path = path
+        if self._deferred_delete and self._deferred_delete != path:
+            shutil.rmtree(self._deferred_delete, ignore_errors=True)
+            self._deferred_delete = None
+        score = self._score(metrics)
+        self._entries.append((score, path))
+        self._prune()
+        return path
+
+    def _score(self, metrics: Dict[str, Any]) -> float:
+        attr = self.config.checkpoint_score_attribute
+        if attr is None or attr not in metrics:
+            return float(self._counter)  # recency
+        value = float(metrics[attr])
+        return value if self.config.checkpoint_score_order == "max" else -value
+
+    def _prune(self):
+        keep = self.config.num_to_keep
+        if keep is None or len(self._entries) <= keep:
+            return
+        self._entries.sort(key=lambda e: e[0], reverse=True)
+        for _, path in self._entries[keep:]:
+            if path != self.latest_path:
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                self._deferred_delete = path
+        self._entries = self._entries[:keep]
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return self.latest
+        best_path = max(self._entries, key=lambda e: e[0])[1]
+        return Checkpoint.from_directory(best_path)

@@ -1,0 +1,62 @@
+"""Training run configuration objects.
+
+(reference: python/ray/air/config.py — ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig; TPU additions: tpu_per_worker + gang placement over a pod
+slice instead of GPU counts.)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    One worker == one process == (on TPU) one host of a slice driving its
+    local chips via jax; ``use_tpu`` gang-schedules the group onto a single
+    slice (STRICT_SPREAD + slice-id equality).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpu_per_worker: int = 0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and self.tpu_per_worker:
+            res["TPU"] = float(self.tpu_per_worker)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """max_failures < 0 means retry forever (reference: air/config.py)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

@@ -1,0 +1,104 @@
+"""Per-worker training session: the in-loop API.
+
+User training loops call ``report(metrics, checkpoint=...)`` and the rank
+accessors (reference: python/ray/air/session.py:43 report, :359
+get_dataset_shard; impl train/_internal/session.py:427). The session is a
+process-global set up by the train worker actor before the user loop runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class _Session:
+    def __init__(
+        self,
+        world_size: int,
+        world_rank: int,
+        local_rank: int,
+        checkpoint: Optional[Checkpoint],
+        dataset_shards: Optional[Dict[str, Any]] = None,
+        experiment_name: str = "",
+        trial_id: str = "",
+    ):
+        self.world_size = world_size
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.experiment_name = experiment_name
+        self.trial_id = trial_id
+        self.reports: List[Dict[str, Any]] = []
+        self.lock = threading.Lock()
+        self.finished = threading.Event()
+
+
+_session: Optional[_Session] = None
+_session_lock = threading.Lock()
+
+
+def _init_session(**kwargs) -> _Session:
+    global _session
+    with _session_lock:
+        _session = _Session(**kwargs)
+        return _session
+
+
+def _shutdown_session():
+    global _session
+    with _session_lock:
+        if _session is not None:
+            _session.finished.set()
+        _session = None
+
+
+def _get_session() -> _Session:
+    if _session is None:
+        raise RuntimeError(
+            "not inside a training session (call this from a train loop "
+            "launched by a Trainer)"
+        )
+    return _session
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) to the driver."""
+    s = _get_session()
+    entry: Dict[str, Any] = {"metrics": dict(metrics)}
+    if checkpoint is not None:
+        entry["checkpoint"] = checkpoint
+    with s.lock:
+        s.reports.append(entry)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set on restart/resume)."""
+    return _get_session().loaded_checkpoint
+
+
+def get_world_size() -> int:
+    return _get_session().world_size
+
+
+def get_world_rank() -> int:
+    return _get_session().world_rank
+
+
+def get_local_rank() -> int:
+    return _get_session().local_rank
+
+
+def get_dataset_shard(dataset_name: str = "train"):
+    return _get_session().dataset_shards.get(dataset_name)
+
+
+def get_experiment_name() -> str:
+    return _get_session().experiment_name
+
+
+def get_trial_id() -> str:
+    return _get_session().trial_id

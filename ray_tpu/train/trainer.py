@@ -1,0 +1,174 @@
+"""Trainers: BaseTrainer → DataParallelTrainer → JaxTrainer.
+
+(reference: python/ray/train/base_trainer.py:556 fit,
+train/data_parallel_trainer.py:387 training_loop. The reference runs fit()
+as a Tune trial; here fit() drives the BackendExecutor directly and the Tune
+integration wraps a trainer the same way, ray_tpu/tune.)
+
+The TPU replacement for TorchTrainer: the user's ``train_loop_per_worker``
+runs once per slice host, uses ``ray_tpu.train.session`` for
+report/checkpoint, and builds its SPMD mesh with ray_tpu.parallel over the
+host's chips (single-host) or jax.distributed (multi-host).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.backend_executor import BackendExecutor, JaxConfig, TrainingFailedError
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+
+logger = logging.getLogger(__name__)
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs one copy of ``train_loop_per_worker`` per worker; data is split
+    across workers; gradients sync inside the loop (host collectives for CPU
+    tensors, in-program XLA collectives for device state)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[JaxConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config
+        self.datasets = datasets or {}
+
+    # -- dataset sharding -------------------------------------------------
+
+    def _shard_datasets(self, num_workers: int) -> Optional[List[Dict[str, Any]]]:
+        if not self.datasets:
+            return None
+        shards: List[Dict[str, Any]] = [dict() for _ in range(num_workers)]
+        for name, ds in self.datasets.items():
+            split = getattr(ds, "split", None)
+            if callable(split):
+                parts = split(num_workers, equal=True)
+            elif isinstance(ds, (list, tuple)):
+                parts = [list(ds[i::num_workers]) for i in range(num_workers)]
+            else:
+                parts = [ds] * num_workers  # replicate opaque objects
+            for i in range(num_workers):
+                shards[i][name] = parts[i]
+        return shards
+
+    # -- the fit loop -----------------------------------------------------
+
+    def fit(self) -> Result:
+        failures_allowed = self.run_config.failure_config.max_failures
+        ckpt_manager = CheckpointManager(
+            self.run_config.resolved_storage_path(),
+            self.run_config.checkpoint_config,
+        )
+        resume = self.resume_from_checkpoint
+        history: List[Dict[str, Any]] = []
+        attempt = 0
+        while True:
+            attempt += 1
+            executor = BackendExecutor(self.scaling_config, self.backend_config)
+            error: Optional[BaseException] = None
+            try:
+                executor.start()
+                run_refs = executor.start_training(
+                    self.train_loop_per_worker,
+                    self.train_loop_config,
+                    resume,
+                    self._shard_datasets(self.scaling_config.num_workers),
+                    experiment_name=self.run_config.name or "",
+                )
+                self._drive(executor, run_refs, ckpt_manager, history)
+            except Exception as e:  # noqa: BLE001
+                error = e
+            finally:
+                executor.shutdown()
+            if error is None:
+                return Result(
+                    metrics=history[-1] if history else {},
+                    checkpoint=ckpt_manager.latest,
+                    metrics_history=history,
+                    path=ckpt_manager.storage_path,
+                )
+            if failures_allowed != 0 and (
+                failures_allowed < 0 or attempt <= failures_allowed
+            ):
+                logger.warning(
+                    "training attempt %d failed (%r); restarting from %s",
+                    attempt,
+                    error,
+                    "latest checkpoint" if ckpt_manager.latest else "scratch",
+                )
+                resume = ckpt_manager.latest or self.resume_from_checkpoint
+                continue
+            return Result(
+                metrics=history[-1] if history else {},
+                checkpoint=ckpt_manager.latest,
+                error=error,
+                metrics_history=history,
+                path=ckpt_manager.storage_path,
+            )
+
+    def _drive(
+        self,
+        executor: BackendExecutor,
+        run_refs: List,
+        ckpt_manager: CheckpointManager,
+        history: List[Dict[str, Any]],
+    ):
+        """Poll rank 0's reports until every rank's loop returns."""
+        seen = 0
+        pending = list(run_refs)
+        while pending:
+            done, pending = ray_tpu.wait(
+                pending, num_returns=len(pending), timeout=0.2
+            )
+            for entry in executor.poll_reports(0, seen):
+                seen += 1
+                metrics = entry["metrics"]
+                history.append(metrics)
+                if "checkpoint" in entry:
+                    ckpt_manager.register(entry["checkpoint"], metrics)
+            if done:
+                ray_tpu.get(done)  # surface worker exceptions
+        # drain reports that landed after the last wait
+        for entry in executor.poll_reports(0, seen):
+            seen += 1
+            history.append(entry["metrics"])
+            if "checkpoint" in entry:
+                ckpt_manager.register(entry["checkpoint"], entry["metrics"])
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Alias with jax backend defaults (the TorchTrainer counterpart)."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        kwargs.setdefault("backend_config", JaxConfig())
+        super().__init__(train_loop_per_worker, **kwargs)

@@ -1,0 +1,164 @@
+"""WorkerGroup: a gang of train-worker actors.
+
+(reference: python/ray/train/_internal/worker_group.py:100 — here the gang is
+placement-group backed, and on TPU it is one worker per slice host.)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+
+logger = logging.getLogger(__name__)
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """Hosts one rank of the training job. ``run`` executes the user loop;
+    ``poll_reports`` / ``finished`` are called concurrently by the driver
+    (max_concurrency set at creation)."""
+
+    def __init__(self, world_size: int, rank: int, coordinator: Dict[str, Any]):
+        self.world_size = world_size
+        self.rank = rank
+        os.environ["RAYTPU_TRAIN_WORLD_SIZE"] = str(world_size)
+        os.environ["RAYTPU_TRAIN_RANK"] = str(rank)
+        for k, v in (coordinator or {}).items():
+            os.environ[k] = str(v)
+        self._session = None
+        self._error: Optional[str] = None
+
+    def make_coordinator(self) -> str:
+        """Rank 0 picks a coordinator address ON ITS OWN HOST (multi-host
+        jax.distributed needs a port reachable from every other rank; a
+        driver-probed port would be on the wrong machine)."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = "127.0.0.1"
+        return f"{host}:{port}"
+
+    def set_coordinator(self, address: str) -> bool:
+        os.environ["RAYTPU_COORDINATOR_ADDRESS"] = address
+        os.environ["JAX_COORDINATOR_ADDRESS"] = address
+        return True
+
+    def setup_collective(self, group_name: str) -> bool:
+        """Join the gang's host collective group (the DDP-equivalent plane
+        for host tensors; device tensors use in-program XLA collectives)."""
+        from ray_tpu.util import collective
+
+        if not collective.is_group_initialized(group_name):
+            collective.init_collective_group(
+                self.world_size, self.rank, backend="host", group_name=group_name
+            )
+        return True
+
+    def run(
+        self,
+        train_fn: Callable,
+        config: Dict[str, Any],
+        checkpoint: Optional[Checkpoint],
+        dataset_shard: Optional[Dict[str, Any]],
+        experiment_name: str = "",
+    ):
+        """Run the user training loop to completion (blocking actor call)."""
+        self._session = session_mod._init_session(
+            world_size=self.world_size,
+            world_rank=self.rank,
+            local_rank=0,
+            checkpoint=checkpoint,
+            dataset_shards=dataset_shard,
+            experiment_name=experiment_name,
+        )
+        try:
+            import inspect
+
+            params = [
+                p
+                for p in inspect.signature(train_fn).parameters.values()
+                if p.kind
+                in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+            ]
+            return train_fn(config or {}) if params else train_fn()
+        finally:
+            self._session.finished.set()
+
+    def poll_reports(self, start: int) -> List[Dict[str, Any]]:
+        s = self._session
+        if s is None:
+            return []
+        with s.lock:
+            return s.reports[start:]
+
+    def ping(self) -> int:
+        return self.rank
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_group=None,
+        coordinator: Optional[Dict[str, Any]] = None,
+    ):
+        self.num_workers = num_workers
+        cpus = resources_per_worker.get("CPU", 1.0)
+        tpus = resources_per_worker.get("TPU", 0.0)
+        extra = {
+            k: v for k, v in resources_per_worker.items() if k not in ("CPU", "TPU")
+        }
+        self.workers = []
+        for rank in range(num_workers):
+            cls = TrainWorker.options(
+                num_cpus=cpus,
+                num_tpus=tpus or None,
+                resources=extra or None,
+                max_concurrency=4,
+                **(
+                    {
+                        "scheduling_strategy": _pg_strategy(placement_group, rank),
+                    }
+                    if placement_group is not None
+                    else {}
+                ),
+            )
+            self.workers.append(cls.remote(num_workers, rank, coordinator or {}))
+
+    def execute(self, method: str, *args, timeout: Optional[float] = None, **kwargs):
+        """Call a method on every worker; returns rank-ordered results."""
+        refs = [getattr(w, method).remote(*args, **kwargs) for w in self.workers]
+        return ray_tpu.get(refs, timeout=timeout)
+
+    def execute_async(self, method: str, *args, **kwargs):
+        return [getattr(w, method).remote(*args, **kwargs) for w in self.workers]
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+
+
+def _pg_strategy(pg, rank: int):
+    from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+    return PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=rank
+    )

@@ -80,7 +80,15 @@ def _get_or_create_store(group_name: str, world_size: int):
     deadline = time.monotonic() + 30.0
     while time.monotonic() < deadline:
         try:
-            return ray_tpu.get_actor(name)
+            handle = ray_tpu.get_actor(name)
+            existing = ray_tpu.get(handle.world.remote(), timeout=30.0)
+            if existing != world_size:
+                raise RuntimeError(
+                    f"collective group {group_name!r} already exists with "
+                    f"world_size={existing} (wanted {world_size}); a stale "
+                    f"store from a previous run? destroy it first"
+                )
+            return handle
         except ValueError:
             pass
         try:

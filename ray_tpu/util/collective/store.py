@@ -43,10 +43,25 @@ class CollectiveStore:
                 del self._pending[key]
                 self._cv.notify_all()
             else:
-                deadline = time.monotonic() + 600.0
+                # shorter than the clients' RPC timeout so THIS error (with
+                # arrival counts) reaches the caller, not a bare get-timeout
+                deadline = time.monotonic() + 90.0
                 while key not in self._done:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        # withdraw our contribution so a straggler completing
+                        # later doesn't see a half-failed collective succeed
+                        pend = self._pending.get(key)
+                        if pend is not None:
+                            pend.pop(rank, None)
+                            if not pend:
+                                del self._pending[key]
+                        else:
+                            entry = self._done.get(key)
+                            if entry is not None:
+                                entry["remaining"] -= 1
+                                if entry["remaining"] <= 0:
+                                    del self._done[key]
                         raise TimeoutError(
                             f"collective {key} timed out at rank {rank}: "
                             f"{len(self._pending.get(key, {}))}/{self.world_size} arrived"
@@ -55,7 +70,7 @@ class CollectiveStore:
             entry = self._done[key]
             values = entry["values"]
             entry["remaining"] -= 1
-            if entry["remaining"] == 0:
+            if entry["remaining"] <= 0:
                 del self._done[key]
             return values
 
