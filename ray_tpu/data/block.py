@@ -1,0 +1,96 @@
+"""Blocks: the unit of distributed data.
+
+A block is a pyarrow.Table living in the shared-memory object store,
+referenced by ObjectRef (reference: python/ray/data/block.py — Block =
+pyarrow.Table / pandas.DataFrame; BlockAccessor). Batches convert to
+numpy-dict (the jax-friendly format), pandas, or pyarrow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+VALID_BATCH_FORMATS = ("numpy", "pandas", "pyarrow", "default")
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
+    if not rows:
+        return pa.table({})
+    if not isinstance(rows[0], dict):
+        rows = [{"item": r} for r in rows]
+    return pa.Table.from_pylist(rows)
+
+
+def block_from_batch(batch: Any) -> Block:
+    """numpy-dict / pandas / pyarrow / list-of-rows -> Block."""
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        cols = {}
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if arr.ndim > 1:
+                # tensor column: store as fixed-size-list of flattened rows
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(arr.reshape(arr.shape[0], -1).ravel()),
+                    int(np.prod(arr.shape[1:])),
+                )
+            else:
+                cols[k] = pa.array(arr)
+        return pa.table(cols)
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return block_from_rows(batch)
+    raise TypeError(f"cannot build a block from {type(batch)}")
+
+
+def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
+    if batch_format in ("numpy", "default"):
+        out: Dict[str, np.ndarray] = {}
+        for name in block.column_names:
+            col = block.column(name)
+            if pa.types.is_fixed_size_list(col.type):
+                flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+                out[name] = flat.reshape(len(block), -1)
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format == "pyarrow":
+        return block
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def block_num_rows(block: Block) -> int:
+    return block.num_rows
+
+
+def block_rows(block: Block) -> List[Dict[str, Any]]:
+    return block.to_pylist()
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return block.slice(start, end - start)
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def block_schema(block: Block):
+    return block.schema
