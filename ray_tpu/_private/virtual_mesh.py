@@ -1,0 +1,70 @@
+"""Virtual multi-device CPU mesh bring-up (shared by tests and the driver).
+
+The environment's axon sitecustomize registers a single-chip TPU PJRT
+plugin in every Python process. Multi-chip sharding logic is validated on
+an n-device virtual CPU platform instead; this module is the one copy of
+the recipe (env guards for child processes + jax.config for this process).
+
+Reference analogue: the conftest trick in python/ray/tests/conftest.py of
+the upstream project — shape multi-node logic on one host.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def set_virtual_cpu_env(n_devices: int) -> None:
+    """Point env vars at an n-device CPU platform (children inherit them)."""
+    # Children of this process must not re-register the axon TPU plugin.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", opt, flags
+        )
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def ensure_virtual_devices(n_devices: int) -> None:
+    """Guarantee ≥ n_devices jax devices, virtualizing over CPU if needed.
+
+    On a real multi-chip platform the existing devices are used untouched.
+    Anywhere else (single-chip axon tunnel, CPU) the backend is (re)built as
+    an n-device virtual CPU platform. The known-single-chip axon tunnel is
+    detected from its env var so we never claim the real TPU just to count
+    devices.
+    """
+    import jax
+
+    single_chip_tunnel = (
+        "PALLAS_AXON_POOL_IPS" in os.environ and n_devices > 1
+    )
+    initialized = _backends_initialized()
+    if not single_chip_tunnel or initialized:
+        if len(jax.devices()) >= n_devices:
+            return
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+    set_virtual_cpu_env(n_devices)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    assert len(jax.devices()) >= n_devices, (
+        f"virtual CPU mesh bring-up failed: need {n_devices}, "
+        f"have {len(jax.devices())}"
+    )
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:
+        return False

@@ -15,12 +15,13 @@ JAX_PLATFORMS/XLA_FLAGS then give them the same virtual 8-device CPU mesh.
 """
 
 import os
+import sys
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_tpu._private.virtual_mesh import set_virtual_cpu_env
+
+set_virtual_cpu_env(8)
 
 import jax
 
