@@ -3,10 +3,16 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline normalizes achieved MFU against the 40% north-star from
 BASELINE.json (reference's GPT-J fine-tune target: ≥40% MFU on TPU).
+
+Default flagship is the 1B-param config (head_dim=128 → full MXU tiles);
+``--model 125m`` benches the small config. The train step runs the Pallas
+flash-attention forward+backward kernels (ray_tpu/ops/attention.py) and the
+blockwise cross-entropy (ray_tpu/models/gpt.py:blockwise_next_token_loss).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -19,7 +25,14 @@ TARGET_MFU = 0.40
 
 
 def main():
-    from ray_tpu.models.gpt import gpt_125m, gpt_nano, train_step_flops
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None, choices=["1b", "125m", "nano"])
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+
+    from ray_tpu.models.gpt import gpt_1b, gpt_125m, gpt_nano, train_step_flops
     from ray_tpu.models.training import (
         default_optimizer,
         init_sharded_state,
@@ -29,14 +42,22 @@ def main():
 
     platform = jax.devices()[0].platform
     on_tpu = platform not in ("cpu",)
-    if on_tpu:
+    if args.model is None:
+        args.model = "1b" if on_tpu else "nano"
+    if args.model == "1b":
+        # bf16 params+moments so the full Adam state fits one 16G chip; a
+        # real multi-chip run keeps f32 master state sharded over fsdp.
+        cfg = gpt_1b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+        batch, seq, iters = 8, 2048, 20
+    elif args.model == "125m":
         cfg = gpt_125m(dtype=jnp.bfloat16)
-        batch, seq = 16, 2048
-        iters = 30
+        batch, seq, iters = 16, 2048, 30
     else:
         cfg = gpt_nano()
-        batch, seq = 4, 128
-        iters = 3
+        batch, seq, iters = 4, 128, 3
+    batch = args.batch or batch
+    seq = args.seq or seq
+    iters = args.iters or iters
 
     mesh = MeshSpec().build(jax.devices()[:1])
     opt = default_optimizer(learning_rate=1e-4)
@@ -66,7 +87,7 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "gpt125m_train_tokens_per_sec_chip",
+                "metric": f"gpt{args.model}_train_tokens_per_sec_chip",
                 "value": round(tokens_per_s, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / TARGET_MFU, 4),

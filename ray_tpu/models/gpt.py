@@ -226,12 +226,16 @@ class ScannedBlocks(nn.Module):
 
 
 class GPT(nn.Module):
-    """Returns logits [batch, seq, vocab]."""
+    """Returns logits [batch, seq, vocab] — or, with ``return_hidden=True``,
+    ``(hidden, head_kernel, head_bias)`` so callers can run a blockwise
+    cross-entropy that never materializes the full [b, t, vocab] logits
+    (the dominant HBM cost of the train step at GPT-J vocab sizes)."""
 
     cfg: GPTConfig
+    return_hidden: bool = False
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None) -> jax.Array:
+    def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -251,12 +255,44 @@ class GPT(nn.Module):
         x = ScannedBlocks(cfg, name="blocks")(x, positions)
         x = _layer_norm(cfg, "ln_f")(x)
         if cfg.tie_embeddings:
-            logits = embed.attend(x.astype(cfg.param_dtype))
+            kernel = embed.embedding.T  # [d, vocab]
+            bias = None
         else:
-            logits = _dense((cfg.vocab_size,), ("embed", "vocab"), cfg, "lm_head")(x)
+            kernel, bias = LMHead(cfg, name="lm_head")()
+        if self.return_hidden:
+            return x, kernel, bias
+        logits = x.astype(cfg.dtype) @ kernel.astype(cfg.dtype)
+        if bias is not None:
+            logits = logits + bias
         return nn.with_logical_constraint(
             logits.astype(jnp.float32), ("batch", "seq", "act_vocab")
         )
+
+
+class LMHead(nn.Module):
+    """Owns the untied lm_head params (same tree as the former DenseGeneral:
+    lm_head/{kernel,bias}) and returns them as arrays."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self):
+        cfg = self.cfg
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "vocab")
+            ),
+            (cfg.embed_dim, cfg.vocab_size),
+            cfg.param_dtype,
+        )
+        bias = self.param(
+            "bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("vocab",)),
+            (cfg.vocab_size,),
+            cfg.param_dtype,
+        )
+        return kernel, bias
 
 
 # ---------------------------------------------------------------------------
@@ -275,6 +311,57 @@ def next_token_loss(logits: jax.Array, tokens: jax.Array,
         m = mask[:, 1:].astype(jnp.float32)
         return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
     return nll.mean()
+
+
+def blockwise_next_token_loss(
+    hidden: jax.Array,
+    head_kernel: jax.Array,
+    head_bias: Optional[jax.Array],
+    tokens: jax.Array,
+    mask: Optional[jax.Array] = None,
+    chunk: int = 256,
+) -> jax.Array:
+    """Mean next-token cross-entropy without materializing [b, t, vocab].
+
+    Scans over sequence chunks; each chunk's logits are computed, reduced to
+    (logsumexp, target-logit) and rematerialized in the backward pass
+    (jax.checkpoint), so peak HBM holds one [b, chunk, vocab] block instead
+    of three full-size f32 logit tensors. This is the XLA-friendly
+    equivalent of a fused cross-entropy kernel.
+    """
+    b, t, d = hidden.shape
+    xs = hidden[:, :-1]
+    targets = tokens[:, 1:]
+    n = t - 1
+    valid = jnp.ones((b, n), jnp.float32) if mask is None else mask[:, 1:].astype(jnp.float32)
+    pad = (-n) % chunk
+    if pad:
+        xs = jnp.pad(xs, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    nc = (n + pad) // chunk
+    xs = xs.reshape(b, nc, chunk, d).swapaxes(0, 1)        # [nc, b, chunk, d]
+    targets = targets.reshape(b, nc, chunk).swapaxes(0, 1)
+    valid = valid.reshape(b, nc, chunk).swapaxes(0, 1)
+
+    compute_dtype = hidden.dtype
+
+    @jax.checkpoint
+    def chunk_nll(x_c, t_c, m_c):
+        logits = (x_c.astype(compute_dtype) @ head_kernel.astype(compute_dtype)).astype(
+            jnp.float32
+        )
+        if head_bias is not None:
+            logits = logits + head_bias.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return ((lse - tl) * m_c).sum()
+
+    def body(acc, args):
+        return acc + chunk_nll(*args), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, targets, valid))
+    return total / jnp.maximum(valid.sum(), 1.0)
 
 
 def train_step_flops(cfg: GPTConfig, batch: int, seq: int) -> float:

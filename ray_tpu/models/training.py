@@ -21,7 +21,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ray_tpu.models.gpt import GPT, GPTConfig, next_token_loss
+from ray_tpu.models.gpt import (
+    GPT,
+    GPTConfig,
+    blockwise_next_token_loss,
+    next_token_loss,
+)
 from ray_tpu.parallel import sharding as shd
 
 
@@ -134,7 +139,7 @@ def make_train_step(
     donate: bool = True,
 ) -> Callable:
     """Build `step(state, tokens) -> (state, metrics)`, jitted with shardings."""
-    model = GPT(cfg)
+    model = GPT(cfg, return_hidden=True)
     active_rules = list(rules if rules is not None else shd.DEFAULT_RULES)
 
     def loss_fn(params, tokens):
@@ -143,10 +148,11 @@ def make_train_step(
             # with_logical_constraint calls reach XLA (they are silent
             # no-ops when no rules are set).
             with nn.logical_axis_rules(active_rules):
-                logits = model.apply({"params": params}, tokens)
+                hidden, kernel, bias = model.apply({"params": params}, tokens)
         else:
-            logits = model.apply({"params": params}, tokens)
-        return next_token_loss(logits, tokens)
+            hidden, kernel, bias = model.apply({"params": params}, tokens)
+        # Blockwise xent: never materializes the [b, t, vocab] logits.
+        return blockwise_next_token_loss(hidden, kernel, bias, tokens)
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
@@ -177,12 +183,12 @@ def make_train_step(
 
 
 def make_eval_step(cfg: GPTConfig) -> Callable:
-    model = GPT(cfg)
+    model = GPT(cfg, return_hidden=True)
 
     @jax.jit
     def eval_step(params, tokens):
-        logits = model.apply({"params": params}, tokens)
-        return next_token_loss(logits, tokens)
+        hidden, kernel, bias = model.apply({"params": params}, tokens)
+        return blockwise_next_token_loss(hidden, kernel, bias, tokens)
 
     return eval_step
 

@@ -74,7 +74,7 @@ def _attention_xla(
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, causal, scale, block_q, block_k, q_len, kv_len
 ):
     from jax.experimental import pallas as pl
@@ -143,8 +143,12 @@ def _flash_fwd_kernel(
 
     @pl.when(ki == nk - 1)
     def _finish():
-        denom = jnp.where(l_ref[:, 0] == 0.0, 1.0, l_ref[:, 0])
+        l = l_ref[:, 0]
+        denom = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / denom[:, None]).astype(o_ref.dtype)
+        # logsumexp over the scaled+masked logits; rows with no valid kv
+        # (cannot happen for causal self-attention) would be -inf.
+        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(denom)
 
 
 def _flash_attention_tpu(
@@ -157,7 +161,8 @@ def _flash_attention_tpu(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
-) -> jax.Array:
+):
+    """Returns (out [b,h,t_q,d], lse [b,h,t_q] float32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -179,7 +184,7 @@ def _flash_attention_tpu(
         q_len=t_q,
         kv_len=t_kv,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -187,8 +192,16 @@ def _flash_attention_tpu(
             pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            # [bh, t_q, 1]: trailing dim of 1 equals the full array dim,
+            # which keeps the block shape legal for TPU (8,128) tiling.
+            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -196,7 +209,241 @@ def _flash_attention_tpu(
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, t_q, d)
+    return out.reshape(b, h, t_q, d), lse.reshape(b, h, t_q)
+
+
+def _row_block_specs(block_q, transposed_grid=False):
+    """BlockSpec for [bh, t_q, 1] row statistics (lse/delta)."""
+    from jax.experimental import pallas as pl
+
+    if transposed_grid:  # grid (bh, kv, q): q index is the 3rd grid axis
+        return pl.BlockSpec((1, block_q, 1), lambda bhi, j, i: (bhi, i, 0))
+    return pl.BlockSpec((1, block_q, 1), lambda bhi, i, j: (bhi, i, 0))
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernels (backward)
+#
+# FlashAttention-2 style: recompute P = exp(S - lse) per block; one kernel
+# accumulates dQ (kv innermost), a second accumulates dK/dV (q innermost).
+# delta = rowsum(dO * O) is computed in plain XLA beforehand.
+# ---------------------------------------------------------------------------
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, causal, scale, block_q, block_k, q_len, kv_len
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if kv_len % block_k != 0:
+            kv_valid = (
+                ki * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, (block_k, 1), 0)
+            ) < kv_len
+            k = jnp.where(kv_valid, k, 0.0)
+            v = jnp.where(kv_valid, v, 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = (
+                qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                + (kv_len - q_len)
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len % block_k != 0:
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if causal and q_len > kv_len:
+            # Rows with no visible kv (possible when q extends past kv) have
+            # lse == NEG_INF, making exp(s - lse) == 1 instead of 0.
+            p = jnp.where(lse[:, None] > NEG_INF / 2, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        fully_masked = (qi * block_q + block_q - 1 + (kv_len - q_len)) < ki * block_k
+
+        @pl.when(jnp.logical_not(fully_masked))
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, causal, scale, block_q, block_k, q_len, kv_len
+):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        ragged_q = q_len % block_q != 0
+        if ragged_q:
+            q_valid = (
+                qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+            ) < q_len
+            q = jnp.where(q_valid, q, 0.0)
+            do = jnp.where(q_valid, do, 0.0)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        if causal:
+            q_pos = (
+                qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                + (kv_len - q_len)
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if kv_len % block_k != 0:
+            s = jnp.where(k_pos < kv_len, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if causal and q_len > kv_len:
+            # Same NEG_INF-sentinel guard as the dq kernel: empty rows must
+            # not contribute to dk/dv.
+            p = jnp.where(lse[:, None] > NEG_INF / 2, p, 0.0)
+        if ragged_q:
+            # lse/delta of padded q rows are undefined (possibly nan) —
+            # zero those rows explicitly before they touch the MXU.
+            p = jnp.where(q_valid, p, 0.0)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        if ragged_q:
+            ds = jnp.where(q_valid, ds, 0.0)
+        dk_acc_ref[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        fully_masked = (qi * block_q + block_q - 1 + (kv_len - q_len)) < ki * block_k
+
+        @pl.when(jnp.logical_not(fully_masked))
+        def _run():
+            _body()
+    else:
+        _body()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_attention_tpu_bwd(
+    q, k, v, o, lse, g, *, causal, scale, block_q, block_k, interpret=False
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[-2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_kv)
+    bh = b * h
+    qr = q.reshape(bh, t_q, d)
+    kr = k.reshape(bh, t_kv, d)
+    vr = v.reshape(bh, t_kv, d)
+    dor = g.reshape(bh, t_q, d)
+    lser = lse.reshape(bh, t_q, 1)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(bh, t_q, 1)
+
+    common = dict(
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        q_len=t_q, kv_len=t_kv,
+    )
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bhi, i, j: (bhi, i, 0))
+    row_spec = _row_block_specs(block_q)
+    kv_spec_dq = pl.BlockSpec((1, block_k, d), lambda bhi, i, j: (bhi, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, pl.cdiv(t_q, block_q), pl.cdiv(t_kv, block_k)),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    # dk/dv pass: kv block is the resident tile; iterate q blocks innermost.
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bhi, j, i: (bhi, i, 0))
+    row_spec2 = _row_block_specs(block_q, transposed_grid=True)
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda bhi, j, i: (bhi, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(bh, pl.cdiv(t_kv, block_k), pl.cdiv(t_q, block_q)),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+    return (
+        dq.reshape(b, h, t_q, d),
+        dk.reshape(b, h, t_kv, d),
+        dv.reshape(b, h, t_kv, d),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -221,9 +468,9 @@ def dot_product_attention(
 ) -> jax.Array:
     """Fused attention over [batch, heads, seq, head_dim] inputs.
 
-    Differentiable everywhere: the Pallas path is forward-only, so under
-    grad we use the XLA path (XLA's own flash-style fusion handles the
-    backward pass well on TPU; a custom_vjp pallas backward is future work).
+    Differentiable everywhere: forward and backward both run as Pallas
+    flash kernels (custom_vjp), with an O(T²) XLA fallback for CPU tests
+    and shapes the kernel cannot tile.
     """
     scale_val = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
     use = use_pallas if use_pallas is not None else _on_tpu()
@@ -231,38 +478,41 @@ def dot_product_attention(
     if (
         use
         and segment_ids is None
-        and d % 128 == 0
+        and (d % 128 == 0 or d == 64)
         and q.shape[-2] % 8 == 0
         and k.shape[-2] % 8 == 0
     ):
-        return _flash_attention_with_xla_grad(
-            q, k, v, causal=causal, scale=scale_val, block_q=block_q, block_k=block_k
+        return flash_attention(
+            q, k, v, causal, scale_val, block_q, block_k, False
         )
     return _attention_xla(q, k, v, causal=causal, scale=scale_val, segment_ids=segment_ids)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_with_xla_grad(q, k, v, causal, scale, block_q, block_k):
-    return _flash_attention_tpu(
-        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Flash attention with a full Pallas forward+backward (custom_vjp)."""
+    out, _ = _flash_attention_tpu(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_attention_tpu(
+        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    return _flash_attention_tpu_bwd(
+        q, k, v, out, lse, g,
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret,
     )
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _flash_attention_tpu(
-        q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
-    )
-    return out, (q, k, v)
-
-
-def _flash_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    # Backward through the XLA reference implementation (numerically matches
-    # the kernel; XLA fuses this into a memory-efficient backward on TPU).
-    _, vjp = jax.vjp(
-        lambda q, k, v: _attention_xla(q, k, v, causal=causal, scale=scale), q, k, v
-    )
-    return vjp(g)
-
-
-_flash_attention_with_xla_grad.defvjp(_flash_fwd, _flash_bwd)
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -9,6 +9,7 @@ from ray_tpu.ops.attention import (
     _attention_xla,
     _flash_attention_tpu,
     dot_product_attention,
+    flash_attention,
 )
 
 
@@ -18,10 +19,11 @@ def _rand(shape, seed):
 
 def _flash(q, k, v, causal, bq=64, bk=64):
     d = q.shape[-1]
-    return _flash_attention_tpu(
+    out, _ = _flash_attention_tpu(
         q, k, v, causal=causal, scale=1.0 / d**0.5,
         block_q=bq, block_k=bk, interpret=True,
     )
+    return out
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -54,3 +56,65 @@ def test_grad_flows_through_dispatcher():
     q, k, v = (_rand((1, 2, 64, 64), s) for s in (0, 1, 2))
     g = jax.grad(lambda q: dot_product_attention(q, k, v, causal=True).sum())(q)
     assert g.shape == q.shape and bool(jnp.isfinite(g).all())
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shape", [(2, 2, 128, 128), (1, 2, 192, 128)])
+def test_flash_backward_matches_xla(causal, shape):
+    """Pallas dq/dk/dv kernels (interpret mode) vs the XLA vjp."""
+    q, k, v = (_rand(shape, s) for s in (0, 1, 2))
+    scale = 1.0 / q.shape[-1] ** 0.5
+
+    def loss_ref(q, k, v):
+        o = _attention_xla(q, k, v, causal=causal, scale=scale)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal, scale, 64, 64, True)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_out = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-3, err_msg=f"d{name}")
+
+
+def test_flash_backward_q_longer_than_kv():
+    # causal with t_q > t_kv: leading q rows attend to nothing; their lse is
+    # the NEG_INF sentinel and must not leak p=1 into the backward. The XLA
+    # reference's softmax returns uniform probs for such rows (finite
+    # NEG_INF), so compare under a cotangent that zeroes the empty rows —
+    # there the two conventions' gradients provably agree.
+    q = _rand((1, 1, 160, 128), 0)
+    k, v = (_rand((1, 1, 64, 128), s) for s in (1, 2))
+    scale = 1.0 / 128**0.5
+    w = (jnp.arange(160) >= 160 - 64).astype(jnp.float32)[None, None, :, None]
+    g_ref = jax.grad(
+        lambda a: (_attention_xla(*a, causal=True, scale=scale) * w).sum(), 0
+    )((q, k, v))
+    g_out = jax.grad(
+        lambda a: (flash_attention(*a, True, scale, 64, 64, True) * w).sum(), 0
+    )((q, k, v))
+    for a, b, name in zip(g_out, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-3, err_msg=f"d{name}")
+    # and the flash-convention grads must at least be finite with a full
+    # cotangent (the p=1 leak produced O(10) garbage here)
+    g_full = jax.grad(lambda a: flash_attention(*a, True, scale, 64, 64, True).sum(), 0)(
+        (q, k, v)
+    )
+    for g in g_full:
+        assert bool(jnp.isfinite(g).all())
+
+
+def test_flash_backward_ragged_q_blocks():
+    # q_len not a multiple of block_q: padded rows must not poison dk/dv
+    q = _rand((1, 1, 96, 128), 0)
+    k, v = (_rand((1, 1, 96, 128), s) for s in (1, 2))
+    scale = 1.0 / 128**0.5
+    g_ref = jax.grad(
+        lambda k: _attention_xla(q, k, v, causal=True, scale=scale).sum(), 0
+    )(k)
+    g_out = jax.grad(
+        lambda k: flash_attention(q, k, v, True, scale, 64, 64, True).sum(), 0
+    )(k)
+    np.testing.assert_allclose(g_out, g_ref, atol=5e-5, rtol=1e-3)
