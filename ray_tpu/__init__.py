@@ -7,6 +7,14 @@ and collectives ride ICI/DCN via jax.sharding meshes.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# pyarrow's bundled mimalloc pool segfaults in mi_thread_init under heavy
+# thread churn (observed: NULL+0x18 deref when many short-lived rpc threads
+# make their first arrow allocation concurrently). The system allocator is
+# immune; set it before pyarrow is first imported.
+_os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
 from ray_tpu._private.worker import init, shutdown, is_initialized
 from ray_tpu.api import (
     ActorClass,

@@ -15,6 +15,9 @@ import threading
 
 
 def main():
+    import faulthandler
+
+    faulthandler.enable()  # native crashes leave a stack in the worker log
     logging.basicConfig(
         level=os.environ.get("RAYTPU_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

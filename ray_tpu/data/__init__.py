@@ -1,0 +1,42 @@
+"""ray_tpu.data: distributed datasets of Arrow blocks (reference:
+python/ray/data — Dataset over ObjectRef[Block], read API, iterators)."""
+
+from ray_tpu.data.block import (
+    Block,
+    block_from_batch,
+    block_from_rows,
+    block_to_batch,
+    concat_blocks,
+)
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedDataset
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "ActorPoolStrategy",
+    "Block",
+    "Dataset",
+    "GroupedDataset",
+    "block_from_batch",
+    "block_from_rows",
+    "block_to_batch",
+    "concat_blocks",
+    "from_arrow",
+    "from_blocks",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+]

@@ -8,10 +8,20 @@ numpy-dict (the jax-friendly format), pandas, or pyarrow.
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, Iterable, List, Optional
 
 import numpy as np
 import pyarrow as pa
+
+# If pyarrow was imported before ray_tpu set ARROW_DEFAULT_MEMORY_POOL, the
+# default pool may still be mimalloc, which crashes in mi_thread_init under
+# rpc thread churn — switch the pool at runtime as well.
+try:  # pragma: no cover - depends on import order
+    if pa.default_memory_pool().backend_name == "mimalloc":
+        pa.set_memory_pool(pa.system_memory_pool())
+except Exception:
+    pass
 
 Block = pa.Table
 
@@ -32,17 +42,25 @@ def block_from_batch(batch: Any) -> Block:
         return batch
     if isinstance(batch, dict):
         cols = {}
+        fields = []
         for k, v in batch.items():
             arr = np.asarray(v)
             if arr.ndim > 1:
-                # tensor column: store as fixed-size-list of flattened rows
-                cols[k] = pa.FixedSizeListArray.from_arrays(
+                # tensor column: fixed-size-list of flattened rows, with the
+                # element shape recorded in field metadata so round-trips
+                # restore the original dims (reference: ray.data's
+                # ArrowTensorArray extension type preserves element shape)
+                col = pa.FixedSizeListArray.from_arrays(
                     pa.array(arr.reshape(arr.shape[0], -1).ravel()),
                     int(np.prod(arr.shape[1:])),
                 )
+                meta = {b"tensor_shape": json.dumps(list(arr.shape[1:])).encode()}
+                fields.append(pa.field(k, col.type, metadata=meta))
             else:
-                cols[k] = pa.array(arr)
-        return pa.table(cols)
+                col = pa.array(arr)
+                fields.append(pa.field(k, col.type))
+            cols[k] = col
+        return pa.Table.from_arrays(list(cols.values()), schema=pa.schema(fields))
     try:
         import pandas as pd
 
@@ -58,11 +76,17 @@ def block_from_batch(batch: Any) -> Block:
 def block_to_batch(block: Block, batch_format: str = "numpy") -> Any:
     if batch_format in ("numpy", "default"):
         out: Dict[str, np.ndarray] = {}
-        for name in block.column_names:
+        for idx, name in enumerate(block.column_names):
             col = block.column(name)
             if pa.types.is_fixed_size_list(col.type):
                 flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
-                out[name] = flat.reshape(len(block), -1)
+                field = block.schema.field(idx)
+                meta = field.metadata or {}
+                if b"tensor_shape" in meta:
+                    shape = tuple(json.loads(meta[b"tensor_shape"].decode()))
+                    out[name] = flat.reshape((len(block),) + shape)
+                else:
+                    out[name] = flat.reshape(len(block), -1)
             else:
                 out[name] = col.to_numpy(zero_copy_only=False)
         return out

@@ -1,0 +1,731 @@
+"""Dataset: a distributed collection of Arrow blocks held by ObjectRef.
+
+TPU-native re-design of the reference's Ray Data core (reference:
+python/ray/data/dataset.py Dataset; _internal/plan.py;
+_internal/execution/streaming_executor.py:48). Differences by design:
+
+- Blocks are pyarrow Tables in the shared-memory object store; batches
+  surface as numpy dicts (the JAX-friendly zero-copy format) rather than
+  torch tensors.
+- Execution is eager-per-op but never materializes data on the driver:
+  every transform maps ObjectRef[Block] -> ObjectRef[Block] via tasks (or
+  an actor pool), and each task returns (block, meta) pairs so bookkeeping
+  (row counts, sizes) travels out-of-band from the data plane.
+- map_batches with fixed ``batch_size`` feeds XLA's static-shape
+  requirement: resulting blocks are exact batch multiples when
+  ``drop_last`` iterators are used downstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+@dataclasses.dataclass
+class BlockMeta:
+    num_rows: int
+    size_bytes: int
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """compute= strategy running the map fn on a pool of long-lived actors
+    (reference: data/_internal/execution/operators/actor_pool_map_operator.py)."""
+
+    size: int = 2
+    max_tasks_in_flight_per_actor: int = 2
+
+
+def _meta_of(block: B.Block) -> BlockMeta:
+    return BlockMeta(num_rows=block.num_rows, size_bytes=block.nbytes)
+
+
+# ---------------------------------------------------------------------------
+# remote task helpers (module-level so they pickle by reference)
+# ---------------------------------------------------------------------------
+
+
+def _apply_fn_to_block(
+    fn: Callable,
+    blk: B.Block,
+    batch_size: Optional[int],
+    batch_format: str,
+    fn_kwargs: Dict[str, Any],
+    mode: str,
+) -> B.Block:
+    if mode == "rows":  # map / filter / flat_map operate on rows
+        rows = B.block_rows(blk)
+        if fn_kwargs.get("_op") == "filter":
+            out_rows = [r for r in rows if fn(r)]
+        elif fn_kwargs.get("_op") == "flat_map":
+            out_rows = [o for r in rows for o in fn(r)]
+        else:
+            out_rows = [fn(r) for r in rows]
+        return B.block_from_rows(out_rows)
+    outs: List[B.Block] = []
+    n = blk.num_rows
+    step = batch_size or max(n, 1)
+    for start in range(0, max(n, 1), step):
+        sub = B.block_slice(blk, start, min(start + step, n))
+        batch = B.block_to_batch(sub, batch_format)
+        out = fn(batch, **fn_kwargs)
+        outs.append(B.block_from_batch(out))
+    return B.concat_blocks(outs)
+
+
+@ray_tpu.remote
+def _map_block_task(fn, blk, batch_size, batch_format, fn_kwargs, mode):
+    out = _apply_fn_to_block(fn, blk, batch_size, batch_format, fn_kwargs or {}, mode)
+    return out, _meta_of(out)
+
+
+@ray_tpu.remote
+def _slice_block_task(blk, start, end):
+    out = B.block_slice(blk, start, end)
+    return out, _meta_of(out)
+
+
+@ray_tpu.remote
+def _concat_blocks_task(*blks):
+    out = B.concat_blocks(list(blks))
+    return out, _meta_of(out)
+
+
+@ray_tpu.remote
+def _shuffle_partition_task(blk, n_parts, seed):
+    """Stage 1 of the all-to-all shuffle: assign rows to partitions."""
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n_parts, size=blk.num_rows)
+    return [blk.take(pa.array(np.nonzero(assign == j)[0])) for j in range(n_parts)]
+
+
+@ray_tpu.remote
+def _shuffle_reduce_task(seed, *parts):
+    merged = B.concat_blocks(list(parts))
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(merged.num_rows)
+    out = merged.take(pa.array(perm))
+    return out, _meta_of(out)
+
+
+@ray_tpu.remote
+def _sort_partition_task(blk, key, boundaries, descending):
+    """Range-partition one block by key against sampled boundaries."""
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    idx = np.searchsorted(boundaries, col, side="right")
+    if descending:
+        idx = len(boundaries) - idx
+    return [blk.take(pa.array(np.nonzero(idx == j)[0])) for j in range(len(boundaries) + 1)]
+
+
+@ray_tpu.remote
+def _sort_reduce_task(key, descending, *parts):
+    merged = B.concat_blocks(list(parts))
+    if merged.num_rows:
+        col = merged.column(key).to_numpy(zero_copy_only=False)
+        order = np.argsort(col, kind="stable")
+        if descending:
+            order = order[::-1]
+        merged = merged.take(pa.array(order))
+    return merged, _meta_of(merged)
+
+
+@ray_tpu.remote
+def _sample_task(blk, key, k, seed):
+    if blk.num_rows == 0:
+        return np.array([])
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    rng = np.random.default_rng(seed)
+    k = min(k, len(col))
+    return rng.choice(col, size=k, replace=False)
+
+
+@ray_tpu.remote
+def _groupby_partition_task(blk, key, n_parts):
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    h = np.array([hash(x) % n_parts for x in col.tolist()])
+    return [blk.take(pa.array(np.nonzero(h == j)[0])) for j in range(n_parts)]
+
+
+@ray_tpu.remote
+def _groupby_agg_task(key, aggs, *parts):
+    merged = B.concat_blocks(list(parts))
+    if merged.num_rows == 0:
+        return merged, _meta_of(merged)
+    df = merged.to_pandas()
+    g = df.groupby(key, sort=True)
+    pieces = {}
+    for out_name, (col, how) in aggs.items():
+        if how == "count":
+            pieces[out_name] = g.size()
+        else:
+            pieces[out_name] = getattr(g[col], how)()
+    import pandas as pd
+
+    out_df = pd.DataFrame(pieces).reset_index()
+    out = pa.Table.from_pandas(out_df, preserve_index=False)
+    return out, _meta_of(out)
+
+
+@ray_tpu.remote
+def _write_block_task(blk, path, fmt):
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(blk, path)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(blk, path)
+    else:
+        raise ValueError(fmt)
+    return path
+
+
+@ray_tpu.remote(max_concurrency=1)
+class _MapWorker:
+    """Actor-pool worker: applies a transform fn to blocks."""
+
+    def __init__(self, fn_constructor=None):
+        self._fn = fn_constructor() if fn_constructor is not None else None
+
+    def apply(self, fn, blk, batch_size, batch_format, fn_kwargs, mode):
+        use_fn = self._fn if self._fn is not None else fn
+        out = _apply_fn_to_block(
+            use_fn, blk, batch_size, batch_format, fn_kwargs or {}, mode
+        )
+        return out, _meta_of(out)
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+
+
+class Dataset:
+    """Distributed data as a list of ObjectRef[Block] (+ lazy metadata)."""
+
+    def __init__(
+        self,
+        block_refs: List[Any],
+        meta_refs: Optional[List[Any]] = None,
+        stats: Optional[List[Tuple[str, float]]] = None,
+    ):
+        self._block_refs = list(block_refs)
+        self._meta_refs = list(meta_refs) if meta_refs is not None else [None] * len(
+            self._block_refs
+        )
+        self._metas: List[Optional[BlockMeta]] = [None] * len(self._block_refs)
+        self._stats = list(stats or [])
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def _fetch_metas(self) -> List[BlockMeta]:
+        missing = [
+            (i, r)
+            for i, (m, r) in enumerate(zip(self._metas, self._meta_refs))
+            if m is None
+        ]
+        for i, ref in missing:
+            if ref is None:
+                blk = ray_tpu.get(self._block_refs[i])
+                self._metas[i] = _meta_of(blk)
+            else:
+                self._metas[i] = ray_tpu.get(ref)
+        return self._metas  # type: ignore[return-value]
+
+    def count(self) -> int:
+        return sum(m.num_rows for m in self._fetch_metas())
+
+    def size_bytes(self) -> int:
+        return sum(m.size_bytes for m in self._fetch_metas())
+
+    def schema(self):
+        for ref in self._block_refs:
+            blk = ray_tpu.get(ref)
+            if blk.num_rows or blk.num_columns:
+                return blk.schema
+        return None
+
+    def stats(self) -> str:
+        lines = [f"Dataset({self.num_blocks()} blocks)"]
+        for op, dt in self._stats:
+            lines.append(f"  {op}: {dt * 1000:.1f}ms")
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return f"Dataset(num_blocks={self.num_blocks()})"
+
+    def _derived(self, pairs: List[Any], op: str, t0: float) -> "Dataset":
+        """Build the next Dataset from a list of (block, meta) 2-return refs."""
+        blocks = [p[0] for p in pairs]
+        metas = [p[1] for p in pairs]
+        return Dataset(
+            blocks, metas, self._stats + [(op, time.perf_counter() - t0)]
+        )
+
+    # -- transforms -------------------------------------------------------
+
+    def map_batches(
+        self,
+        fn: Callable,
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_kwargs: Optional[Dict[str, Any]] = None,
+        fn_constructor: Optional[Callable] = None,
+        num_cpus: Optional[float] = None,
+        **_ignored,
+    ) -> "Dataset":
+        """Apply ``fn(batch) -> batch`` to every batch (reference:
+        data/dataset.py map_batches; actor pools per
+        actor_pool_map_operator.py)."""
+        t0 = time.perf_counter()
+        if isinstance(compute, ActorPoolStrategy):
+            pairs = self._run_actor_pool(
+                fn, compute, batch_size, batch_format, fn_kwargs, fn_constructor, "batches"
+            )
+        else:
+            task = _map_block_task
+            if num_cpus is not None:
+                task = task.options(num_cpus=num_cpus)
+            pairs = [
+                task.options(num_returns=2).remote(
+                    fn, ref, batch_size, batch_format, fn_kwargs, "batches"
+                )
+                for ref in self._block_refs
+            ]
+        return self._derived(pairs, "map_batches", t0)
+
+    def _run_actor_pool(
+        self, fn, strategy, batch_size, batch_format, fn_kwargs, fn_constructor, mode
+    ):
+        pool = [
+            _MapWorker.remote(fn_constructor) for _ in range(strategy.size)
+        ]
+        try:
+            pairs: List[Any] = [None] * len(self._block_refs)
+            inflight: Dict[Any, int] = {}
+            per_actor = {id(a): 0 for a in pool}
+            next_i = 0
+            while next_i < len(self._block_refs) or inflight:
+                # top up: round-robin over actors under their in-flight cap
+                progressed = True
+                while next_i < len(self._block_refs) and progressed:
+                    progressed = False
+                    for a in pool:
+                        if next_i >= len(self._block_refs):
+                            break
+                        if per_actor[id(a)] < strategy.max_tasks_in_flight_per_actor:
+                            refs = a.apply.options(num_returns=2).remote(
+                                fn,
+                                self._block_refs[next_i],
+                                batch_size,
+                                batch_format,
+                                fn_kwargs,
+                                mode,
+                            )
+                            pairs[next_i] = refs
+                            per_actor[id(a)] += 1
+                            inflight[refs[0]] = (next_i, id(a))
+                            next_i += 1
+                            progressed = True
+                if inflight:
+                    done, _ = ray_tpu.wait(list(inflight), num_returns=1)
+                    for ref in done:
+                        _, aid = inflight.pop(ref)
+                        per_actor[aid] -= 1
+            return pairs
+        finally:
+            for a in pool:
+                ray_tpu.kill(a)
+
+    def map(self, fn: Callable, **kw) -> "Dataset":
+        return self._row_op(fn, "map", **kw)
+
+    def filter(self, fn: Callable, **kw) -> "Dataset":
+        return self._row_op(fn, "filter", **kw)
+
+    def flat_map(self, fn: Callable, **kw) -> "Dataset":
+        return self._row_op(fn, "flat_map", **kw)
+
+    def _row_op(self, fn, op, **kw) -> "Dataset":
+        t0 = time.perf_counter()
+        pairs = [
+            _map_block_task.options(num_returns=2).remote(
+                fn, ref, None, "numpy", {"_op": op}, "rows"
+            )
+            for ref in self._block_refs
+        ]
+        return self._derived(pairs, op, t0)
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(batch, **_):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(_add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b, **_: {k: v for k, v in b.items() if k not in cols}
+        )
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b, **_: {k: v for k, v in b.items() if k in cols}
+        )
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda b, **_: {mapping.get(k, k): v for k, v in b.items()}
+        )
+
+    # -- shuffles / layout ------------------------------------------------
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Rebalance into ``num_blocks`` near-equal row-aligned blocks."""
+        t0 = time.perf_counter()
+        metas = self._fetch_metas()
+        total = sum(m.num_rows for m in metas)
+        bounds = [total * i // num_blocks for i in range(num_blocks + 1)]
+        # slice every source block at the output boundaries, then concat
+        per_out: List[List[Any]] = [[] for _ in range(num_blocks)]
+        row0 = 0
+        for ref, m in zip(self._block_refs, metas):
+            row1 = row0 + m.num_rows
+            for j in range(num_blocks):
+                lo, hi = max(row0, bounds[j]), min(row1, bounds[j + 1])
+                if lo < hi:
+                    if lo == row0 and hi == row1:
+                        per_out[j].append((ref, None))
+                    else:
+                        s = _slice_block_task.options(num_returns=2).remote(
+                            ref, lo - row0, hi - row0
+                        )
+                        per_out[j].append((s[0], s[1]))
+            row0 = row1
+        pairs = [
+            _concat_blocks_task.options(num_returns=2).remote(
+                *[r for r, _ in parts]
+            )
+            for parts in per_out
+        ]
+        return self._derived(pairs, "repartition", t0)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """All-to-all shuffle (two-stage map/reduce, reference:
+        data/_internal/planner/exchange/ + push_based_shuffle.py)."""
+        t0 = time.perf_counter()
+        n = max(len(self._block_refs), 1)
+        base = seed if seed is not None else random.randint(0, 2**31)
+        parts = [
+            _shuffle_partition_task.options(num_returns=n).remote(ref, n, base + i)
+            for i, ref in enumerate(self._block_refs)
+        ]
+        if n == 1:
+            parts = [[p] if not isinstance(p, list) else p for p in parts]
+        pairs = [
+            _shuffle_reduce_task.options(num_returns=2).remote(
+                base + 7919 + j, *[parts[i][j] for i in range(len(parts))]
+            )
+            for j in range(n)
+        ]
+        return self._derived(pairs, "random_shuffle", t0)
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Distributed sample-partition-sort (reference: data sort_op)."""
+        t0 = time.perf_counter()
+        n = max(len(self._block_refs), 1)
+        samples = np.concatenate(
+            [
+                np.asarray(s, dtype=object)
+                for s in ray_tpu.get(
+                    [
+                        _sample_task.remote(ref, key, 16, 1234 + i)
+                        for i, ref in enumerate(self._block_refs)
+                    ]
+                )
+            ]
+        )
+        samples = np.sort(samples.astype(np.asarray(samples.tolist()).dtype))
+        if len(samples) == 0 or n == 1:
+            boundaries = []
+        else:
+            qs = [len(samples) * j // n for j in range(1, n)]
+            boundaries = [samples[q] for q in qs]
+        nb = len(boundaries) + 1
+        parts = [
+            _sort_partition_task.options(num_returns=nb).remote(
+                ref, key, boundaries, descending
+            )
+            for ref in self._block_refs
+        ]
+        if nb == 1:
+            parts = [[p] if not isinstance(p, list) else p for p in parts]
+        # descending: the partition task already flips the index so that
+        # partition 0 holds the largest values — keep natural output order
+        pairs = [
+            _sort_reduce_task.options(num_returns=2).remote(
+                key, descending, *[parts[i][j] for i in range(len(parts))]
+            )
+            for j in range(nb)
+        ]
+        return self._derived(pairs, "sort", t0)
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        return GroupedDataset(self, key)
+
+    # -- combining --------------------------------------------------------
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self._block_refs)
+        metas = list(self._meta_refs)
+        for o in others:
+            blocks += o._block_refs
+            metas += o._meta_refs
+        return Dataset(blocks, metas, self._stats + [("union", 0.0)])
+
+    def limit(self, n: int) -> "Dataset":
+        t0 = time.perf_counter()
+        metas = self._fetch_metas()
+        out_blocks, out_metas = [], []
+        remaining = n
+        for ref, m, mref in zip(self._block_refs, metas, self._meta_refs):
+            if remaining <= 0:
+                break
+            if m.num_rows <= remaining:
+                out_blocks.append(ref)
+                out_metas.append(mref)
+                remaining -= m.num_rows
+            else:
+                s = _slice_block_task.options(num_returns=2).remote(ref, 0, remaining)
+                out_blocks.append(s[0])
+                out_metas.append(s[1])
+                remaining = 0
+        return Dataset(out_blocks, out_metas, self._stats + [("limit", time.perf_counter() - t0)])
+
+    # -- consumption ------------------------------------------------------
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Split into n shards; ``equal=True`` row-aligns the shards (the
+        contract session.get_dataset_shard relies on — reference:
+        data/dataset.py split(equal=True))."""
+        if not equal:
+            shards = [
+                Dataset(self._block_refs[i::n], self._meta_refs[i::n], self._stats)
+                for i in range(n)
+            ]
+            return shards
+        metas = self._fetch_metas()
+        total = sum(m.num_rows for m in metas)
+        bounds = [total * i // n for i in range(n + 1)]
+        out: List[Dataset] = []
+        row0_list = []
+        row0 = 0
+        for m in metas:
+            row0_list.append(row0)
+            row0 += m.num_rows
+        for j in range(n):
+            blocks, metas_out = [], []
+            for ref, m, b0 in zip(self._block_refs, metas, row0_list):
+                b1 = b0 + m.num_rows
+                lo, hi = max(b0, bounds[j]), min(b1, bounds[j + 1])
+                if lo < hi:
+                    if lo == b0 and hi == b1:
+                        blocks.append(ref)
+                        metas_out.append(None)
+                    else:
+                        s = _slice_block_task.options(num_returns=2).remote(
+                            ref, lo - b0, hi - b0
+                        )
+                        blocks.append(s[0])
+                        metas_out.append(s[1])
+            out.append(Dataset(blocks, metas_out, self._stats + [("split", 0.0)]))
+        return out
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+        prefetch_blocks: int = 1,
+    ) -> Iterator[Any]:
+        """Stream batches to the caller, prefetching blocks ahead of
+        consumption (reference: data/iterator.py iter_batches)."""
+        refs = list(self._block_refs)
+        if not refs:
+            return
+        rng = (
+            np.random.default_rng(local_shuffle_seed)
+            if local_shuffle_buffer_size
+            else None
+        )
+        carry: Optional[B.Block] = None
+        shuffle_pool: List[B.Block] = []
+        pool_rows = 0
+
+        def _emit(blk: B.Block):
+            nonlocal carry
+            if carry is not None and carry.num_rows:
+                blk = B.concat_blocks([carry, blk])
+                carry = None
+            n = blk.num_rows
+            if batch_size is None:
+                if n:
+                    yield B.block_to_batch(blk, batch_format)
+                return
+            start = 0
+            while n - start >= batch_size:
+                yield B.block_to_batch(
+                    B.block_slice(blk, start, start + batch_size), batch_format
+                )
+                start += batch_size
+            if start < n:
+                carry = B.block_slice(blk, start, n)
+
+        i = 0
+        pending: List[Any] = []
+        while i < len(refs) or pending or shuffle_pool:
+            while i < len(refs) and len(pending) <= prefetch_blocks:
+                pending.append(refs[i])
+                i += 1
+            if pending:
+                blk = ray_tpu.get(pending.pop(0))
+                if rng is not None:
+                    shuffle_pool.append(blk)
+                    pool_rows += blk.num_rows
+                    if pool_rows < local_shuffle_buffer_size and (
+                        i < len(refs) or pending
+                    ):
+                        continue
+                    merged = B.concat_blocks(shuffle_pool)
+                    perm = rng.permutation(merged.num_rows)
+                    blk = merged.take(pa.array(perm))
+                    shuffle_pool, pool_rows = [], 0
+                yield from _emit(blk)
+            elif shuffle_pool:
+                merged = B.concat_blocks(shuffle_pool)
+                perm = rng.permutation(merged.num_rows)
+                shuffle_pool, pool_rows = [], 0
+                yield from _emit(merged.take(pa.array(perm)))
+        if carry is not None and carry.num_rows and not drop_last:
+            yield B.block_to_batch(carry, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self._block_refs:
+            blk = ray_tpu.get(ref)
+            yield from B.block_rows(blk)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for ref in self._block_refs:
+            blk = ray_tpu.get(ref)
+            out.extend(B.block_rows(blk))
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for ref in self._block_refs:
+            out.extend(B.block_rows(ray_tpu.get(ref)))
+        return out
+
+    def to_pandas(self):
+        import pandas as pd
+
+        blocks = [ray_tpu.get(r) for r in self._block_refs]
+        merged = B.concat_blocks(blocks)
+        return merged.to_pandas()
+
+    def materialize(self) -> "Dataset":
+        """Eager engine: blocks already exist; fetch metas for bookkeeping."""
+        self._fetch_metas()
+        return self
+
+    # -- output -----------------------------------------------------------
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def _write(self, path: str, fmt: str) -> List[str]:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        ext = {"parquet": "parquet", "csv": "csv"}[fmt]
+        return ray_tpu.get(
+            [
+                _write_block_task.remote(
+                    ref, os.path.join(path, f"part-{i:05d}.{ext}"), fmt
+                )
+                for i, ref in enumerate(self._block_refs)
+            ]
+        )
+
+    # Datasets must travel to train workers: ObjectRefs pickle by reference.
+    def __reduce__(self):
+        return (
+            Dataset,
+            (self._block_refs, self._meta_refs, self._stats),
+        )
+
+
+class GroupedDataset:
+    """Minimal groupby: hash-partition on key + per-partition pandas agg
+    (reference: data/grouped_data.py)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, aggs: Dict[str, Tuple[Optional[str], str]]) -> Dataset:
+        t0 = time.perf_counter()
+        n = max(self._ds.num_blocks(), 1)
+        parts = [
+            _groupby_partition_task.options(num_returns=n).remote(ref, self._key, n)
+            for ref in self._ds._block_refs
+        ]
+        if n == 1:
+            parts = [[p] if not isinstance(p, list) else p for p in parts]
+        pairs = [
+            _groupby_agg_task.options(num_returns=2).remote(
+                self._key, aggs, *[parts[i][j] for i in range(len(parts))]
+            )
+            for j in range(n)
+        ]
+        return self._ds._derived(pairs, f"groupby({self._key})", t0)
+
+    def count(self) -> Dataset:
+        return self._agg({"count()": (None, "count")})
+
+    def sum(self, on: str) -> Dataset:
+        return self._agg({f"sum({on})": (on, "sum")})
+
+    def mean(self, on: str) -> Dataset:
+        return self._agg({f"mean({on})": (on, "mean")})
+
+    def min(self, on: str) -> Dataset:
+        return self._agg({f"min({on})": (on, "min")})
+
+    def max(self, on: str) -> Dataset:
+        return self._agg({f"max({on})": (on, "max")})

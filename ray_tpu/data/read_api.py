@@ -1,0 +1,186 @@
+"""Dataset creation: in-memory sources and file readers.
+
+Reference surface: python/ray/data/read_api.py (range, from_items,
+read_parquet/csv/json, from_numpy/from_pandas/from_arrow). Readers run as
+tasks — one per file (parquet additionally splits by row-group for large
+files) — so bytes land directly in the distributed object store.
+"""
+
+from __future__ import annotations
+
+import builtins
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import BlockMeta, Dataset, _meta_of
+
+DEFAULT_PARALLELISM = 8
+
+
+@ray_tpu.remote
+def _read_parquet_task(path, columns, row_groups):
+    import pyarrow.parquet as pq
+
+    f = pq.ParquetFile(path)
+    if row_groups is None:
+        tbl = f.read(columns=columns)
+    else:
+        tbl = f.read_row_groups(row_groups, columns=columns)
+    return tbl, _meta_of(tbl)
+
+
+@ray_tpu.remote
+def _read_csv_task(path, read_options):
+    import pyarrow.csv as pacsv
+
+    tbl = pacsv.read_csv(path, **(read_options or {}))
+    return tbl, _meta_of(tbl)
+
+
+@ray_tpu.remote
+def _read_json_task(path):
+    import pyarrow.json as pajson
+
+    tbl = pajson.read_json(path)
+    return tbl, _meta_of(tbl)
+
+
+@ray_tpu.remote
+def _make_block_task(builder, *args):
+    blk = builder(*args)
+    return blk, _meta_of(blk)
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    os.path.join(p, f)
+                    for f in os.listdir(p)
+                    if not f.startswith(".") and not f.startswith("_")
+                )
+            )
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def _from_local_blocks(blocks: List[B.Block], stats_op: str) -> Dataset:
+    refs, metas = [], []
+    for blk in blocks:
+        refs.append(ray_tpu.put(blk))
+        metas.append(None)
+    ds = Dataset(refs, metas, [(stats_op, 0.0)])
+    ds._metas = [_meta_of(b) for b in blocks]
+    return ds
+
+
+def range(n: int, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """Dataset of {"id": 0..n-1} (reference: read_api.py range)."""
+    parallelism = max(1, min(parallelism, n or 1))
+    blocks = []
+    for i in builtins.range(parallelism):
+        lo, hi = n * i // parallelism, n * (i + 1) // parallelism
+        blocks.append(pa.table({"id": np.arange(lo, hi, dtype=np.int64)}))
+    return _from_local_blocks(blocks, "range")
+
+
+def from_items(items: List[Any], *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    blocks = []
+    for i in builtins.range(parallelism):
+        lo, hi = len(items) * i // parallelism, len(items) * (i + 1) // parallelism
+        blocks.append(B.block_from_rows(items[lo:hi]))
+    return _from_local_blocks(blocks, "from_items")
+
+
+def from_numpy(arrays: Dict[str, np.ndarray], *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """Columns from numpy arrays (tensor columns keep their shapes)."""
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    n = len(next(iter(arrays.values())))
+    parallelism = max(1, min(parallelism, n or 1))
+    blocks = []
+    for i in builtins.range(parallelism):
+        lo, hi = n * i // parallelism, n * (i + 1) // parallelism
+        blocks.append(B.block_from_batch({k: v[lo:hi] for k, v in arrays.items()}))
+    return _from_local_blocks(blocks, "from_numpy")
+
+
+def from_pandas(dfs, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    blocks = [pa.Table.from_pandas(df, preserve_index=False) for df in dfs]
+    return _from_local_blocks(blocks, "from_pandas")
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _from_local_blocks(tables, "from_arrow")
+
+
+def from_blocks(block_refs: List[Any]) -> Dataset:
+    return Dataset(block_refs, None, [("from_blocks", 0.0)])
+
+
+def read_parquet(
+    paths,
+    *,
+    columns: Optional[List[str]] = None,
+    parallelism: int = DEFAULT_PARALLELISM,
+) -> Dataset:
+    """One task per file; large single files split by row-group ranges."""
+    import pyarrow.parquet as pq
+
+    files = _expand_paths(paths)
+    pairs = []
+    if len(files) < parallelism:
+        # split files into row-group ranges for more read parallelism
+        for path in files:
+            n_rg = pq.ParquetFile(path).num_row_groups
+            want = max(1, parallelism // len(files))
+            want = min(want, n_rg)
+            for j in builtins.range(want):
+                lo, hi = n_rg * j // want, n_rg * (j + 1) // want
+                if lo < hi:
+                    pairs.append(
+                        _read_parquet_task.options(num_returns=2).remote(
+                            path, columns, list(builtins.range(lo, hi))
+                        )
+                    )
+    else:
+        pairs = [
+            _read_parquet_task.options(num_returns=2).remote(p, columns, None)
+            for p in files
+        ]
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs], [("read_parquet", 0.0)])
+
+
+def read_csv(paths, *, parallelism: int = DEFAULT_PARALLELISM, **read_options) -> Dataset:
+    files = _expand_paths(paths)
+    pairs = [
+        _read_csv_task.options(num_returns=2).remote(p, read_options or None)
+        for p in files
+    ]
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs], [("read_csv", 0.0)])
+
+
+def read_json(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    files = _expand_paths(paths)
+    pairs = [_read_json_task.options(num_returns=2).remote(p) for p in files]
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs], [("read_json", 0.0)])

@@ -21,12 +21,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ray_tpu.models.gpt import (
-    GPT,
-    GPTConfig,
-    blockwise_next_token_loss,
-    next_token_loss,
-)
+from ray_tpu.models.gpt import GPT, GPTConfig, blockwise_next_token_loss
 from ray_tpu.parallel import sharding as shd
 
 

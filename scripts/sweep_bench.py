@@ -1,12 +1,11 @@
 """Ad-hoc sweep: model size × batch × flash block sizes on the real chip."""
-import itertools
-import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bench import PEAK_FLOPS
 from ray_tpu.models.gpt import gpt_125m, gpt_1b, train_step_flops
 from ray_tpu.models.training import (
     default_optimizer,
@@ -15,7 +14,7 @@ from ray_tpu.models.training import (
 )
 from ray_tpu.parallel.mesh import MeshSpec
 
-PEAK = 197e12
+PEAK = PEAK_FLOPS["tpu"]
 
 
 def run(cfg_name, batch, seq, iters=10):
