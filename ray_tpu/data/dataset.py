@@ -151,8 +151,14 @@ def _sample_task(blk, key, k, seed):
 
 @ray_tpu.remote
 def _groupby_partition_task(blk, key, n_parts):
+    import zlib
+
+    # deterministic hash: Python's hash() is salt-randomized per process
+    # for str/bytes, which would scatter one key across partitions
     col = blk.column(key).to_numpy(zero_copy_only=False)
-    h = np.array([hash(x) % n_parts for x in col.tolist()])
+    h = np.array(
+        [zlib.crc32(repr(x).encode()) % n_parts for x in col.tolist()]
+    )
     return [blk.take(pa.array(np.nonzero(h == j)[0])) for j in range(n_parts)]
 
 
@@ -432,12 +438,19 @@ class Dataset:
         t0 = time.perf_counter()
         n = max(len(self._block_refs), 1)
         base = seed if seed is not None else random.randint(0, 2**31)
+        if n == 1:
+            # single partition: one reduce over the source blocks directly
+            # (num_returns=1 would package the partition list as one object)
+            pairs = [
+                _shuffle_reduce_task.options(num_returns=2).remote(
+                    base + 7919, *self._block_refs
+                )
+            ]
+            return self._derived(pairs, "random_shuffle", t0)
         parts = [
             _shuffle_partition_task.options(num_returns=n).remote(ref, n, base + i)
             for i, ref in enumerate(self._block_refs)
         ]
-        if n == 1:
-            parts = [[p] if not isinstance(p, list) else p for p in parts]
         pairs = [
             _shuffle_reduce_task.options(num_returns=2).remote(
                 base + 7919 + j, *[parts[i][j] for i in range(len(parts))]
@@ -468,14 +481,19 @@ class Dataset:
             qs = [len(samples) * j // n for j in range(1, n)]
             boundaries = [samples[q] for q in qs]
         nb = len(boundaries) + 1
+        if nb == 1:
+            pairs = [
+                _sort_reduce_task.options(num_returns=2).remote(
+                    key, descending, *self._block_refs
+                )
+            ]
+            return self._derived(pairs, "sort", t0)
         parts = [
             _sort_partition_task.options(num_returns=nb).remote(
                 ref, key, boundaries, descending
             )
             for ref in self._block_refs
         ]
-        if nb == 1:
-            parts = [[p] if not isinstance(p, list) else p for p in parts]
         # descending: the partition task already flips the index so that
         # partition 0 holds the largest values — keep natural output order
         pairs = [
@@ -603,9 +621,15 @@ class Dataset:
         i = 0
         pending: List[Any] = []
         while i < len(refs) or pending or shuffle_pool:
+            queued = False
             while i < len(refs) and len(pending) <= prefetch_blocks:
                 pending.append(refs[i])
                 i += 1
+                queued = True
+            if queued and len(pending) > 1:
+                # kick off pulls of the queued-but-not-yet-consumed blocks so
+                # cross-node transfers overlap with consumption
+                ray_tpu.wait(pending[1:], num_returns=len(pending) - 1, timeout=0)
             if pending:
                 blk = ray_tpu.get(pending.pop(0))
                 if rng is not None:
@@ -701,12 +725,17 @@ class GroupedDataset:
     def _agg(self, aggs: Dict[str, Tuple[Optional[str], str]]) -> Dataset:
         t0 = time.perf_counter()
         n = max(self._ds.num_blocks(), 1)
+        if n == 1:
+            pairs = [
+                _groupby_agg_task.options(num_returns=2).remote(
+                    self._key, aggs, *self._ds._block_refs
+                )
+            ]
+            return self._ds._derived(pairs, f"groupby({self._key})", t0)
         parts = [
             _groupby_partition_task.options(num_returns=n).remote(ref, self._key, n)
             for ref in self._ds._block_refs
         ]
-        if n == 1:
-            parts = [[p] if not isinstance(p, list) else p for p in parts]
         pairs = [
             _groupby_agg_task.options(num_returns=2).remote(
                 self._key, aggs, *[parts[i][j] for i in range(len(parts))]

@@ -24,6 +24,7 @@ class _Session:
         dataset_shards: Optional[Dict[str, Any]] = None,
         experiment_name: str = "",
         trial_id: str = "",
+        trial_dir: str = "",
     ):
         self.world_size = world_size
         self.world_rank = world_rank
@@ -32,6 +33,7 @@ class _Session:
         self.dataset_shards = dataset_shards or {}
         self.experiment_name = experiment_name
         self.trial_id = trial_id
+        self.trial_dir = trial_dir
         self.reports: List[Dict[str, Any]] = []
         self.lock = threading.Lock()
         self.finished = threading.Event()

@@ -14,6 +14,7 @@ host's chips (single-host) or jax.distributed (multi-host).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -41,6 +42,39 @@ class BaseTrainer:
 
     def fit(self) -> Result:
         raise NotImplementedError
+
+    def as_trainable(self) -> Callable:
+        """Adapter for ``ray_tpu.tune.Tuner``: returns ``fn(config)`` that
+        runs a per-trial fit with ``config`` merged into the train loop
+        config, forwarding every report (metrics + checkpoints) to the
+        trial session (reference: train/base_trainer.py wrapping trainers
+        as Tune trainables)."""
+        import copy
+        import dataclasses as _dc
+
+        base = self
+
+        def _trial_fn(config):
+            from ray_tpu.train import session as session_mod
+
+            sess = session_mod._get_session()
+            trainer = copy.copy(base)
+            if getattr(trainer, "train_loop_config", None) is not None:
+                trainer.train_loop_config = {**trainer.train_loop_config, **config}
+            trainer.run_config = _dc.replace(
+                base.run_config,
+                name=None,
+                storage_path=sess.trial_dir
+                or os.path.join(
+                    base.run_config.resolved_storage_path(), sess.trial_id or "trial"
+                ),
+            )
+            trainer._report_callback = session_mod.report
+            result = trainer.fit()
+            if result.error is not None:
+                raise result.error
+
+        return _trial_fn
 
 
 class DataParallelTrainer(BaseTrainer):
@@ -143,27 +177,44 @@ class DataParallelTrainer(BaseTrainer):
         ckpt_manager: CheckpointManager,
         history: List[Dict[str, Any]],
     ):
-        """Poll rank 0's reports until every rank's loop returns."""
-        seen = 0
+        """Poll every rank's reports until every rank's loop returns.
+
+        Rank 0's metrics and checkpoints are canonical (SPMD ranks hold
+        identical state, so persisting every rank's copy would write
+        num_workers duplicates per step and churn num_to_keep retention).
+        Reports from other ranks are still drained — a checkpoint from a
+        nonzero rank is registered only when rank 0's same report carried
+        none (e.g. per-host sharded checkpoints saved by rank 0 only)."""
+        num_workers = len(run_refs)
+        seen = [0] * num_workers
+        callback = getattr(self, "_report_callback", None)
+        rank0_ckpt_count = 0
+
+        def _poll_all():
+            nonlocal rank0_ckpt_count
+            for rank in range(num_workers):
+                for entry in executor.poll_reports(rank, seen[rank]):
+                    seen[rank] += 1
+                    metrics = entry["metrics"]
+                    if rank == 0:
+                        history.append(metrics)
+                        if callback is not None:
+                            callback(metrics, checkpoint=entry.get("checkpoint"))
+                        if "checkpoint" in entry:
+                            rank0_ckpt_count += 1
+                            ckpt_manager.register(entry["checkpoint"], metrics)
+                    elif "checkpoint" in entry and rank0_ckpt_count == 0:
+                        ckpt_manager.register(entry["checkpoint"], metrics)
+
         pending = list(run_refs)
         while pending:
             done, pending = ray_tpu.wait(
                 pending, num_returns=len(pending), timeout=0.2
             )
-            for entry in executor.poll_reports(0, seen):
-                seen += 1
-                metrics = entry["metrics"]
-                history.append(metrics)
-                if "checkpoint" in entry:
-                    ckpt_manager.register(entry["checkpoint"], metrics)
+            _poll_all()
             if done:
                 ray_tpu.get(done)  # surface worker exceptions
-        # drain reports that landed after the last wait
-        for entry in executor.poll_reports(0, seen):
-            seen += 1
-            history.append(entry["metrics"])
-            if "checkpoint" in entry:
-                ckpt_manager.register(entry["checkpoint"], entry["metrics"])
+        _poll_all()  # drain reports that landed after the last wait
 
 
 class JaxTrainer(DataParallelTrainer):

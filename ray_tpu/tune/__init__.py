@@ -1,0 +1,61 @@
+"""ray_tpu.tune: hyperparameter tuning on trial actors (reference:
+python/ray/tune — Tuner.fit, ASHA/median schedulers, search spaces)."""
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import get_checkpoint, get_trial_id
+from ray_tpu.train.session import report as _session_report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    choice,
+    generate_variants,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    Trial,
+    TuneConfig,
+    Tuner,
+    with_parameters,
+)
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None):
+    """In-trial reporting (same session channel as ray_tpu.train.report)."""
+    _session_report(metrics, checkpoint=checkpoint)
+
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "Checkpoint",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "ResultGrid",
+    "Trial",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "generate_variants",
+    "get_checkpoint",
+    "get_trial_id",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+]

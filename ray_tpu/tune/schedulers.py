@@ -1,0 +1,125 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), median stopping.
+
+Reference: python/ray/tune/schedulers/async_hyperband.py (ASHA rungs and
+cutoff quantile), trial_scheduler.py (decision protocol), median_stopping_rule.py.
+Decisions are made per reported result; STOP kills the trial actor early.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def set_metric(self, metric: Optional[str], mode: Optional[str]):
+        if getattr(self, "metric", None) is None:
+            self.metric = metric
+        if getattr(self, "mode", None) is None:
+            self.mode = mode or "max"
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA: rungs at grace_period * reduction_factor**k; at each rung a
+    trial below the top-1/reduction_factor quantile is stopped."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 3,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self._rungs: List[float] = []
+        t = grace_period
+        while t < max_t:
+            self._rungs.append(t)
+            t = int(t * reduction_factor) if t * reduction_factor > t else t + 1
+        # rung milestone -> {trial_id: best metric recorded at that rung}
+        self._recorded: Dict[float, Dict[str, float]] = collections.defaultdict(dict)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric is None or self.metric not in result:
+            return CONTINUE
+        t = result.get(self.time_attr)
+        if t is None:
+            return CONTINUE
+        value = float(result[self.metric])
+        sign = 1.0 if (self.mode or "max") == "max" else -1.0
+        decision = CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self._rungs):
+            if t < rung or trial_id in self._recorded[rung]:
+                continue
+            self._recorded[rung][trial_id] = value
+            vals = sorted((sign * v for v in self._recorded[rung].values()), reverse=True)
+            k = max(1, int(len(vals) / self.rf))
+            cutoff = vals[k - 1]
+            if sign * value < cutoff:
+                decision = STOP
+            break  # only the highest newly-reached rung decides
+        return decision
+
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        grace_period: int = 3,
+        min_samples_required: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self._history: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric is None or self.metric not in result:
+            return CONTINUE
+        t = result.get(self.time_attr) or 0
+        sign = 1.0 if (self.mode or "max") == "max" else -1.0
+        self._history[trial_id].append(sign * float(result[self.metric]))
+        if t < self.grace_period or len(self._history) < self.min_samples:
+            return CONTINUE
+        means = {
+            tid: sum(v) / len(v) for tid, v in self._history.items() if v
+        }
+        others = sorted(v for tid, v in means.items() if tid != trial_id)
+        if not others:
+            return CONTINUE
+        median = others[len(others) // 2]
+        return STOP if means[trial_id] < median else CONTINUE

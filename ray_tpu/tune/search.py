@@ -1,0 +1,138 @@
+"""Search spaces and the basic variant generator.
+
+Reference surface: python/ray/tune/search/ (sample.py Domains,
+basic_variant.py BasicVariantGenerator, variant_generator.py grid
+expansion). Grid axes cross-multiply; stochastic domains resample per
+trial; ``num_samples`` repeats the whole grid.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
+        self.fn = fn
+
+    def sample(self, rng):  # resolved against the partial config later
+        return self
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn: Callable) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v: Any) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _walk(space: Dict[str, Any], path=()) -> List[Tuple[Tuple, Any]]:
+    """Flatten nested dict search space into (path, value) leaves."""
+    out = []
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            out.extend(_walk(v, path + (k,)))
+        else:
+            out.append((path + (k,), v))
+    return out
+
+
+def _set_path(cfg: Dict[str, Any], path: Tuple, value: Any):
+    d = cfg
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(
+    param_space: Dict[str, Any], num_samples: int = 1, seed: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """Expand grid axes × num_samples, sampling stochastic domains."""
+    rng = random.Random(seed)
+    leaves = _walk(param_space or {})
+    grid_axes = [(p, v["grid_search"]) for p, v in leaves if _is_grid(v)]
+    other = [(p, v) for p, v in leaves if not _is_grid(v)]
+    combos = (
+        list(itertools.product(*[vals for _, vals in grid_axes]))
+        if grid_axes
+        else [()]
+    )
+    configs: List[Dict[str, Any]] = []
+    for _ in range(max(1, num_samples)):
+        for combo in combos:
+            cfg: Dict[str, Any] = {}
+            for (p, _), val in zip(grid_axes, combo):
+                _set_path(cfg, p, val)
+            for p, v in other:
+                if isinstance(v, SampleFrom):
+                    _set_path(cfg, p, v.fn(cfg))
+                elif isinstance(v, Domain):
+                    _set_path(cfg, p, v.sample(rng))
+                else:
+                    _set_path(cfg, p, v)
+            configs.append(cfg)
+    return configs

@@ -1,0 +1,343 @@
+"""Tuner: the trial-runner event loop over actors + placement.
+
+Reference: python/ray/tune/tuner.py:320 (Tuner.fit), tune/execution/
+trial_runner.py:1372 (step loop: launch → poll results → scheduler
+decision → stop/collect), tune/experiment/trial.py (trial state machine).
+Each trial runs as one actor hosting the trainable function; intermediate
+``tune.report`` results stream back via actor polling, feed the scheduler
+(ASHA early stopping kills the actor), and carry checkpoints that are
+retained per-trial. Experiment state persists to JSON for ``Tuner.restore``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.checkpoint_manager import CheckpointManager
+from ray_tpu.train.config import CheckpointConfig, RunConfig
+from ray_tpu.train.result import Result
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune import search as search_mod
+
+logger = logging.getLogger(__name__)
+
+PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[sched_mod.TrialScheduler] = None
+    trial_resources: Optional[Dict[str, float]] = None
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    path: str = ""
+    early_stopped: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], trials: List[Trial]):
+        self._results = results
+        self.trials = trials
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self.trials if t.error]
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Result:
+        metric = metric or self._default_metric
+        mode = mode or self._default_mode
+        sign = 1.0 if mode == "max" else -1.0
+        best, best_v = None, -float("inf")
+        for r in self._results:
+            if r.error is not None or metric not in (r.metrics or {}):
+                continue
+            v = sign * float(r.metrics[metric])
+            if v > best_v:
+                best, best_v = r, v
+        if best is None:
+            raise ValueError(f"no completed trial reported metric {metric!r}")
+        return best
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame(
+            [
+                {"trial_id": t.trial_id, "status": t.status, **t.last_result}
+                for t in self.trials
+            ]
+        )
+
+    _default_metric: Optional[str] = None
+    _default_mode: str = "max"
+
+
+@ray_tpu.remote(max_concurrency=4)
+class _TrialActor:
+    """Hosts one trainable function; reports stream out via poll()."""
+
+    def __init__(self):
+        self._session = None
+
+    def run(self, fn, config, trial_id, trial_dir, experiment_name, resume_ckpt):
+        from ray_tpu.train import session as session_mod
+
+        self._session = session_mod._init_session(
+            world_size=1,
+            world_rank=0,
+            local_rank=0,
+            checkpoint=resume_ckpt,
+            experiment_name=experiment_name,
+            trial_id=trial_id,
+            trial_dir=trial_dir,
+        )
+        os.makedirs(trial_dir, exist_ok=True)
+        try:
+            fn(config)
+        finally:
+            self._session.finished.set()
+        return True
+
+    def poll(self, start: int):
+        s = self._session
+        if s is None:
+            return []
+        with s.lock:
+            return list(s.reports[start:])
+
+
+class Tuner:
+    """``Tuner(trainable, param_space=..., tune_config=..., run_config=...)``
+
+    trainable: either ``fn(config)`` (reports via ``ray_tpu.tune.report`` /
+    ``train.report``) or a Trainer instance (its ``as_trainable()`` runs a
+    per-trial fit with merged ``train_loop_config``).
+    """
+
+    def __init__(
+        self,
+        trainable: Any,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored_trials: Optional[List[Trial]] = None
+
+    # -- experiment state -------------------------------------------------
+
+    @property
+    def experiment_dir(self) -> str:
+        base = self.run_config.resolved_storage_path()
+        return base
+
+    def _save_state(self, trials: List[Trial]):
+        state = [dataclasses.asdict(t) for t in trials]
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        with open(os.path.join(self.experiment_dir, "tuner_state.json"), "w") as f:
+            json.dump(state, f, default=str)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any, **kwargs) -> "Tuner":
+        """Resume an interrupted experiment: completed trials keep their
+        results; pending/running/errored trials re-run."""
+        with open(os.path.join(path, "tuner_state.json")) as f:
+            state = json.load(f)
+        # keep experiment_dir == path: resolved_storage_path joins
+        # storage_path with name, so split the path accordingly
+        run_config = kwargs.pop("run_config", None) or RunConfig(
+            storage_path=os.path.dirname(os.path.abspath(path)),
+            name=os.path.basename(os.path.abspath(path)),
+        )
+        tuner = cls(trainable, run_config=run_config, **kwargs)
+        trials = []
+        for t in state:
+            trial = Trial(**{k: t[k] for k in (
+                "trial_id", "config", "status", "last_result", "metrics_history",
+                "error", "path", "early_stopped")})
+            if trial.status not in (TERMINATED,):
+                trial.status = PENDING
+                trial.error = None
+                trial.metrics_history = []
+                trial.last_result = {}
+            trials.append(trial)
+        tuner._restored_trials = trials
+        return tuner
+
+    # -- fit --------------------------------------------------------------
+
+    def _resolve_trainable(self) -> Callable[[Dict[str, Any]], None]:
+        t = self.trainable
+        if callable(getattr(t, "as_trainable", None)):
+            return t.as_trainable()
+        if callable(t):
+            return t
+        raise TypeError(f"not a trainable: {t!r}")
+
+    def fit(self) -> ResultGrid:
+        cfgs = self.tune_config
+        scheduler = cfgs.scheduler or sched_mod.FIFOScheduler()
+        scheduler.set_metric(cfgs.metric, cfgs.mode)
+        fn = self._resolve_trainable()
+        exp_dir = self.experiment_dir
+        exp_name = self.run_config.name or os.path.basename(exp_dir)
+
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+        else:
+            variants = search_mod.generate_variants(
+                self.param_space, cfgs.num_samples, seed=cfgs.seed
+            )
+            trials = [
+                Trial(trial_id=f"{exp_name}_{i:05d}_{uuid.uuid4().hex[:6]}", config=c)
+                for i, c in enumerate(variants)
+            ]
+        for t in trials:
+            t.path = t.path or os.path.join(exp_dir, t.trial_id)
+
+        limit = cfgs.max_concurrent_trials or len(trials)
+        actors: Dict[str, Any] = {}
+        run_refs: Dict[str, Any] = {}
+        seen: Dict[str, int] = {}
+        ckpt_mgrs: Dict[str, CheckpointManager] = {}
+        pending = [t for t in trials if t.status == PENDING]
+        running: List[Trial] = []
+        by_id = {t.trial_id: t for t in trials}
+
+        def _launch(trial: Trial):
+            opts = dict(self.tune_config.trial_resources or {"num_cpus": 1})
+            actor = _TrialActor.options(**opts).remote()
+            actors[trial.trial_id] = actor
+            run_refs[trial.trial_id] = actor.run.remote(
+                fn, trial.config, trial.trial_id, trial.path, exp_name, None
+            )
+            seen[trial.trial_id] = 0
+            ckpt_mgrs[trial.trial_id] = CheckpointManager(
+                trial.path, self.run_config.checkpoint_config or CheckpointConfig()
+            )
+            trial.status = RUNNING
+            running.append(trial)
+
+        def _finalize(trial: Trial, error: Optional[str], early: bool = False):
+            trial.status = ERROR if error else TERMINATED
+            trial.error = error
+            trial.early_stopped = early
+            running.remove(trial)
+            actor = actors.pop(trial.trial_id, None)
+            run_refs.pop(trial.trial_id, None)
+            if actor is not None:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+            scheduler.on_trial_complete(trial.trial_id)
+            self._save_state(trials)
+
+        def _drain_reports(trial: Trial) -> Optional[str]:
+            """Pull new reports; returns STOP if the scheduler says so."""
+            actor = actors[trial.trial_id]
+            try:
+                reports = ray_tpu.get(
+                    actor.poll.remote(seen[trial.trial_id]), timeout=30
+                )
+            except Exception:
+                return None
+            decision = None
+            for entry in reports:
+                seen[trial.trial_id] += 1
+                metrics = dict(entry["metrics"])
+                metrics.setdefault("training_iteration", seen[trial.trial_id])
+                metrics["trial_id"] = trial.trial_id
+                trial.metrics_history.append(metrics)
+                trial.last_result = metrics
+                if "checkpoint" in entry:
+                    ckpt_mgrs[trial.trial_id].register(entry["checkpoint"], metrics)
+                d = scheduler.on_result(trial.trial_id, metrics)
+                if d == sched_mod.STOP:
+                    decision = sched_mod.STOP
+            return decision
+
+        while pending or running:
+            while pending and len(running) < limit:
+                _launch(pending.pop(0))
+            refs = [run_refs[t.trial_id] for t in running]
+            done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.25)
+            done_set = set(done)
+            for trial in list(running):
+                decision = _drain_reports(trial)
+                ref = run_refs.get(trial.trial_id)
+                if ref in done_set:
+                    err = None
+                    try:
+                        ray_tpu.get(ref)
+                        _drain_reports(trial)  # reports landed after last poll
+                    except Exception as e:  # noqa: BLE001
+                        err = f"{type(e).__name__}: {e}"
+                    _finalize(trial, err)
+                elif decision == sched_mod.STOP:
+                    logger.info("ASHA stopping trial %s early", trial.trial_id)
+                    _finalize(trial, None, early=True)
+
+        self._save_state(trials)
+        results = [
+            Result(
+                metrics=t.last_result,
+                checkpoint=ckpt_mgrs[t.trial_id].latest
+                if t.trial_id in ckpt_mgrs
+                else None,
+                error=RuntimeError(t.error) if t.error else None,
+                metrics_history=t.metrics_history,
+                path=t.path,
+            )
+            for t in trials
+        ]
+        grid = ResultGrid(results, trials)
+        grid._default_metric = cfgs.metric
+        grid._default_mode = cfgs.mode
+        return grid
+
+
+def with_parameters(fn: Callable, **heavy_kwargs) -> Callable:
+    """Bind large objects by ObjectRef (reference: tune/trainable/util.py
+    with_parameters) so each trial fetches them from the object store."""
+    refs = {k: ray_tpu.put(v) for k, v in heavy_kwargs.items()}
+
+    def wrapped(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return fn(config, **resolved)
+
+    return wrapped

@@ -1,5 +1,9 @@
 """Ad-hoc sweep: model size × batch × flash block sizes on the real chip."""
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -197,3 +197,23 @@ def test_dataset_feeds_jax_trainer(ray_start_regular, tmp_path):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["rows_seen"] == 64  # 32 rows/worker x 2 epochs
+
+
+def test_single_block_shuffle_sort_groupby(ray_start_regular):
+    # regression: num_returns=1 packaged the partition list as one object
+    ds = rd.from_items([{"k": "b" if i % 2 else "a", "v": i} for i in range(10)],
+                       parallelism=1)
+    assert sorted(r["v"] for r in ds.random_shuffle(seed=0).take_all()) == list(range(10))
+    assert [r["v"] for r in ds.sort("v").take_all()] == list(range(10))
+    counts = {r["k"]: r["count()"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {"a": 5, "b": 5}
+
+
+def test_groupby_string_keys_across_workers(ray_start_regular):
+    # regression: salted hash() scattered string keys across partitions
+    ds = rd.from_items(
+        [{"city": ["NYC", "SF", "LA"][i % 3], "v": 1.0} for i in range(30)],
+        parallelism=5,
+    )
+    sums = {r["city"]: r["sum(v)"] for r in ds.groupby("city").sum("v").take_all()}
+    assert sums == {"NYC": 10.0, "SF": 10.0, "LA": 10.0}
