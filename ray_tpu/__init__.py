@@ -21,7 +21,9 @@ from ray_tpu.api import (
     ActorDiedError,
     ActorHandle,
     GetTimeoutError,
+    ObjectLostError,
     ObjectRef,
+    ObjectStoreFullError,
     RayTpuError,
     RemoteFunction,
     TaskError,
@@ -52,6 +54,8 @@ __all__ = [
     "TaskError",
     "ActorDiedError",
     "GetTimeoutError",
+    "ObjectLostError",
+    "ObjectStoreFullError",
     "WorkerCrashedError",
     "__version__",
 ]

@@ -49,6 +49,7 @@ class _Config:
         "health_check_failure_threshold": 5,
         "task_max_retries_default": 3,
         "actor_max_restarts_default": 0,
+        "lineage_max_resubmits": 3,  # per-object lineage re-executions
         "gcs_rpc_timeout_s": 30.0,
         # --- rpc ---
         "rpc_connect_timeout_s": 10.0,

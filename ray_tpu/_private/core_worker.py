@@ -35,7 +35,7 @@ import cloudpickle
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
-from ray_tpu._private.object_store import MemoryStore, PlasmaClient
+from ray_tpu._private.object_store import MemoryStore, ObjectLostError, PlasmaClient
 from ray_tpu._private import rpc as rpc_mod
 from ray_tpu._private.rpc import ConnectionLost, RpcClient, ServerConn, RpcServer
 
@@ -179,6 +179,12 @@ class CoreWorker:
         self._locations: Dict[bytes, Tuple[str, int]] = {}
         self._locations_lock = threading.Lock()
         self._pulls_inflight: set = set()
+        # lineage (reference: core_worker/object_recovery_manager.h:41 +
+        # task_manager.h:203 ResubmitTask): plasma return oid -> the spec of
+        # the task that created it, kept while local refs exist so the owner
+        # can re-execute the task if every copy of the object is lost
+        self._lineage: Dict[bytes, Dict[str, Any]] = {}
+        self._lost_objects: set = set()  # binaries whose location died
         # raylet clients for spillback leasing on other nodes
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._node_addr_cache: Dict[NodeID, Tuple[str, int]] = {}
@@ -272,9 +278,28 @@ class CoreWorker:
                 return  # another caller is pulling; plasma get provides the wait
             self._pulls_inflight.add(binary)
         try:
-            self.raylet.call("store_pull", (oid, loc), timeout=timeout or 120.0)
-        except Exception:
-            logger.warning("pull of %s from %s failed", oid.hex()[:12], loc)
+            ok = self.raylet.call("store_pull", (oid, loc), timeout=timeout or 120.0)
+            if not ok:
+                # the local raylet contacted the peer and the peer cannot
+                # serve the object (dead or dropped it): the location is
+                # genuinely gone — mark lost so get() can try lineage recovery
+                logger.warning(
+                    "pull of %s failed: %s no longer holds it; marking lost",
+                    oid.hex()[:12], loc,
+                )
+                with self._locations_lock:
+                    if self._locations.get(binary) == loc:
+                        self._locations.pop(binary, None)
+                    self._lost_objects.add(binary)
+        except Exception as e:  # noqa: BLE001
+            # an RPC error/timeout here proves nothing about the peer (it may
+            # just be a short caller deadline on a big transfer): keep the
+            # location so a later get can retry; node death is detected
+            # separately via the GCS node-removed notification
+            logger.warning(
+                "pull of %s from %s did not complete (%s: %s); will retry",
+                oid.hex()[:12], loc, type(e).__name__, e,
+            )
         finally:
             with self._locations_lock:
                 self._pulls_inflight.discard(binary)
@@ -313,6 +338,8 @@ class CoreWorker:
             return
         oid = ObjectID(binary)
         self.memory_store.delete(oid)
+        with self._pending_lock:
+            self._lineage.pop(binary, None)
         try:
             if self.plasma is not None:
                 self.plasma.delete(oid)
@@ -355,13 +382,7 @@ class CoreWorker:
             else:
                 results[oid] = self._deserialize(memoryview(data))
         if plasma_ids:
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            self._start_pulls(plasma_ids, remaining)
-            views = self.plasma.get_views(plasma_ids, timeout=remaining)
-            if views is None:
-                raise GetTimeoutError(
-                    f"timed out waiting for {[o.hex()[:16] for o in plasma_ids]}"
-                )
+            views = self._plasma_get_with_recovery(plasma_ids, deadline)
             for oid, view in views.items():
                 try:
                     value = self._deserialize(view)
@@ -371,6 +392,70 @@ class CoreWorker:
                 self._schedule_release(oid, view, value)
                 results[oid] = value
         return [results[oid] for oid in object_ids]
+
+    def _plasma_get_with_recovery(
+        self, plasma_ids: List[ObjectID], deadline: Optional[float]
+    ) -> Dict[ObjectID, memoryview]:
+        """Blocking plasma get that notices lost objects between waits and
+        re-executes their creating tasks from lineage (reference:
+        object_recovery_manager.h:90 RecoverObject)."""
+        while True:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            slice_t = 2.0 if remaining is None else min(2.0, remaining)
+            self._start_pulls(plasma_ids, remaining)
+            views = self.plasma.get_views(plasma_ids, timeout=slice_t)
+            if views is not None:
+                return views
+            for oid in plasma_ids:
+                if self.plasma.contains(oid):
+                    continue
+                binary = oid.binary()
+                with self._locations_lock:
+                    lost = binary in self._lost_objects and binary not in self._pulls_inflight
+                if lost and not self._try_recover(oid):
+                    raise ObjectLostError(
+                        f"object {oid.hex()[:16]} is lost: the node holding it "
+                        f"died and no lineage is available to re-create it "
+                        f"(ray.put objects and exhausted resubmit budgets are "
+                        f"not recoverable)"
+                    )
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(
+                    f"timed out waiting for {[o.hex()[:16] for o in plasma_ids]}"
+                )
+
+    def _try_recover(self, oid: ObjectID) -> bool:
+        """Resubmit the creating task of a lost object. Returns False when no
+        lineage exists or the resubmit budget is exhausted."""
+        binary = oid.binary()
+        with self._pending_lock:
+            spec = self._lineage.get(binary)
+            if spec is None:
+                return False
+            task_id = spec["task_id"]
+            if task_id in self._pending:
+                return True  # resubmit already in flight
+            if spec.get("resubmits_left", GlobalConfig.lineage_max_resubmits) <= 0:
+                return False
+            spec["resubmits_left"] = (
+                spec.get("resubmits_left", GlobalConfig.lineage_max_resubmits) - 1
+            )
+            # the resubmitted attempt keeps the task's own retry budget
+            spec["retries_left"] = spec.get(
+                "max_retries_initial", GlobalConfig.task_max_retries_default
+            )
+            spec.pop("locations", None)
+            self._pending[task_id] = spec
+        with self._locations_lock:
+            self._locations.pop(binary, None)
+            self._lost_objects.discard(binary)
+        logger.warning(
+            "recovering lost object %s: resubmitting task %r (%d resubmits left)",
+            oid.hex()[:12], spec["name"], spec["resubmits_left"],
+        )
+        self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"])
+        self._submit_queue.put(spec)
+        return True
 
     def _schedule_release(self, oid: ObjectID, view: memoryview, value: Any):
         """Unpin a plasma object once the deserialized value can no longer
@@ -552,6 +637,10 @@ class CoreWorker:
             "retries_left": (
                 max_retries if max_retries is not None else GlobalConfig.task_max_retries_default
             ),
+            "max_retries_initial": (
+                max_retries if max_retries is not None else GlobalConfig.task_max_retries_default
+            ),
+            "resubmits_left": GlobalConfig.lineage_max_resubmits,
             "caller_id": self.worker_id,
             "scheduling_node": scheduling_node,
             "scheduling_soft": scheduling_soft,
@@ -732,6 +821,16 @@ class CoreWorker:
                 if producer_node is not None:
                     self.register_locations({oid.binary(): tuple(producer_node)})
                 self.memory_store.put(oid, PLASMA_MARKER)
+                if reply["status"] == "ok" and spec.get("max_retries_initial", 0) > 0:
+                    # pin lineage: this spec can recreate the object if the
+                    # node holding it dies (object_recovery_manager.h:90).
+                    # max_retries=0 declares the task non-idempotent, which
+                    # makes its objects non-reconstructable (reference
+                    # semantics: task_manager.h retryable check)
+                    with self._pending_lock:
+                        self._lineage[oid.binary()] = spec
+            with self._locations_lock:
+                self._lost_objects.discard(oid.binary())
         with self._pending_lock:
             self._pending.pop(task_id, None)
         self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"])
@@ -965,6 +1064,17 @@ class CoreWorker:
             if message.get("event") == "removed":
                 node = message["node"]
                 self._node_addr_cache.pop(node["node_id"], None)
+                # invalidate the object directory for that node: objects
+                # located only there are lost and become recovery candidates
+                addr = tuple(node.get("address") or ())
+                if addr:
+                    with self._locations_lock:
+                        stale = [
+                            b for b, a in self._locations.items() if tuple(a) == addr
+                        ]
+                        for b in stale:
+                            self._locations.pop(b, None)
+                            self._lost_objects.add(b)
             return
         if channel == "actors" or channel.startswith("actor:"):
             actor_id = message["actor_id"]

@@ -129,7 +129,10 @@ def _make_arena(capacity: int):
 
 
 class _Entry:
-    __slots__ = ("offset", "size", "sealed", "pin_count", "last_used", "creating_worker")
+    __slots__ = (
+        "offset", "size", "sealed", "pin_count", "last_used",
+        "creating_worker", "spill_path", "spill_data",
+    )
 
     def __init__(self, offset: int, size: int, creating_worker=None):
         self.offset = offset
@@ -138,6 +141,14 @@ class _Entry:
         self.pin_count = 0
         self.last_used = time.monotonic()
         self.creating_worker = creating_worker
+        # spilled state: bytes held in memory until the background flusher
+        # persists them (spill_data), then a file path (spill_path)
+        self.spill_path: Optional[str] = None
+        self.spill_data: Optional[bytes] = None
+
+    @property
+    def resident(self) -> bool:
+        return self.offset >= 0
 
 
 class PlasmaStore:
@@ -162,6 +173,22 @@ class PlasmaStore:
         self._arena = _make_arena(self.capacity)
         self._entries: Dict[ObjectID, _Entry] = {}
         self._cv = threading.Condition()
+        # disk spilling (reference: raylet/local_object_manager.h +
+        # python/ray/_private/external_storage.py:246 FileSystemStorage):
+        # under memory pressure, unpinned sealed objects move to files and
+        # restore transparently on the next get.
+        self._spill_enabled = GlobalConfig.object_spilling_enabled
+        self._spill_dir = GlobalConfig.object_spilling_dir or os.path.join(
+            session_dir, f"spill_{name}"
+        )
+        self._closed = False
+        if self._spill_enabled:
+            # disk writes happen off the store lock: _spill_locked only
+            # copies bytes out of the arena; this thread persists them
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name=f"{name}-spill-flush", daemon=True
+            )
+            self._flusher.start()
 
     # -- server-side API (called via raylet RPC handlers or locally) --
 
@@ -205,14 +232,28 @@ class PlasmaStore:
                 if all(
                     (e := self._entries.get(o)) is not None and e.sealed for o in object_ids
                 ):
-                    result = {}
+                    # restore + pin in one pass: a pinned entry cannot be
+                    # re-spilled by a later restore's eviction in this loop
+                    pinned = []
+                    ok = True
                     for o in object_ids:
                         entry = self._entries[o]
+                        if not entry.resident and not self._restore_locked(o, entry):
+                            ok = False  # arena too full even after spilling
+                            break
                         entry.last_used = time.monotonic()
-                        if pin:
-                            entry.pin_count += 1
-                        result[o] = (entry.offset, entry.size)
-                    return result
+                        entry.pin_count += 1
+                        pinned.append(entry)
+                    if ok:
+                        result = {}
+                        for o in object_ids:
+                            entry = self._entries[o]
+                            if not pin:
+                                entry.pin_count -= 1
+                            result[o] = (entry.offset, entry.size)
+                        return result
+                    for entry in pinned:  # partial restore: undo and wait
+                        entry.pin_count -= 1
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     return None
@@ -234,21 +275,98 @@ class PlasmaStore:
             e = self._entries.get(object_id)
             if e is not None and e.pin_count == 0:
                 self._entries.pop(object_id)
-                self._arena.free(e.offset)
+                if e.resident:
+                    self._arena.free(e.offset)
+                elif e.spill_path is not None:
+                    try:
+                        os.unlink(e.spill_path)
+                    except OSError:
+                        pass
 
     def _evict_locked(self, needed: int):
-        """LRU-evict unpinned sealed objects until ``needed`` could fit."""
+        """Free ``needed`` bytes: spill unpinned sealed objects to disk when
+        enabled (no data loss), otherwise LRU-drop them."""
         candidates = sorted(
-            (o for o, e in self._entries.items() if e.sealed and e.pin_count == 0),
+            (
+                o
+                for o, e in self._entries.items()
+                if e.sealed and e.pin_count == 0 and e.resident
+            ),
             key=lambda o: self._entries[o].last_used,
         )
         freed = 0
         for o in candidates:
-            e = self._entries.pop(o)
-            self._arena.free(e.offset)
+            e = self._entries[o]
+            if self._spill_enabled:
+                self._spill_locked(o, e)
+            else:
+                self._entries.pop(o)
+                self._arena.free(e.offset)
             freed += e.size
             if freed >= needed:
                 break
+
+    def _spill_locked(self, object_id: ObjectID, e: _Entry):
+        """Copy the object out of the arena (memcpy only — the disk write
+        happens on the flusher thread, off the store lock)."""
+        e.spill_data = bytes(self._view[e.offset : e.offset + e.size])
+        self._arena.free(e.offset)
+        e.offset = -1
+        self._cv.notify_all()
+
+    def _flush_loop(self):
+        while not self._closed:
+            target = None
+            with self._cv:
+                for oid, e in self._entries.items():
+                    if e.spill_data is not None and e.spill_path is None:
+                        target = (oid, e, e.spill_data)
+                        break
+                if target is None:
+                    self._cv.wait(0.5)
+                    continue
+            oid, e, data = target
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, oid.hex())
+            with open(path, "wb") as f:
+                f.write(data)
+            with self._cv:
+                cur = self._entries.get(oid)
+                if cur is e and e.spill_data is data and not e.resident:
+                    e.spill_path = path
+                    e.spill_data = None
+                else:
+                    # restored or deleted while we were writing
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+
+    def _restore_locked(self, object_id: ObjectID, e: _Entry) -> bool:
+        """Bring a spilled object back into the arena (may spill others)."""
+        offset = self._arena.allocate(e.size)
+        if offset < 0:
+            self._evict_locked(e.size)
+            offset = self._arena.allocate(e.size)
+        if offset < 0:
+            return False
+        if e.spill_data is not None:
+            self._view[offset : offset + e.size] = e.spill_data
+        else:
+            # cold path: the object was flushed to disk. The read happens
+            # under the lock — bounded by the object's size; the common
+            # (recently-spilled) case is the memcpy branch above.
+            with open(e.spill_path, "rb") as f:
+                self._view[offset : offset + e.size] = f.read()
+            try:
+                os.unlink(e.spill_path)
+            except OSError:
+                pass
+        e.spill_path = None
+        e.spill_data = None
+        e.offset = offset
+        e.last_used = time.monotonic()
+        return True
 
     def read(self, object_id: ObjectID, offset: int, length: int) -> Optional[bytes]:
         """Copy out a chunk of a sealed object (node-to-node transfer plane,
@@ -258,8 +376,16 @@ class PlasmaStore:
             if e is None or not e.sealed:
                 return None
             length = min(length, e.size - offset)
+            if not e.resident:
+                if e.spill_data is not None:  # not yet flushed to disk
+                    return e.spill_data[offset : offset + length]
+                with open(e.spill_path, "rb") as f:
+                    f.seek(offset)
+                    return f.read(length)
             base = e.offset
-        return bytes(self._view[base + offset : base + offset + length])
+            # copy while holding the lock: an unpinned entry could otherwise
+            # be spilled/evicted between lock release and the copy
+            return bytes(self._view[base + offset : base + offset + length])
 
     def stats(self) -> Dict[str, int]:
         with self._cv:
@@ -275,6 +401,7 @@ class PlasmaStore:
         return self._view[offset : offset + size]
 
     def close(self):
+        self._closed = True
         try:
             self._view.release()
             self._map.close()

@@ -20,6 +20,7 @@ from ray_tpu._private.core_worker import (  # re-export error types
     TaskError,
     WorkerCrashedError,
 )
+from ray_tpu._private.object_store import ObjectLostError, ObjectStoreFullError
 
 _VALID_OPTIONS = {
     "num_cpus",

@@ -179,19 +179,18 @@ class DataParallelTrainer(BaseTrainer):
     ):
         """Poll every rank's reports until every rank's loop returns.
 
-        Rank 0's metrics and checkpoints are canonical (SPMD ranks hold
+        Rank 0's metrics and checkpoints are canonical: SPMD ranks hold
         identical state, so persisting every rank's copy would write
-        num_workers duplicates per step and churn num_to_keep retention).
-        Reports from other ranks are still drained — a checkpoint from a
-        nonzero rank is registered only when rank 0's same report carried
-        none (e.g. per-host sharded checkpoints saved by rank 0 only)."""
+        num_workers duplicates per step and churn num_to_keep retention.
+        Reports from other ranks are drained (so their queues empty and
+        their errors surface) but their checkpoints are NOT persisted —
+        save checkpoints from rank 0, as in the reference's default
+        (train/_internal/checkpoint.py rank-0 convention)."""
         num_workers = len(run_refs)
         seen = [0] * num_workers
         callback = getattr(self, "_report_callback", None)
-        rank0_ckpt_count = 0
 
         def _poll_all():
-            nonlocal rank0_ckpt_count
             for rank in range(num_workers):
                 for entry in executor.poll_reports(rank, seen[rank]):
                     seen[rank] += 1
@@ -201,10 +200,12 @@ class DataParallelTrainer(BaseTrainer):
                         if callback is not None:
                             callback(metrics, checkpoint=entry.get("checkpoint"))
                         if "checkpoint" in entry:
-                            rank0_ckpt_count += 1
                             ckpt_manager.register(entry["checkpoint"], metrics)
-                    elif "checkpoint" in entry and rank0_ckpt_count == 0:
-                        ckpt_manager.register(entry["checkpoint"], metrics)
+                    elif "checkpoint" in entry:
+                        logger.debug(
+                            "dropping checkpoint reported by rank %d (rank-0 "
+                            "checkpoints are canonical)", rank,
+                        )
 
         pending = list(run_refs)
         while pending:

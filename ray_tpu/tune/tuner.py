@@ -313,12 +313,18 @@ class Tuner:
                     _finalize(trial, None, early=True)
 
         self._save_state(trials)
+
+        def _trial_checkpoint(t: Trial):
+            if t.trial_id in ckpt_mgrs:
+                return ckpt_mgrs[t.trial_id].latest
+            # restored trial that completed before the interruption: its
+            # checkpoints are on disk under the trial dir
+            return _latest_checkpoint_on_disk(t.path)
+
         results = [
             Result(
                 metrics=t.last_result,
-                checkpoint=ckpt_mgrs[t.trial_id].latest
-                if t.trial_id in ckpt_mgrs
-                else None,
+                checkpoint=_trial_checkpoint(t),
                 error=RuntimeError(t.error) if t.error else None,
                 metrics_history=t.metrics_history,
                 path=t.path,
@@ -329,6 +335,22 @@ class Tuner:
         grid._default_metric = cfgs.metric
         grid._default_mode = cfgs.mode
         return grid
+
+
+def _latest_checkpoint_on_disk(trial_path: str) -> Optional[Checkpoint]:
+    """Highest-numbered checkpoint_NNNNNN dir under a trial path, if any."""
+    try:
+        dirs = sorted(
+            d
+            for d in os.listdir(trial_path)
+            if d.startswith("checkpoint_")
+            and os.path.isdir(os.path.join(trial_path, d))
+        )
+    except OSError:
+        return None
+    if not dirs:
+        return None
+    return Checkpoint.from_directory(os.path.join(trial_path, dirs[-1]))
 
 
 def with_parameters(fn: Callable, **heavy_kwargs) -> Callable:
