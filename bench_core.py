@@ -1,0 +1,167 @@
+"""Core-runtime microbenchmarks vs BASELINE.md's reference table.
+
+Measures the same surfaces as the reference's microbenchmark suite
+(reference: python/ray/_private/ray_perf.py:93, archived results in
+release/release_logs/2.4.0/microbenchmark.json). Prints one JSON line per
+metric plus a summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+
+# single-node numbers from BASELINE.md (m4.16xlarge-class, 64 cores)
+REFERENCE = {
+    "tasks_async_per_s": 11590.0,
+    "tasks_sync_per_s": 1403.0,
+    "actor_calls_sync_per_s": 2628.0,
+    "actor_calls_async_per_s": 8775.0,
+    "put_small_per_s": 6428.0,
+    "get_small_per_s": 6220.0,
+    "put_gbps": 20.1,
+    "pg_create_remove_per_s": 1111.0,
+}
+
+
+def _bench(name: str, n: int, fn) -> float:
+    t0 = time.perf_counter()
+    fn(n)
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    ref = REFERENCE.get(name)
+    print(
+        json.dumps(
+            {
+                "metric": name,
+                "value": round(rate, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(rate / ref, 4) if ref else None,
+            }
+        ),
+        flush=True,
+    )
+    return rate
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+@ray_tpu.remote
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+def main():
+    ray_tpu.init(num_cpus=4, log_level="ERROR")
+    results = {}
+
+    # warmup: spin up workers
+    ray_tpu.get([_noop.remote() for _ in range(20)], timeout=60)
+
+    def tasks_async(n):
+        ray_tpu.get([_noop.remote() for _ in range(n)], timeout=120)
+
+    results["tasks_async_per_s"] = _bench("tasks_async_per_s", 2000, tasks_async)
+
+    def tasks_sync(n):
+        for _ in range(n):
+            ray_tpu.get(_noop.remote(), timeout=30)
+
+    results["tasks_sync_per_s"] = _bench("tasks_sync_per_s", 200, tasks_sync)
+
+    actor = _Counter.remote()
+    ray_tpu.get(actor.inc.remote(), timeout=30)
+
+    def actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(actor.inc.remote(), timeout=30)
+
+    results["actor_calls_sync_per_s"] = _bench("actor_calls_sync_per_s", 500, actor_sync)
+
+    def actor_async(n):
+        ray_tpu.get([actor.inc.remote() for _ in range(n)], timeout=120)
+
+    results["actor_calls_async_per_s"] = _bench(
+        "actor_calls_async_per_s", 2000, actor_async
+    )
+    ray_tpu.kill(actor)
+
+    small = np.arange(16)
+
+    def put_small(n):
+        for _ in range(n):
+            ray_tpu.put(small)
+
+    results["put_small_per_s"] = _bench("put_small_per_s", 2000, put_small)
+
+    ref_small = ray_tpu.put(small)
+
+    def get_small(n):
+        for _ in range(n):
+            ray_tpu.get(ref_small, timeout=30)
+
+    results["get_small_per_s"] = _bench("get_small_per_s", 2000, get_small)
+
+    big = np.zeros(64 * 1024 * 1024 // 8)  # 64 MB
+
+    t0 = time.perf_counter()
+    iters = 10
+    for _ in range(iters):
+        ray_tpu.put(big)
+    dt = time.perf_counter() - t0
+    gbps = 64 * iters / 1024 / dt
+    print(
+        json.dumps(
+            {
+                "metric": "put_gbps",
+                "value": round(gbps, 2),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / REFERENCE["put_gbps"], 4),
+            }
+        ),
+        flush=True,
+    )
+    results["put_gbps"] = gbps
+
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    def pg_cycle(n):
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1.0}])
+            pg.wait(timeout_seconds=10)
+            remove_placement_group(pg)
+
+    results["pg_create_remove_per_s"] = _bench("pg_create_remove_per_s", 100, pg_cycle)
+
+    geo = 1.0
+    keys = [k for k in results if k in REFERENCE]
+    for k in keys:
+        geo *= results[k] / REFERENCE[k]
+    geo **= 1.0 / len(keys)
+    print(
+        json.dumps(
+            {
+                "metric": "core_microbench_geomean_vs_reference",
+                "value": round(geo, 4),
+                "unit": "x",
+                "vs_baseline": round(geo, 4),
+            }
+        )
+    )
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

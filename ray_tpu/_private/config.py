@@ -50,10 +50,14 @@ class _Config:
         "task_max_retries_default": 3,
         "actor_max_restarts_default": 0,
         "lineage_max_resubmits": 3,  # per-object lineage re-executions
+        "actor_max_inflight": 256,  # pipelined calls per (caller, actor)
         "gcs_rpc_timeout_s": 30.0,
         # --- rpc ---
         "rpc_connect_timeout_s": 10.0,
         "rpc_max_frame_bytes": 512 * 1024**2,
+        # dispatch pool size per RpcServer: large enough that long-poll
+        # handlers (store gets, lease waits) cannot starve control traffic
+        "rpc_dispatch_threads": 128,
         # --- task events / observability ---
         "task_events_enabled": True,
         "task_events_buffer_size": 100_000,

@@ -153,8 +153,11 @@ class CoreWorker:
             self.plasma = PlasmaClient(self._store_info[0], self._store_info[1], self.raylet.call)
 
         # function/class import cache
+        import weakref as _weakref
+
         self._fn_cache: Dict[bytes, Any] = {}
         self._fn_exported: set = set()
+        self._fn_export_ids: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
         # direct connections to other workers / actors
         self._worker_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._worker_clients_lock = threading.Lock()
@@ -166,8 +169,12 @@ class CoreWorker:
         self._actor_info: Dict[ActorID, Dict[str, Any]] = {}
         self._actor_seq: Dict[ActorID, int] = {}
         self._actor_pending: Dict[ActorID, List] = {}
-        self._actor_busy: Dict[ActorID, bool] = {}
+        self._actor_inflight: Dict[ActorID, int] = {}
         self._actor_next_send: Dict[ActorID, int] = {}
+        # wire-order gate: submitter threads race to push pipelined calls;
+        # each call waits its turn so the actor's connection sees seq order
+        self._actor_wire_next: Dict[ActorID, int] = {}
+        self._actor_wire_cv = threading.Condition()
         self._actor_lock = threading.Lock()
         # pending normal tasks owned by this worker
         self._pending: Dict[TaskID, Dict[str, Any]] = {}
@@ -185,6 +192,13 @@ class CoreWorker:
         # can re-execute the task if every copy of the object is lost
         self._lineage: Dict[bytes, Dict[str, Any]] = {}
         self._lost_objects: set = set()  # binaries whose location died
+        # lease cache (reference: direct_task_transport.cc OnWorkerIdle —
+        # a leased worker runs queued same-shape tasks back to back instead
+        # of a lease round-trip per task)
+        self._idle_leases: Dict[Tuple, List] = {}
+        self._lease_waiting: Dict[Tuple, Any] = {}  # sig -> deque[spec]
+        self._lease_inflight: Dict[Tuple, int] = {}  # sig -> lease rpcs out
+        self._lease_lock = threading.Lock()
         # raylet clients for spillback leasing on other nodes
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
         self._node_addr_cache: Dict[NodeID, Tuple[str, int]] = {}
@@ -509,6 +523,7 @@ class CoreWorker:
         if fetch_local:
             # kick off pulls for known-remote objects so wait() makes progress
             self._start_pulls(object_ids, timeout)
+        version = self.memory_store.version
         while True:
             ready = [o for o in object_ids if self.ready(o)]
             if len(ready) >= num_returns:
@@ -518,19 +533,35 @@ class CoreWorker:
             if deadline is not None and time.monotonic() >= deadline:
                 not_ready = [o for o in object_ids if o not in ready]
                 return ready, not_ready
-            time.sleep(0.002)
+            # event-driven: task completions land in the memory store (inline
+            # data or plasma markers) and bump its version; the 50 ms cap
+            # covers plasma-only arrivals (remote pulls into the local store)
+            version = self.memory_store.wait_change(version, 0.05)
 
     # ------------------------------------------------------------------
     # function export/import (GCS KV is the function table)
     # ------------------------------------------------------------------
 
     def export_function(self, fn: Any) -> bytes:
+        # identity cache: pickling dominates submit cost otherwise. Matches
+        # the reference's export-once semantics (function_manager.py): later
+        # mutation of a function's globals/closure does not re-export.
+        try:
+            cached = self._fn_export_ids.get(fn)
+        except TypeError:
+            cached = None
+        if cached is not None:
+            return cached
         data = cloudpickle.dumps(fn)
         fn_id = hashlib.sha1(data).digest()
         if fn_id not in self._fn_exported:
             self.gcs.call("kv_put", ("fn", fn_id.hex(), data, True))
             self._fn_exported.add(fn_id)
         self._fn_cache.setdefault(fn_id, fn)
+        try:
+            self._fn_export_ids[fn] = fn_id
+        except TypeError:
+            pass  # unweakrefable callable: pickle each time
         return fn_id
 
     def import_function(self, fn_id: bytes) -> Any:
@@ -653,6 +684,169 @@ class CoreWorker:
         self._submit_queue.put(spec)
         return return_ids
 
+    # -- lease caching / scheduling keys --------------------------------
+
+    def _lease_sig(self, spec: Dict[str, Any]) -> Optional[Tuple]:
+        if spec.get("scheduling_node") is not None:
+            return None  # affinity-constrained: never reuse generic leases
+        return tuple(sorted((spec.get("resources") or {}).items()))
+
+    def _maybe_push_from_cache(self, sig: Tuple):
+        """Marry waiting specs with idle cached leases (no raylet RPC)."""
+        while True:
+            with self._lease_lock:
+                stack = self._idle_leases.get(sig)
+                waiting = self._lease_waiting.get(sig)
+                if not stack or not waiting:
+                    return
+                lease, lease_raylet, client, _ts = stack.pop()
+                spec = waiting.popleft()
+            self._push_spec(spec, sig, lease, lease_raylet, client)
+
+    def _ensure_lease_requests(self, sig: Tuple):
+        """Keep enough lease requests in flight to cover the waiting queue
+        (minus idle leases), capped; the raylet queues excess requests."""
+        with self._lease_lock:
+            waiting = len(self._lease_waiting.get(sig) or ())
+            idle = len(self._idle_leases.get(sig) or ())
+            inflight = self._lease_inflight.get(sig, 0)
+            need = min(waiting - idle - inflight, 32 - inflight)
+            if need <= 0:
+                return
+            self._lease_inflight[sig] = inflight + need
+        for _ in range(need):
+            self._submit_queue.put({"__action__": "lease", "sig": sig})
+
+    def _acquire_lease(self, sig: Tuple):
+        """Run the lease dance for one worker of shape ``sig`` (submitter
+        thread), then hand it to a waiting spec."""
+        resources = dict(sig)
+        lease_raylet = self.raylet
+        hops = 0
+        try:
+            while not self._shutdown.is_set():
+                with self._lease_lock:
+                    if not self._lease_waiting.get(sig):
+                        return  # queue drained (cached leases served it)
+                try:
+                    # short raylet-side wait: a request whose demand has
+                    # since drained must not pin a submitter thread (nor
+                    # block raylet grants) for the full lease timeout
+                    lease = lease_raylet.call(
+                        "request_worker_lease",
+                        {
+                            "resources": resources,
+                            "job_id": self.job_id,
+                            "allow_spill": hops == 0,
+                            "timeout": 1.0,
+                        },
+                        timeout=GlobalConfig.worker_lease_timeout_s * 2,
+                    )
+                except (ConnectionLost, TimeoutError, OSError):
+                    if lease_raylet is self.raylet:
+                        raise  # our own raylet is gone
+                    self._node_addr_cache.clear()
+                    lease_raylet, hops = self.raylet, 0
+                    continue
+                if lease is None:
+                    lease_raylet, hops = self.raylet, 0
+                    continue
+                if "retry_at" in lease:
+                    lease_raylet = self._get_raylet_client(tuple(lease["retry_at"]))
+                    hops += 1
+                    continue
+                try:
+                    client = self._get_worker_client(tuple(lease["address"]))
+                except (ConnectionLost, OSError):
+                    self._return_lease(lease, lease_raylet)
+                    continue
+                self._on_worker_idle(sig, lease, lease_raylet, client, stash_ok=False)
+                return
+        except Exception as e:  # noqa: BLE001 - fail one waiting spec
+            with self._lease_lock:
+                waiting = self._lease_waiting.get(sig)
+                spec = waiting.popleft() if waiting else None
+            if spec is not None:
+                self._fail_task(spec, e)
+        finally:
+            with self._lease_lock:
+                self._lease_inflight[sig] = max(
+                    0, self._lease_inflight.get(sig, 1) - 1
+                )
+            self._ensure_lease_requests(sig)
+
+    def _on_worker_idle(self, sig, lease, lease_raylet, client, stash_ok=True):
+        """A leased worker has no task: give it the next waiting spec, or
+        (when ``stash_ok``, i.e. it just finished a task) cache the lease
+        briefly — the sweeper returns it if demand stays zero. A freshly
+        granted lease with no takers goes straight back to the raylet."""
+        with self._lease_lock:
+            waiting = self._lease_waiting.get(sig)
+            spec = waiting.popleft() if waiting else None
+            if spec is None and stash_ok:
+                if len(self._idle_leases.setdefault(sig, [])) < 16:
+                    self._idle_leases[sig].append(
+                        (lease, lease_raylet, client, time.monotonic())
+                    )
+                    return
+        if spec is None:
+            self._return_lease(lease, lease_raylet)
+            return
+        self._push_spec(spec, sig, lease, lease_raylet, client)
+
+    def _push_spec(self, spec, sig, lease, lease_raylet, client, cacheable=True):
+        """Push one task to a leased worker; when the reply arrives the
+        worker goes back through _on_worker_idle (cacheable leases) or the
+        lease is returned (affinity leases)."""
+
+        def _worker_idle():
+            if cacheable:
+                self._on_worker_idle(sig, lease, lease_raylet, client)
+            else:
+                self._return_lease(lease, lease_raylet)
+
+        def on_done(kind, payload, spec=spec):
+            if kind == rpc_mod.RESPONSE:
+                _worker_idle()
+                self._handle_reply(spec, payload)
+            elif isinstance(payload, (ConnectionLost, OSError)):
+                self._return_lease(lease, lease_raylet)
+                # worker died mid-task: owner-side retry (task_manager.h:277)
+                if spec["retries_left"] > 0:
+                    spec["retries_left"] -= 1
+                    logger.warning(
+                        "task %s lost worker, retrying (%d left)",
+                        spec["name"],
+                        spec["retries_left"],
+                    )
+                    self._submit_queue.put(spec)
+                else:
+                    self._fail_task(
+                        spec,
+                        WorkerCrashedError(
+                            f"worker died running {spec['name']}: {payload}"
+                        ),
+                    )
+            else:
+                _worker_idle()
+                self._fail_task(spec, payload)
+
+        client.call_async("push_task", spec, on_done)
+
+    def _sweep_idle_leases(self, max_age: float = 1.0):
+        """Return leases that sat unused past max_age (runs on the event
+        loop tick); prevents hoarding when the queue drains elsewhere."""
+        to_return = []
+        now = time.monotonic()
+        with self._lease_lock:
+            for sig, stack in self._idle_leases.items():
+                keep = []
+                for item in stack:
+                    (keep if now - item[3] <= max_age else to_return).append(item)
+                self._idle_leases[sig] = keep
+        for lease, lease_raylet, _client, _ts in to_return:
+            self._return_lease(lease, lease_raylet)
+
     def _submit_loop(self):
         while not self._shutdown.is_set():
             try:
@@ -664,6 +858,8 @@ class CoreWorker:
             try:
                 if spec.get("__action__") == "send_actor":
                     self._send_actor_task(spec["spec"])
+                elif spec.get("__action__") == "lease":
+                    self._acquire_lease(spec["sig"])
                 elif spec.get("actor_id") is not None and spec.get("method") is not None:
                     if spec.get("ordered", True):
                         self._enqueue_actor_task(spec)
@@ -682,6 +878,18 @@ class CoreWorker:
         submitter pool size."""
         self._resolve_deps(spec["deps"], spec["nested"])
         spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
+        sig = self._lease_sig(spec)
+        if sig is not None:
+            # scheduling-key path (reference: direct_task_transport.cc —
+            # tasks queue per resource shape; granted/idle leased workers
+            # pop from the queue and run tasks back to back)
+            import collections
+
+            with self._lease_lock:
+                self._lease_waiting.setdefault(sig, collections.deque()).append(spec)
+            self._maybe_push_from_cache(sig)
+            self._ensure_lease_requests(sig)
+            return
         lease_raylet = self.raylet
         hops = 0
         if spec.get("scheduling_node") is not None:
@@ -737,32 +945,13 @@ class CoreWorker:
                 self._return_lease(lease, lease_raylet)
                 continue
 
-            def on_done(kind, payload, spec=spec, lease=lease, lease_raylet=lease_raylet):
-                self._return_lease(lease, lease_raylet)
-                if kind == rpc_mod.RESPONSE:
-                    self._handle_reply(spec, payload)
-                elif isinstance(payload, (ConnectionLost, OSError)):
-                    # worker died mid-task: owner-side retry (task_manager.h:277)
-                    if spec["retries_left"] > 0:
-                        spec["retries_left"] -= 1
-                        logger.warning(
-                            "task %s lost worker, retrying (%d left)",
-                            spec["name"],
-                            spec["retries_left"],
-                        )
-                        self._submit_queue.put(spec)
-                    else:
-                        self._fail_task(
-                            spec,
-                            WorkerCrashedError(
-                                f"worker died running {spec['name']}: {payload}"
-                            ),
-                        )
-                else:
-                    self._fail_task(spec, payload)
-
-            client.call_async("push_task", spec, on_done)
+            self._push_with_lease(spec, sig, lease, lease_raylet, client)
             return
+
+    def _push_with_lease(self, spec, sig, lease, lease_raylet, client):
+        """Affinity-path push (sig is None): one lease per task, returned on
+        completion — constrained leases are never cached."""
+        self._push_spec(spec, sig, lease, lease_raylet, client, cacheable=False)
 
     def _return_lease(self, lease, lease_raylet=None):
         try:
@@ -945,47 +1134,89 @@ class CoreWorker:
         self._pump_actor(actor_id)
 
     def _pump_actor(self, actor_id: ActorID):
-        """Send the next in-order actor task if none is in flight. May run on
-        a submitter thread or the rpc callback executor."""
+        """Dispatch every in-order queued call up to the in-flight window
+        (pipelining: the reference keeps many calls in flight per handle and
+        the worker-side queue orders execution —
+        direct_actor_task_submitter.cc). May run on a submitter thread or
+        the rpc callback executor."""
         import heapq
 
+        to_send = []
         with self._actor_lock:
-            if self._actor_busy.get(actor_id):
-                return
             heap = self._actor_pending.get(actor_id) or []
             nxt = self._actor_next_send.get(actor_id, 0)
-            if not (heap and heap[0][0] == nxt):
-                return
-            _, _, spec = heapq.heappop(heap)
-            self._actor_busy[actor_id] = True
-        # hop back to a submitter thread: address resolution can block
-        self._submit_queue.put({"__action__": "send_actor", "spec": spec})
+            inflight = self._actor_inflight.get(actor_id, 0)
+            cap = GlobalConfig.actor_max_inflight
+            while heap and heap[0][0] == nxt and inflight < cap:
+                _, _, spec = heapq.heappop(heap)
+                to_send.append(spec)
+                nxt += 1
+                inflight += 1
+            self._actor_next_send[actor_id] = nxt
+            self._actor_inflight[actor_id] = inflight
+        for spec in to_send:
+            # hop to a submitter thread: address resolution can block
+            self._submit_queue.put({"__action__": "send_actor", "spec": spec})
 
     def _actor_task_done(self, spec: Dict[str, Any]):
         if not spec.get("ordered", True):
             return
         actor_id = spec["actor_id"]
         with self._actor_lock:
-            self._actor_next_send[actor_id] = spec["seq_no"] + 1
-            self._actor_busy[actor_id] = False
+            self._actor_inflight[actor_id] = max(
+                0, self._actor_inflight.get(actor_id, 1) - 1
+            )
         self._pump_actor(actor_id)
+
+    def _advance_wire(self, actor_id: ActorID, spec: Dict[str, Any]):
+        if not spec.get("ordered", True):
+            return
+        with self._actor_wire_cv:
+            nxt = self._actor_wire_next.get(actor_id, 0)
+            if spec["seq_no"] >= nxt:
+                self._actor_wire_next[actor_id] = spec["seq_no"] + 1
+            self._actor_wire_cv.notify_all()
 
     def _send_actor_task(self, spec: Dict[str, Any]):
         """Resolve the actor address (blocking, submitter thread) and push
-        asynchronously; completion runs on the callback executor."""
+        asynchronously; completion runs on the callback executor. Ordered
+        calls pass a wire-order gate before the push so the actor's
+        connection carries them in sequence order even though several
+        submitter threads race. Any unexpected failure must still advance
+        the gate and the in-flight window, or the actor wedges."""
+        try:
+            self._send_actor_task_inner(spec)
+        except Exception as e:  # noqa: BLE001
+            self._advance_wire(spec["actor_id"], spec)
+            self._fail_task(spec, e)
+            self._actor_task_done(spec)
+
+    def _send_actor_task_inner(self, spec: Dict[str, Any]):
         self._resolve_deps(spec["deps"], spec["nested"])
         spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
         actor_id = spec["actor_id"]
+        if spec.get("ordered", True):
+            deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
+            with self._actor_wire_cv:
+                while (
+                    self._actor_wire_next.get(actor_id, 0) != spec["seq_no"]
+                    and not self._shutdown.is_set()
+                ):
+                    if time.monotonic() > deadline:
+                        break  # a predecessor stalled: fail open, not deadlock
+                    self._actor_wire_cv.wait(0.5)
         attempts = 0
         while not self._shutdown.is_set():
             attempts += 1
             try:
                 addr = self._resolve_actor(actor_id)
             except ActorDiedError as e:
+                self._advance_wire(actor_id, spec)
                 self._fail_task(spec, e)
                 self._actor_task_done(spec)
                 return
             except GetTimeoutError as e:
+                self._advance_wire(actor_id, spec)
                 self._fail_task(spec, e)
                 self._actor_task_done(spec)
                 return
@@ -996,6 +1227,7 @@ class CoreWorker:
                 with self._actor_lock:
                     self._actor_info.pop(actor_id, None)
                 if attempts > 50:
+                    self._advance_wire(actor_id, spec)
                     self._fail_task(
                         spec, ActorDiedError(f"actor {actor_id.hex()[:8]} unreachable")
                     )
@@ -1026,6 +1258,7 @@ class CoreWorker:
                 self._actor_task_done(spec)
 
             client.call_async("push_task", spec, on_done)
+            self._advance_wire(actor_id, spec)
             return
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -1051,6 +1284,7 @@ class CoreWorker:
 
     def _event_loop(self):
         while not self._shutdown.wait(1.0):
+            self._sweep_idle_leases()
             with self._events_lock:
                 batch, self._events = self._events, []
             if batch:
@@ -1091,6 +1325,7 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown.set()
+        self._sweep_idle_leases(max_age=0.0)  # return every cached lease
         for _ in self._submitters:
             self._submit_queue.put(None)
         with self._worker_clients_lock:

@@ -98,9 +98,25 @@ class NodeInfo:
 
 
 class GcsServer:
+    # heartbeats must never queue behind long-poll handlers (wait_for_actor
+    # etc. can park the dispatch pool): they run inline on the read loop,
+    # which is safe because they only touch _lock briefly
+    RPC_INLINE = ("heartbeat",)
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.server = RpcServer("gcs", host, port)
-        self._lock = threading.RLock()
+        self._lock = threading.Condition(threading.RLock())
+        # bounded executors for actor/pg scheduling (a thread per schedule
+        # would mean 10k threads at the reference's 10k-actor envelope);
+        # separate pools because actors may wait on pg commits
+        self._actor_sched_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="gcs-actor-sched"
+        )
+        self._pg_sched_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="gcs-pg-sched"
+        )
         self._kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> key -> value
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._actors: Dict[ActorID, ActorInfo] = {}
@@ -134,6 +150,9 @@ class GcsServer:
     def _publish(self, channel: str, message: Any):
         with self._lock:
             subs = list(self._subscribers.get(channel, ()))
+            # every published transition also wakes long-poll waiters
+            # (wait_for_actor / wait_placement_group)
+            self._lock.notify_all()
         for conn in subs:
             conn.notify(channel, message)
 
@@ -274,9 +293,7 @@ class GcsServer:
                     raise ValueError(f"actor name {info.name!r} already taken")
                 self._named_actors[info.name] = actor_id
             self._actors[actor_id] = info
-        threading.Thread(
-            target=self._schedule_actor, args=(info,), name="gcs-actor-sched", daemon=True
-        ).start()
+        self._actor_sched_pool.submit(self._schedule_actor, info)
         return True
 
     def rpc_get_actor(self, conn, payload):
@@ -301,13 +318,15 @@ class GcsServer:
         """Long-poll until the actor is ALIVE or DEAD; returns its view."""
         actor_id, timeout = payload
         deadline = time.monotonic() + (timeout if timeout is not None else 1e9)
-        while time.monotonic() < deadline:
-            with self._lock:
+        with self._lock:
+            while True:
                 info = self._actors.get(actor_id)
                 if info is not None and info.state in (ALIVE, DEAD):
                     return info.public_view()
-            time.sleep(0.005)
-        return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._lock.wait(min(remaining, 1.0))
 
     def rpc_kill_actor(self, conn, payload):
         actor_id, no_restart = payload
@@ -369,7 +388,10 @@ class GcsServer:
             if node is None and affinity is not None and soft:
                 node = self._pick_node(resources)
             if node is None:
-                time.sleep(0.1)
+                # wake immediately when a node registers/frees resources
+                # (register/heartbeat paths notify via _publish)
+                with self._lock:
+                    self._lock.wait(0.5)
                 continue
             lease = None
             client = None
@@ -484,9 +506,7 @@ class GcsServer:
                 info.num_restarts,
                 info.max_restarts,
             )
-            threading.Thread(
-                target=self._schedule_actor, args=(info,), daemon=True
-            ).start()
+            self._actor_sched_pool.submit(self._schedule_actor, info)
 
     def _handle_node_death(self, node_id: NodeID):
         with self._lock:
@@ -518,9 +538,7 @@ class GcsServer:
                 node_id.hex()[:8],
             )
             self._release_bundles(p.pg_id, survivors[p.pg_id])
-            threading.Thread(
-                target=self._schedule_pg, args=(p,), name="gcs-pg-resched", daemon=True
-            ).start()
+            self._pg_sched_pool.submit(self._schedule_pg, p)
 
     # ------------------------------------------------------------------
     # placement groups (two-phase prepare/commit, reference:
@@ -532,22 +550,22 @@ class GcsServer:
         info = PlacementGroupInfo(pg_id, spec)
         with self._lock:
             self._pgs[pg_id] = info
-        threading.Thread(
-            target=self._schedule_pg, args=(info,), name="gcs-pg-sched", daemon=True
-        ).start()
+        self._pg_sched_pool.submit(self._schedule_pg, info)
         return True
 
     def rpc_wait_placement_group(self, conn, payload):
         """Long-poll until the group is CREATED or REMOVED (failed)."""
         pg_id, timeout = payload
         deadline = time.monotonic() + (timeout if timeout is not None else 1e9)
-        while time.monotonic() < deadline:
-            with self._lock:
+        with self._lock:
+            while True:
                 info = self._pgs.get(pg_id)
                 if info is not None and info.state in (PG_CREATED, PG_REMOVED):
                     return info.public_view()
-            time.sleep(0.01)
-        return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._lock.wait(min(remaining, 1.0))
 
     def rpc_remove_placement_group(self, conn, payload):
         pg_id = payload
@@ -556,6 +574,7 @@ class GcsServer:
             if info is None or info.state == PG_REMOVED:
                 return False
             info.state = PG_REMOVED
+            self._lock.notify_all()
             assignment = [
                 (i, node_id)
                 for i, node_id in enumerate(info.bundle_nodes)
@@ -742,6 +761,7 @@ class GcsServer:
                     info.bundle_nodes = list(plan)
                     info.state = PG_CREATED
                     outcome = "created"
+                self._lock.notify_all()
             if outcome == "removed":
                 self._release_bundles(info.pg_id, committed)
                 return
@@ -754,6 +774,7 @@ class GcsServer:
         with self._lock:
             info.state = PG_REMOVED
             info.failure = "scheduling failed: no feasible placement in time"
+            self._lock.notify_all()
         self._publish(f"pg:{info.pg_id.hex()}", info.public_view())
 
     def _release_bundles(self, pg_id, assignment: List[Tuple[int, NodeID]]):

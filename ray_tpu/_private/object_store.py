@@ -43,11 +43,32 @@ class MemoryStore:
     def __init__(self):
         self._objects: Dict[ObjectID, bytes] = {}
         self._cv = threading.Condition()
+        self._version = 0  # bumped on every put: lets wait() block on change
 
     def put(self, object_id: ObjectID, data: bytes):
         with self._cv:
             self._objects[object_id] = data
+            self._version += 1
             self._cv.notify_all()
+
+    @property
+    def version(self) -> int:
+        with self._cv:
+            return self._version
+
+    def wait_change(self, version: int, timeout: float) -> int:
+        """Block until a put lands after ``version`` (or timeout); returns
+        the current version. Task completions (inline results and plasma
+        markers) all arrive via put, so callers can sleep instead of
+        polling (replaces the 2 ms spin the round-1 review flagged)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._version == version:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            return self._version
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._cv:
@@ -182,6 +203,8 @@ class PlasmaStore:
             session_dir, f"spill_{name}"
         )
         self._closed = False
+        self._flush_queue: List[ObjectID] = []
+        self._spill_pending_bytes = 0  # un-flushed spill_data held in heap
         if self._spill_enabled:
             # disk writes happen off the store lock: _spill_locked only
             # copies bytes out of the arena; this thread persists them
@@ -206,6 +229,12 @@ class PlasmaStore:
                 )
             self._entries[object_id] = _Entry(offset, size, creating_worker)
             return offset
+
+    def put_bytes(self, object_id: ObjectID, data: bytes, creating_worker=None):
+        """create+write+seal in one step (single-RPC path for small puts)."""
+        offset = self.create(object_id, len(data), creating_worker)
+        self._view[offset : offset + len(data)] = data
+        self.seal(object_id)
 
     def seal(self, object_id: ObjectID):
         with self._cv:
@@ -277,11 +306,15 @@ class PlasmaStore:
                 self._entries.pop(object_id)
                 if e.resident:
                     self._arena.free(e.offset)
-                elif e.spill_path is not None:
-                    try:
-                        os.unlink(e.spill_path)
-                    except OSError:
-                        pass
+                else:
+                    if e.spill_data is not None:
+                        self._spill_pending_bytes -= e.size
+                        e.spill_data = None
+                    if e.spill_path is not None:
+                        try:
+                            os.unlink(e.spill_path)
+                        except OSError:
+                            pass
 
     def _evict_locked(self, needed: int):
         """Free ``needed`` bytes: spill unpinned sealed objects to disk when
@@ -307,25 +340,36 @@ class PlasmaStore:
                 break
 
     def _spill_locked(self, object_id: ObjectID, e: _Entry):
-        """Copy the object out of the arena (memcpy only — the disk write
-        happens on the flusher thread, off the store lock)."""
-        e.spill_data = bytes(self._view[e.offset : e.offset + e.size])
+        """Move the object out of the arena. Fast path: memcpy into heap +
+        async flush. Backpressure: once un-flushed bytes exceed half the
+        arena, write synchronously (bounded memory beats bounded latency
+        when producers outrun the disk)."""
+        if self._spill_pending_bytes > self.capacity // 2:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, object_id.hex())
+            with open(path, "wb") as f:
+                f.write(self._view[e.offset : e.offset + e.size])
+            e.spill_path = path
+        else:
+            e.spill_data = bytes(self._view[e.offset : e.offset + e.size])
+            self._spill_pending_bytes += e.size
+            self._flush_queue.append(object_id)
         self._arena.free(e.offset)
         e.offset = -1
         self._cv.notify_all()
 
     def _flush_loop(self):
         while not self._closed:
-            target = None
             with self._cv:
-                for oid, e in self._entries.items():
-                    if e.spill_data is not None and e.spill_path is None:
-                        target = (oid, e, e.spill_data)
-                        break
-                if target is None:
+                while not self._flush_queue and not self._closed:
                     self._cv.wait(0.5)
-                    continue
-            oid, e, data = target
+                if self._closed:
+                    return
+                oid = self._flush_queue.pop(0)
+                e = self._entries.get(oid)
+                data = e.spill_data if e is not None else None
+                if data is None:
+                    continue  # restored or deleted before the flush
             os.makedirs(self._spill_dir, exist_ok=True)
             path = os.path.join(self._spill_dir, oid.hex())
             with open(path, "wb") as f:
@@ -335,6 +379,7 @@ class PlasmaStore:
                 if cur is e and e.spill_data is data and not e.resident:
                     e.spill_path = path
                     e.spill_data = None
+                    self._spill_pending_bytes -= e.size
                 else:
                     # restored or deleted while we were writing
                     try:
@@ -352,6 +397,7 @@ class PlasmaStore:
             return False
         if e.spill_data is not None:
             self._view[offset : offset + e.size] = e.spill_data
+            self._spill_pending_bytes -= e.size
         else:
             # cold path: the object was flushed to disk. The read happens
             # under the lock — bounded by the object's size; the common
@@ -430,8 +476,13 @@ class PlasmaClient:
     def put_serialized(self, object_id: ObjectID, sobj: serialization.SerializedObject):
         size = sobj.total_size()
         deadline = time.monotonic() + GlobalConfig.object_store_full_retry_s
+        small = size <= 256 * 1024
         while True:
             try:
+                if small:
+                    # one RPC carrying the bytes instead of create+seal
+                    self._rpc("store_put", (object_id, sobj.to_bytes()))
+                    return
                 offset = self._rpc("store_create", (object_id, size))
                 break
             except ValueError:

@@ -532,6 +532,11 @@ class Raylet:
         object_id, size = payload
         return self.store.create(object_id, size)
 
+    def rpc_store_put(self, conn, payload):
+        object_id, data = payload
+        self.store.put_bytes(object_id, data)
+        return True
+
     def rpc_store_seal(self, conn, payload):
         self.store.seal(payload)
         return True

@@ -92,16 +92,64 @@ class ServerConn:
             pass
 
 
+class Deferred:
+    """Returned by an inline handler whose reply is produced later (e.g. an
+    ordered actor task executed by the actor's own thread). The reply is
+    sent from the resolving thread via ``on_resolve`` — no pool thread is
+    parked per in-flight call (a pipelining caller would otherwise exhaust
+    the target's dispatch pool)."""
+
+    __slots__ = ("_lock", "_resolved", "value", "is_error", "_cb")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._resolved = False
+        self.value: Any = None
+        self.is_error = False
+        self._cb = None
+
+    def resolve(self, value: Any, is_error: bool = False):
+        with self._lock:
+            self.value = value
+            self.is_error = is_error
+            self._resolved = True
+            cb = self._cb
+        if cb is not None:
+            cb(self)
+
+    def on_resolve(self, cb):
+        with self._lock:
+            if not self._resolved:
+                self._cb = cb
+                return
+        cb(self)
+
+
 class RpcServer:
-    """Thread-per-connection RPC server.
+    """RPC server with a shared dispatch thread pool.
 
     Handlers: ``fn(conn: ServerConn, payload) -> reply``. Raising inside a
     handler sends an ERROR frame carrying the exception.
+
+    Handlers registered with ``inline=True`` run on the connection's read
+    loop itself — they must be non-blocking and are used where arrival
+    order matters (ordered actor queues, reference:
+    core_worker/transport/actor_scheduling_queue.cc). An inline handler
+    may return a ``Deferred`` whose resolution is awaited on a pool thread.
+
+    The pool reuses threads: a thread per request both thrashed the
+    1-core host and crashed pyarrow's mimalloc in mi_thread_init.
     """
 
     def __init__(self, name: str = "rpc", host: str = "127.0.0.1", port: int = 0):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.name = name
         self._handlers: Dict[str, Callable[[ServerConn, Any], Any]] = {}
+        self._inline: set = set()
+        self._pool = ThreadPoolExecutor(
+            max_workers=GlobalConfig.rpc_dispatch_threads, thread_name_prefix=f"{name}-h"
+        )
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -120,14 +168,19 @@ class RpcServer:
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
-    def register(self, method: str, fn: Callable[[ServerConn, Any], Any]):
+    def register(self, method: str, fn: Callable[[ServerConn, Any], Any], inline: bool = False):
         self._handlers[method] = fn
+        if inline:
+            self._inline.add(method)
 
     def register_all(self, obj: Any, prefix: str = ""):
-        """Register every ``rpc_<name>`` method of obj as handler ``<name>``."""
+        """Register every ``rpc_<name>`` method of obj as handler ``<name>``;
+        methods listed in obj.RPC_INLINE run on the connection read loop."""
+        inline_set = set(getattr(obj, "RPC_INLINE", ()))
         for attr in dir(obj):
             if attr.startswith("rpc_"):
-                self.register(prefix + attr[4:], getattr(obj, attr))
+                name = attr[4:]
+                self.register(prefix + name, getattr(obj, attr), inline=name in inline_set)
 
     def _accept_loop(self):
         while not self._stopped.is_set():
@@ -153,14 +206,14 @@ class RpcServer:
                 kind, msg_id, method, payload = _recv_frame(conn.sock)
                 if kind != REQUEST:
                     continue
-                threading.Thread(
-                    target=self._dispatch,
-                    args=(conn, msg_id, method, payload),
-                    name=f"{self.name}-h-{method}",
-                    daemon=True,
-                ).start()
+                if method in self._inline:
+                    self._dispatch_inline(conn, msg_id, method, payload)
+                else:
+                    self._pool.submit(self._dispatch, conn, msg_id, method, payload)
         except (ConnectionLost, OSError):
             pass
+        except RuntimeError:
+            pass  # pool shut down during server stop
         finally:
             with self._conns_lock:
                 self._conns.pop(id(conn), None)
@@ -171,12 +224,45 @@ class RpcServer:
                 except Exception:
                     pass
 
+    def _dispatch_inline(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
+        """Run an order-sensitive handler on the read loop; a Deferred reply
+        is awaited on a pool thread so the loop keeps draining frames."""
+        handler = self._handlers[method]
+        try:
+            reply = handler(conn, payload)
+        except Exception as e:  # noqa: BLE001
+            try:
+                _send_frame(conn.sock, (ERROR, msg_id, method, e), conn.send_lock)
+            except (ConnectionLost, OSError):
+                conn.closed.set()
+            return
+        if isinstance(reply, Deferred):
+            reply.on_resolve(self._deferred_sender(conn, msg_id, method))
+        else:
+            try:
+                _send_frame(conn.sock, (RESPONSE, msg_id, method, reply), conn.send_lock)
+            except (ConnectionLost, OSError):
+                conn.closed.set()
+
+    def _deferred_sender(self, conn: ServerConn, msg_id: int, method: str):
+        def _send(d: Deferred):
+            try:
+                kind = ERROR if d.is_error else RESPONSE
+                _send_frame(conn.sock, (kind, msg_id, method, d.value), conn.send_lock)
+            except (ConnectionLost, OSError):
+                conn.closed.set()
+
+        return _send
+
     def _dispatch(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
         handler = self._handlers.get(method)
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r} on {self.name}")
             reply = handler(conn, payload)
+            if isinstance(reply, Deferred):
+                reply.on_resolve(self._deferred_sender(conn, msg_id, method))
+                return
             _send_frame(conn.sock, (RESPONSE, msg_id, method, reply), conn.send_lock)
         except (ConnectionLost, OSError):
             conn.closed.set()
@@ -200,6 +286,7 @@ class RpcServer:
             conns = list(self._conns.values())
         for c in conns:
             c.close()
+        self._pool.shutdown(wait=False)
 
 
 class _CallbackExecutor:

@@ -20,25 +20,50 @@ from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.core_worker import CoreWorker, PLASMA_MARKER, TaskError
 from ray_tpu._private.ids import ActorID, ObjectID, WorkerID
-from ray_tpu._private.rpc import RpcServer, ServerConn
+from ray_tpu._private.rpc import Deferred, RpcServer, ServerConn
 
 logger = logging.getLogger(__name__)
 
 
 class _ActorState:
+    """Hosts one actor instance plus its in-order execution queue.
+
+    Ordered (max_concurrency==1) calls run on a dedicated thread consuming
+    the queue in arrival order — arrival order equals the caller's send
+    order because push_task is an inline rpc handler (enqueued on the
+    connection read loop) and each caller pushes on one TCP connection in
+    sequence order. This is the pipelined equivalent of the reference's
+    ActorSchedulingQueue (transport/actor_scheduling_queue.cc): many calls
+    in flight, execution strictly serialized and ordered."""
+
     def __init__(self, instance: Any, max_concurrency: int):
         self.instance = instance
         self.max_concurrency = max_concurrency
         self.sem = threading.Semaphore(max_concurrency)
+        import collections
+
+        self.queue: "collections.deque" = collections.deque()
+        self.cv = threading.Condition()
+        self.thread: Optional[threading.Thread] = None
+
+    def enqueue(self, item):
+        with self.cv:
+            self.queue.append(item)
+            self.cv.notify()
 
 
 class TaskExecutor:
+    # push_task runs inline on the connection read loop so ordered actor
+    # calls enqueue in arrival order; the actual execution happens on the
+    # actor's thread (ordered) or the server pool (normal/unordered).
+    RPC_INLINE = ("push_task",)
+
     def __init__(self, core: CoreWorker, server: RpcServer):
         self.core = core
         self.server = server
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actors_lock = threading.Lock()
-        server.register("push_task", self.rpc_push_task)
+        server.register("push_task", self.rpc_push_task, inline=True)
         server.register("create_actor", self.rpc_create_actor)
         server.register("kill_self", self.rpc_kill_self)
         server.register("health", lambda conn, p: "ok")
@@ -130,10 +155,56 @@ class TaskExecutor:
 
     # ------------------------------------------------------------------
 
-    def rpc_push_task(self, conn: ServerConn, spec: Dict[str, Any]) -> Dict[str, Any]:
+    def rpc_push_task(self, conn: ServerConn, spec: Dict[str, Any]):
+        """Inline handler: must not block. Routes to the actor's ordered
+        queue or the dispatch pool and returns a Deferred reply."""
+        d = Deferred()
         if spec.get("actor_id") is not None and spec.get("method") is not None:
-            return self._execute_actor_task(spec)
-        return self._execute_normal_task(spec)
+            with self._actors_lock:
+                state = self._actors.get(spec["actor_id"])
+            if state is None:
+                raise RuntimeError(
+                    f"actor {spec['actor_id'].hex()[:8]} not hosted on this worker"
+                )
+            if spec.get("ordered", True) and state.max_concurrency == 1:
+                if state.thread is None:
+                    state.thread = threading.Thread(
+                        target=self._actor_exec_loop,
+                        args=(state,),
+                        name=f"actor-{spec['actor_id'].hex()[:8]}",
+                        daemon=True,
+                    )
+                    state.thread.start()
+                state.enqueue((spec, d))
+            else:
+                self.server._pool.submit(
+                    self._resolve_with, d, self._execute_actor_task, spec
+                )
+        else:
+            self.server._pool.submit(
+                self._resolve_with, d, self._execute_normal_task, spec
+            )
+        return d
+
+    def _resolve_with(self, d: Deferred, fn, spec):
+        try:
+            d.resolve(fn(spec))
+        except Exception as e:  # noqa: BLE001
+            d.resolve(e, is_error=True)
+
+    def _actor_exec_loop(self, state: _ActorState):
+        while True:
+            with state.cv:
+                while not state.queue:
+                    state.cv.wait()
+                spec, d = state.queue.popleft()
+            try:
+                d.resolve(self._execute_actor_task(spec))
+            except BaseException as e:  # noqa: BLE001 - incl. SystemExit:
+                # the loop thread must survive (its death would strand every
+                # queued Deferred); sys.exit() from a method surfaces as an
+                # error reply, matching exit-from-task semantics
+                d.resolve(e if isinstance(e, Exception) else RuntimeError(repr(e)), is_error=True)
 
     def _execute_normal_task(self, spec) -> Dict[str, Any]:
         task_id = spec["task_id"]

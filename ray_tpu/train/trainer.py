@@ -202,10 +202,13 @@ class DataParallelTrainer(BaseTrainer):
                         if "checkpoint" in entry:
                             ckpt_manager.register(entry["checkpoint"], metrics)
                     elif "checkpoint" in entry:
-                        logger.debug(
-                            "dropping checkpoint reported by rank %d (rank-0 "
-                            "checkpoints are canonical)", rank,
-                        )
+                        if not getattr(self, "_warned_nonzero_ckpt", False):
+                            self._warned_nonzero_ckpt = True
+                            logger.warning(
+                                "dropping checkpoint reported by rank %d: only "
+                                "rank-0 checkpoints are persisted (report "
+                                "checkpoints from rank 0)", rank,
+                            )
 
         pending = list(run_refs)
         while pending:
