@@ -47,6 +47,7 @@ class GPTConfig:
     remat: bool = True
     scan_layers: bool = True
     attn_use_pallas: Optional[bool] = None  # None → auto (TPU only)
+    seq_parallel_impl: str = "ring"         # "ring" | "ulysses" (used when sp>1)
 
     @property
     def qkv_dim(self) -> int:
@@ -140,6 +141,7 @@ def _dense(features: Tuple[int, ...], logical_axes: Tuple[str, ...], cfg: GPTCon
 
 class Attention(nn.Module):
     cfg: GPTConfig
+    mesh: Any = None  # set when the seq axis is sharded (sp > 1)
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -151,13 +153,20 @@ class Attention(nn.Module):
         q = _rotary(q, positions, cfg.rotary_dim)
         k = _rotary(k, positions, cfg.rotary_dim)
         # [b, t, h, d] → [b, h, t, d] for the fused kernel
-        out = dot_product_attention(
-            q.transpose(0, 2, 1, 3),
-            k.transpose(0, 2, 1, 3),
-            v.transpose(0, 2, 1, 3),
-            causal=True,
-            use_pallas=cfg.attn_use_pallas,
-        ).transpose(0, 2, 1, 3)
+        qh, kh, vh = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+            # context parallelism: ring/ulysses over the sp axis
+            # (first-class long-context support — SURVEY.md §5)
+            from ray_tpu.ops.ring import sequence_parallel_attention
+
+            out = sequence_parallel_attention(
+                qh, kh, vh, self.mesh, impl=cfg.seq_parallel_impl, causal=True,
+                use_pallas=cfg.attn_use_pallas,
+            ).transpose(0, 2, 1, 3)
+        else:
+            out = dot_product_attention(
+                qh, kh, vh, causal=True, use_pallas=cfg.attn_use_pallas
+            ).transpose(0, 2, 1, 3)
         return _dense((cfg.embed_dim,), ("heads", "kv", "embed"), cfg, "o", use_bias=False)(
             out
         )
@@ -186,6 +195,7 @@ def _layer_norm(cfg: GPTConfig, name: str):
 
 class Block(nn.Module):
     cfg: GPTConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -193,17 +203,18 @@ class Block(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
         if cfg.parallel_residual:
             hidden = _layer_norm(cfg, "ln")(x)
-            x = x + Attention(cfg, name="attn")(hidden, positions) + Mlp(
+            x = x + Attention(cfg, self.mesh, name="attn")(hidden, positions) + Mlp(
                 cfg, name="mlp"
             )(hidden)
         else:
-            x = x + Attention(cfg, name="attn")(_layer_norm(cfg, "ln1")(x), positions)
+            x = x + Attention(cfg, self.mesh, name="attn")(_layer_norm(cfg, "ln1")(x), positions)
             x = x + Mlp(cfg, name="mlp")(_layer_norm(cfg, "ln2")(x))
         return nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
 
 class ScannedBlocks(nn.Module):
     cfg: GPTConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
@@ -218,10 +229,10 @@ class ScannedBlocks(nn.Module):
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block(cfg, name="layers"), x, None)
+            )(block(cfg, self.mesh, name="layers"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"layer_{i}")(x, positions)
+                x = block(cfg, self.mesh, name=f"layer_{i}")(x, positions)
         return x
 
 
@@ -233,6 +244,7 @@ class GPT(nn.Module):
 
     cfg: GPTConfig
     return_hidden: bool = False
+    mesh: Any = None  # enables ring/ulysses attention when sp > 1
 
     @nn.compact
     def __call__(self, tokens: jax.Array, positions: Optional[jax.Array] = None):
@@ -252,7 +264,7 @@ class GPT(nn.Module):
             name="wte",
         )
         x = embed(tokens)
-        x = ScannedBlocks(cfg, name="blocks")(x, positions)
+        x = ScannedBlocks(cfg, self.mesh, name="blocks")(x, positions)
         x = _layer_norm(cfg, "ln_f")(x)
         if cfg.tie_embeddings:
             kernel = embed.embedding.T  # [d, vocab]

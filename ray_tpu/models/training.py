@@ -134,7 +134,8 @@ def make_train_step(
     donate: bool = True,
 ) -> Callable:
     """Build `step(state, tokens) -> (state, metrics)`, jitted with shardings."""
-    model = GPT(cfg, return_hidden=True)
+    # ring/ulysses attention activates when the mesh shards the sequence
+    model = GPT(cfg, return_hidden=True, mesh=_sp_mesh(mesh))
     active_rules = list(rules if rules is not None else shd.DEFAULT_RULES)
 
     def loss_fn(params, tokens):
@@ -177,8 +178,15 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else (), **kwargs)
 
 
-def make_eval_step(cfg: GPTConfig) -> Callable:
-    model = GPT(cfg, return_hidden=True)
+def _sp_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    return mesh if (mesh is not None and mesh.shape.get("sp", 1) > 1) else None
+
+
+def make_eval_step(cfg: GPTConfig, mesh: Optional[Mesh] = None) -> Callable:
+    """Pass the training mesh so sp>1 eval uses the same ring/ulysses path
+    (dense attention would all-gather full K/V and OOM at the context
+    lengths the sp axis exists for)."""
+    model = GPT(cfg, return_hidden=True, mesh=_sp_mesh(mesh))
 
     @jax.jit
     def eval_step(params, tokens):
@@ -188,9 +196,9 @@ def make_eval_step(cfg: GPTConfig) -> Callable:
     return eval_step
 
 
-def make_forward(cfg: GPTConfig) -> Callable:
+def make_forward(cfg: GPTConfig, mesh: Optional[Mesh] = None) -> Callable:
     """Jittable pure forward (logits) — used by __graft_entry__.entry()."""
-    model = GPT(cfg)
+    model = GPT(cfg, mesh=_sp_mesh(mesh))
 
     def forward(params, tokens):
         return model.apply({"params": params}, tokens)

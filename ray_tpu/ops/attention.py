@@ -16,7 +16,7 @@ VMEM scratch across kv steps.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +66,97 @@ def _attention_xla(
         logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_offset=0,
+) -> Tuple[jax.Array, jax.Array]:
+    """(out, logsumexp) over [b,h,t_q,d] — the merge-ready block primitive
+    for ring/blockwise attention (online-softmax combining across kv
+    blocks). ``kv_offset`` is the global position of k/v's first row when
+    the block is a slice of a longer sequence; with the default, a shorter
+    q is treated as the suffix of the context (chunked-prefill layout).
+    Differentiable end to end (plain XLA ops)."""
+    *_, t_q, d = q.shape
+    t_kv = k.shape[-2]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        if isinstance(kv_offset, int) and kv_offset == 0:
+            q_pos = jnp.arange(t_q)[:, None] + (t_kv - t_q)
+        else:
+            q_pos = jnp.arange(t_q)[:, None]
+        k_pos = kv_offset + jnp.arange(t_kv)[None, :]
+        logits = jnp.where((q_pos >= k_pos)[None, None], logits, NEG_INF)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", jnp.exp(logits - lse[..., None]).astype(v.dtype), v
+    )
+    return out, lse
+
+
+def merge_attention(o, lse, o_new, lse_new, valid=True):
+    """Online-softmax merge of two normalized partial attentions
+    (o in f32, lse from attention_with_lse); the single source of the
+    logaddexp rule shared by ring and blockwise attention."""
+    valid = jnp.asarray(valid)
+    lse_out = jnp.where(valid, jnp.logaddexp(lse, lse_new), lse)
+    w_old = jnp.exp(lse - lse_out)[..., None]
+    w_new = jnp.where(valid, jnp.exp(lse_new - lse_out), 0.0)[..., None]
+    return o * w_old + o_new.astype(jnp.float32) * w_new, lse_out
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    chunk: int = 512,
+) -> jax.Array:
+    """Memory-efficient attention without Pallas: lax.scan over kv chunks
+    with an online-softmax carry; each chunk rematerializes in the backward
+    (jax.checkpoint). Peak memory holds one [b,h,t_q,chunk] block instead
+    of the full [b,h,t_q,t_kv] logits — the XLA-only long-context fallback
+    (SURVEY.md §5 blockwise attention)."""
+    b, h, t_q, d = q.shape
+    t_kv = k.shape[-2]
+    scale_val = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
+    if t_kv % chunk != 0 or t_kv <= chunk:
+        return _attention_xla(q, k, v, causal=causal, scale=scale_val)
+    nc = t_kv // chunk
+    ks = k.reshape(b, h, nc, chunk, d).transpose(2, 0, 1, 3, 4)
+    vs = v.reshape(b, h, nc, chunk, d).transpose(2, 0, 1, 3, 4)
+    q_off = t_kv - t_q  # q rows are the suffix of the context
+
+    @jax.checkpoint
+    def chunk_update(carry, idx, k_c, v_c):
+        o, m = carry  # o normalized-so-far [b,h,t_q,d] f32, m lse [b,h,t_q]
+        o_c, lse_c = attention_with_lse(
+            q, k_c, v_c, causal=causal, scale=scale_val,
+            kv_offset=idx * chunk - q_off,
+        )
+        return merge_attention(o, m, o_c, lse_c)
+
+    def body(carry, xs):
+        idx, k_c, v_c = xs
+        return chunk_update(carry, idx, k_c, v_c), None
+
+    init = (
+        jnp.zeros((b, h, t_q, d), jnp.float32),
+        jnp.full((b, h, t_q), NEG_INF, jnp.float32),
+    )
+    (o, _m), _ = jax.lax.scan(body, init, (jnp.arange(nc), ks, vs))
+    return o.astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

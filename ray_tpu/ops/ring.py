@@ -1,0 +1,161 @@
+"""Sequence/context parallelism: ring attention and Ulysses over the sp axis.
+
+First-class long-context components (SURVEY.md §5: the reference has no
+sequence parallelism anywhere — long-model support was delegated to
+DeepSpeed/Alpa; here they are native ops):
+
+- **Ring attention**: K/V shards rotate around the `sp` ICI ring via
+  ``lax.ppermute``; each hop computes a blockwise attention against the
+  local Q and merges with the online-softmax rule. Q never moves; peak
+  activation memory is one K/V shard per device.
+- **Ulysses**: ``all_to_all`` swaps the head and sequence axes so each
+  device holds *all* positions for a slice of heads, runs the fused Pallas
+  flash kernel on the full sequence, and swaps back. Best when
+  local_heads % sp == 0; rides the custom-vjp flash kernels.
+
+Both are exact (tested against dense attention on the CPU mesh) and
+differentiable. ``sequence_parallel_attention`` is the mesh-level wrapper
+the model calls; with sp == 1 it falls through to the fused kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import (
+    attention_with_lse,
+    dot_product_attention,
+    merge_attention,
+)
+
+try:  # jax>=0.6 top-level; older versions keep it in experimental
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    sp: int,
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """Shard-local ring attention (call under shard_map).
+
+    q/k/v: [b, h_loc, t_loc, d] — the local sequence chunk. Chunks are laid
+    out contiguously: device i holds positions [i*t_loc, (i+1)*t_loc).
+    Step 0 is the local (causal) block; step j receives chunk (my - j) mod
+    sp, which under causal masking contributes fully iff my >= j.
+    """
+    scale_val = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
+    my = jax.lax.axis_index(axis_name)
+    o0, lse0 = attention_with_lse(q, k, v, causal=causal, scale=scale_val)
+    o, lse = o0.astype(jnp.float32), lse0
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(carry, j):
+        o, lse, k_blk, v_blk = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        o_j, lse_j = attention_with_lse(q, k_blk, v_blk, causal=False, scale=scale_val)
+        # after j hops we hold chunk (my - j) mod sp: a *previous* chunk
+        # (fully visible) iff my >= j; otherwise a future chunk (masked out)
+        valid = (my >= j) if causal else jnp.bool_(True)
+        o, lse = merge_attention(o, lse, o_j, lse_j, valid)
+        return (o, lse, k_blk, v_blk), None
+
+    if sp > 1:
+        (o, lse, _, _), _ = jax.lax.scan(
+            step, (o, lse, k, v), jnp.arange(1, sp)
+        )
+    return o.astype(q.dtype)
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    sp: int,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+):
+    """Shard-local Ulysses attention (call under shard_map).
+
+    all_to_all reshapes [b, h_loc, t_loc, d] -> [b, h_loc/sp, t_full, d],
+    runs full-sequence fused attention (Pallas fwd+bwd on TPU), and swaps
+    back. Requires h_loc % sp == 0.
+    """
+    h_loc = q.shape[1]
+    if h_loc % sp != 0:
+        raise ValueError(f"ulysses needs local heads ({h_loc}) divisible by sp ({sp})")
+
+    def swap_in(x):  # heads -> devices, gather sequence
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def swap_out(x):  # sequence -> devices, gather heads
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    out = dot_product_attention(
+        swap_in(q), swap_in(k), swap_in(v),
+        causal=causal, scale=scale, use_pallas=use_pallas,
+    )
+    return swap_out(out)
+
+
+def sequence_parallel_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    impl: str = "ring",
+    sp_axis: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+    batch_axes=("dp", "fsdp"),
+    head_axis: str = "tp",
+) -> jax.Array:
+    """Mesh-level context-parallel attention over [b, h, T, d] arrays whose
+    sequence dim is sharded on ``sp_axis`` (batch on dp/fsdp, heads on tp).
+
+    With sp == 1 this is the plain fused kernel; otherwise the chosen
+    implementation runs under shard_map so the collectives (ppermute ring
+    or all_to_all) ride the ICI mesh explicitly.
+    """
+    sp = mesh.shape.get(sp_axis, 1)
+    if sp == 1:
+        return dot_product_attention(
+            q, k, v, causal=causal, scale=scale, use_pallas=use_pallas
+        )
+    spec = P(batch_axes, head_axis, sp_axis, None)
+    if impl == "ring":
+        local = functools.partial(
+            ring_attention_local, axis_name=sp_axis, sp=sp, causal=causal, scale=scale
+        )
+    elif impl == "ulysses":
+        local = functools.partial(
+            ulysses_attention_local, axis_name=sp_axis, sp=sp, causal=causal,
+            scale=scale, use_pallas=use_pallas,
+        )
+    else:
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:  # kw renamed across jax versions (check_rep -> check_vma)
+        fn = shard_map(lambda a, b, c: local(a, b, c), check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover
+        fn = shard_map(lambda a, b, c: local(a, b, c), check_rep=False, **kwargs)
+    return fn(q, k, v)
