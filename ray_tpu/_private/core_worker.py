@@ -1169,8 +1169,9 @@ class CoreWorker:
         self._pump_actor(actor_id)
 
     def _advance_wire(self, actor_id: ActorID, spec: Dict[str, Any]):
-        if not spec.get("ordered", True):
-            return
+        # EVERY send advances the gate — ordered and unordered calls share
+        # the per-actor seq counter, so an unordered send that skipped the
+        # gate must still move it or later ordered calls wait forever
         with self._actor_wire_cv:
             nxt = self._actor_wire_next.get(actor_id, 0)
             if spec["seq_no"] >= nxt:

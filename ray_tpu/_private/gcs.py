@@ -81,6 +81,7 @@ class ActorInfo:
             "name": self.name,
             "death_cause": self.death_cause,
             "class_name": self.spec.get("class_name", ""),
+            "max_concurrency": self.spec["options"].get("max_concurrency", 1),
         }
 
 
@@ -819,6 +820,8 @@ class GcsServer:
     def stop(self):
         self._stopped.set()
         self.server.stop()
+        self._actor_sched_pool.shutdown(wait=False)
+        self._pg_sched_pool.shutdown(wait=False)
         with self._lock:
             for c in self._raylet_clients.values():
                 c.close()

@@ -269,7 +269,12 @@ def get_actor(name: str) -> ActorHandle:
     if view is None:
         raise ValueError(f"no actor named {name!r}")
     # method names unknown from the view; allow any attribute
-    return _AnyMethodActorHandle(view["actor_id"], (), view.get("class_name", ""))
+    return _AnyMethodActorHandle(
+        view["actor_id"],
+        (),
+        view.get("class_name", ""),
+        view.get("max_concurrency", 1),
+    )
 
 
 class _AnyMethodActorHandle(ActorHandle):

@@ -1,0 +1,173 @@
+"""ray_tpu.serve: model serving — controller, replicas, router, batching.
+
+Reference surface: python/ray/serve (serve.run/deployment/delete,
+controller.py:80, router.py:281, replica.py:520, batching.py). Replicas
+wrap jitted predict callables; @serve.batch's bucket_sizes keep batch
+shapes XLA-static.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.proxy import HTTPProxy
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPProxy",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start_http_proxy",
+    "status",
+]
+
+
+class Deployment:
+    def __init__(self, func_or_class, name: str, config: Dict[str, Any]):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    # public kwarg -> internal config key (same remapping deployment() does)
+    _OPTION_KEYS = {
+        "autoscaling_config": "autoscaling",
+        "ray_actor_options": "resources",
+    }
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = {
+            **self.config,
+            **{self._OPTION_KEYS.get(k, k): v for k, v in overrides.items()},
+        }
+        name = cfg.pop("name", self.name)
+        unknown = set(cfg) - {"num_replicas", "user_config", "autoscaling", "resources"}
+        if unknown:
+            raise TypeError(f"unknown deployment options: {sorted(unknown)}")
+        return Deployment(self.func_or_class, name, cfg)
+
+    def bind(self, *init_args, **init_kwargs) -> "Application":
+        return Application(self, init_args, init_kwargs)
+
+
+class Application:
+    def __init__(self, deployment_obj: Deployment, init_args, init_kwargs):
+        self.deployment = deployment_obj
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+def deployment(
+    _func_or_class=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    user_config: Any = None,
+    autoscaling_config: Optional[Dict[str, Any]] = None,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+):
+    """``@serve.deployment`` decorator (reference: serve/api.py deployment)."""
+
+    def deco(target):
+        return Deployment(
+            target,
+            name or getattr(target, "__name__", "deployment"),
+            {
+                "num_replicas": num_replicas,
+                "user_config": user_config,
+                "autoscaling": autoscaling_config,
+                "resources": ray_actor_options,
+            },
+        )
+
+    return deco if _func_or_class is None else deco(_func_or_class)
+
+
+def _get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        pass
+    try:
+        return ServeController.options(name=CONTROLLER_NAME, max_restarts=1).remote()
+    except Exception:
+        # lost the create race: someone else made it
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def run(target, *, name: Optional[str] = None, wait_for_replicas: bool = True,
+        timeout: float = 60.0) -> DeploymentHandle:
+    """Deploy an Application (or bare Deployment) and return its handle."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application/Deployment, got {target!r}")
+    dep = target.deployment
+    dep_name = name or dep.name
+    controller = _get_or_create_controller()
+    spec = {
+        "func_or_class": dep.func_or_class,
+        "init_args": target.init_args,
+        "init_kwargs": target.init_kwargs,
+        **dep.config,
+    }
+    ray_tpu.get(controller.deploy.remote(dep_name, spec), timeout=timeout)
+    handle = DeploymentHandle(dep_name)
+    if wait_for_replicas:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            table = ray_tpu.get(
+                controller.get_routing_table.remote(dep_name), timeout=30
+            )
+            if table and table["replicas"]:
+                break
+            _time.sleep(0.05)
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.status.remote(), timeout=30)
+
+
+def delete(name: str, timeout: float = 30.0) -> bool:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.delete_deployment.remote(name), timeout=timeout)
+
+
+def shutdown(timeout: float = 30.0):
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=timeout)
+    finally:
+        try:
+            ray_tpu.kill(controller)
+        except Exception:
+            pass
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> HTTPProxy:
+    """Start an in-driver HTTP ingress (POST /<deployment> with JSON)."""
+    return HTTPProxy(host, port)

@@ -1,0 +1,136 @@
+"""@serve.batch: dynamic request batching inside a replica.
+
+Reference: serve/batching.py (@serve.batch decorator). Requests queue in
+the replica; a flusher calls the wrapped fn with a list when either
+``max_batch_size`` items are waiting or ``batch_wait_timeout_s`` elapses.
+
+TPU twist (SURVEY.md §7.7): XLA recompiles per input shape, so
+``bucket_sizes`` restricts flush sizes to a fixed set — a full bucket
+flushes immediately; at timeout the largest bucket <= queue length
+flushes (or the whole remainder when it is smaller than every bucket, in
+which case the callable should pad internally)."""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class _Pending:
+    __slots__ = ("item", "event", "result", "error")
+
+    def __init__(self, item):
+        self.item = item
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    def __init__(self, fn, max_batch_size, batch_wait_timeout_s, bucket_sizes):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self.buckets = sorted(bucket_sizes) if bucket_sizes else None
+        if self.buckets:
+            self.max_batch_size = self.buckets[-1]
+        self.queue: List[_Pending] = []
+        self.cv = threading.Condition()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def submit(self, item):
+        p = _Pending(item)
+        with self.cv:
+            self.queue.append(p)
+            self.cv.notify_all()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _flush_size(self, n: int, timed_out: bool) -> int:
+        if n >= self.max_batch_size:
+            return self.max_batch_size
+        if not timed_out:
+            return 0
+        if not self.buckets:
+            return n
+        fitting = [b for b in self.buckets if b <= n]
+        return fitting[-1] if fitting else n
+
+    def _loop(self):
+        while True:
+            with self.cv:
+                while not self.queue:
+                    self.cv.wait()
+                start = time.monotonic()
+                while (
+                    len(self.queue) < self.max_batch_size
+                    and time.monotonic() - start < self.timeout
+                ):
+                    self.cv.wait(self.timeout / 4)
+                take = self._flush_size(len(self.queue), timed_out=True)
+                batch, self.queue = self.queue[:take], self.queue[take:]
+            if not batch:
+                continue
+            try:
+                results = self.fn([p.item for p in batch])
+                if len(results) != len(batch):
+                    raise ValueError(
+                        f"@serve.batch fn returned {len(results)} results for "
+                        f"a batch of {len(batch)}"
+                    )
+                for p, r in zip(batch, results):
+                    p.result = r
+                    p.event.set()
+            except BaseException as e:  # noqa: BLE001
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 8,
+    batch_wait_timeout_s: float = 0.01,
+    bucket_sizes: Optional[Sequence[int]] = None,
+):
+    """Decorator: ``fn(list_of_items) -> list_of_results`` becomes an
+    item-at-a-time callable that batches concurrent callers."""
+
+    def deco(fn):
+        # no lock captured here: the decorated fn is pickled to replicas
+        # and locks are unpicklable; the batcher materializes lazily in
+        # the process that first calls it (key absent until then —
+        # setdefault must be able to store the first batcher)
+        holder = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            # support bound methods: the last positional arg is the item
+            item = args[-1]
+            bound = args[:-1]
+            # one batcher per bound instance (keyed by id), not per
+            # decorated function: two instances in one process must not
+            # flush each other's requests against the wrong self
+            key = id(bound[0]) if bound else "__fn__"
+            b = holder.get(key)
+            if b is None:
+                b = _Batcher(
+                    lambda items: fn(*bound, items),
+                    max_batch_size,
+                    batch_wait_timeout_s,
+                    bucket_sizes,
+                )
+                # dict.setdefault is atomic under the GIL: one batcher wins
+                # (a loser's idle flusher thread is the only, benign, leak)
+                b = holder.setdefault(key, b)
+            return b.submit(item)
+
+        return wrapper
+
+    return deco if _fn is None else deco(_fn)

@@ -1,0 +1,227 @@
+"""Serve controller: the control plane actor reconciling deployments.
+
+Reference: serve/controller.py:80 (deploy_application:459),
+_private/deployment_state.py:1076 (_scale_deployment_replicas:1454),
+_private/autoscaling_policy.py:54 + calculate_desired_num_replicas:10.
+State: target deployments -> replica actor sets; a version counter lets
+handles cheaply refresh routing tables (the long-poll push channel of the
+reference's LongPollHost, pull-flavored).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.replica import Replica
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+@ray_tpu.remote(max_concurrency=16)
+class ServeController:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # serializes reconciliation: deploy() and the background loop would
+        # otherwise double-create replicas (and over-subscribe the cluster)
+        self._reconcile_lock = threading.Lock()
+        # name -> {spec, replicas: [handle], version}
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._version = 0
+        self._stop = threading.Event()
+        self._loop = threading.Thread(
+            target=self._reconcile_loop, name="serve-reconcile", daemon=True
+        )
+        self._loop.start()
+
+    # -- API ------------------------------------------------------------
+
+    def deploy(self, name: str, spec: Dict[str, Any]) -> bool:
+        """spec: {func_or_class, init_args, init_kwargs, num_replicas,
+        user_config, autoscaling: {min_replicas, max_replicas,
+        target_ongoing_requests}, resources}"""
+        reconfigure_refs = []
+        with self._lock:
+            existing = self._deployments.get(name)
+            if existing is not None:
+                old_spec = existing["spec"]
+                existing["spec"] = spec
+                if old_spec.get("user_config") != spec.get("user_config"):
+                    # collect refs under the lock, wait outside it: a hung
+                    # replica must not stall get_routing_table for everyone
+                    reconfigure_refs = [
+                        r.reconfigure.remote(spec.get("user_config"))
+                        for r in existing["replicas"]
+                    ]
+                self._version += 1
+            else:
+                self._deployments[name] = {
+                    "spec": spec,
+                    "replicas": [],
+                    "version": 0,
+                }
+                self._version += 1
+        for ref in reconfigure_refs:
+            try:
+                ray_tpu.get(ref, timeout=30)
+            except Exception:
+                pass
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            dep = self._deployments.pop(name, None)
+            self._version += 1
+        if dep is None:
+            return False
+        for r in dep["replicas"]:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        return True
+
+    def get_routing_table(self, name: str):
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None:
+                return None
+            return {"replicas": list(dep["replicas"]), "version": self._version}
+
+    def routing_version(self) -> int:
+        return self._version
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "num_replicas": len(dep["replicas"]),
+                    "target": self._target_replicas(dep),
+                }
+                for name, dep in self._deployments.items()
+            }
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        with self._lock:
+            deps = list(self._deployments.values())
+            self._deployments.clear()
+        for dep in deps:
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    # -- reconciliation ---------------------------------------------------
+
+    def _target_replicas(self, dep) -> int:
+        spec = dep["spec"]
+        auto = spec.get("autoscaling")
+        if not auto:
+            return int(spec.get("num_replicas", 1))
+        return int(dep.get("autoscale_target", auto.get("min_replicas", 1)))
+
+    def _reconcile_once(self):
+        with self._reconcile_lock:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self):
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            target = self._target_replicas(dep)
+            spec = dep["spec"]
+            changed = False
+            # prune DEAD replicas; a timeout means the replica is still
+            # starting (health would block on PENDING_CREATION) — keep it,
+            # or slow cold starts trigger runaway re-creation
+            alive = []
+            for r in dep["replicas"]:
+                try:
+                    ray_tpu.get(r.health.remote(), timeout=10)
+                    alive.append(r)
+                except ray_tpu.GetTimeoutError:
+                    alive.append(r)
+                except Exception:
+                    changed = True
+            created = []
+            while len(alive) + len(created) < target:
+                opts = dict(spec.get("resources") or {"num_cpus": 1})
+                created.append(
+                    Replica.options(**opts).remote(
+                        name,
+                        spec["func_or_class"],
+                        spec.get("init_args"),
+                        spec.get("init_kwargs"),
+                        spec.get("user_config"),
+                    )
+                )
+                changed = True
+            to_kill = []
+            while len(alive) + len(created) > target and alive:
+                to_kill.append(alive.pop())
+                changed = True
+            with self._lock:
+                if self._deployments.get(name) is not dep:
+                    # deleted (or replaced) while we reconciled: the actors
+                    # we just created belong to nobody — reap them
+                    to_kill.extend(created)
+                    to_kill.extend(alive)
+                    changed = False
+                else:
+                    dep["replicas"] = alive + created
+                    if changed:
+                        self._version += 1
+            for r in to_kill:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            if changed:
+                logger.info(
+                    "deployment %s reconciled to %d replicas", name, len(alive) + len(created)
+                )
+
+    def _autoscale_once(self):
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, dep in items:
+            auto = dep["spec"].get("autoscaling")
+            if not auto or not dep["replicas"]:
+                continue
+            ongoing = 0
+            for r in dep["replicas"]:
+                try:
+                    ongoing += ray_tpu.get(r.get_metrics.remote(), timeout=10)["ongoing"]
+                except Exception:
+                    pass
+            target_per = max(float(auto.get("target_ongoing_requests", 2.0)), 0.1)
+            desired = math.ceil(ongoing / target_per) if ongoing else auto.get(
+                "min_replicas", 1
+            )
+            desired = min(
+                max(desired, auto.get("min_replicas", 1)), auto.get("max_replicas", 8)
+            )
+            if desired != len(dep["replicas"]):
+                logger.info(
+                    "autoscaling %s: ongoing=%d -> %d replicas", name, ongoing, desired
+                )
+            dep["autoscale_target"] = desired
+
+    def _reconcile_loop(self):
+        interval = 1.0
+        while not self._stop.wait(interval):
+            try:
+                self._autoscale_once()
+                self._reconcile_once()
+            except Exception:
+                logger.exception("serve reconcile iteration failed")

@@ -1,0 +1,130 @@
+"""DeploymentHandle: the request path (router + replica picking).
+
+Reference: serve/_private/router.py:313 Router (assign_replica:281 —
+power-of-two-choices on queue length) + serve/handle.py. The handle caches
+the routing table and refreshes it when the controller's version moves or
+a replica dies; replica choice is po2 over locally tracked in-flight
+counts (the reference's same heuristic without an extra RPC)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+from ray_tpu.serve.controller import CONTROLLER_NAME
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef. A replica death
+    surfaces at result(); the response retries once on fresh replicas
+    (actor submission is async, so the send itself never fails fast)."""
+
+    MAX_DEATH_RETRIES = 3
+
+    def __init__(self, ref, handle, replica_idx, call, attempt: int = 0):
+        self._ref = ref
+        self._handle = handle
+        self._replica_idx = replica_idx
+        self._call = call  # (method, args, kwargs) for the death-retry
+        self._attempt = attempt
+
+    def result(self, timeout: Optional[float] = 60.0):
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except ray_tpu.ActorDiedError:
+            if self._attempt >= self.MAX_DEATH_RETRIES:
+                raise  # every replica in the table may be dead: surface it
+            self._handle._refresh(force=True)
+            retry = self._handle._send(*self._call, attempt=self._attempt + 1)
+            return retry.result(timeout=timeout)
+        finally:
+            self._handle._finish(self._replica_idx)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: Optional[str]):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._send(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas = []
+        self._version = -1
+        self._inflight: Dict[int, int] = {}
+        self._last_refresh = 0.0
+
+    # -- routing ----------------------------------------------------------
+
+    def _controller(self):
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        with self._lock:
+            if not force and self._replicas and now - self._last_refresh < 2.0:
+                return
+        table = ray_tpu.get(
+            self._controller().get_routing_table.remote(self.deployment_name),
+            timeout=30,
+        )
+        if table is None:
+            raise ValueError(f"deployment {self.deployment_name!r} not found")
+        with self._lock:
+            self._replicas = table["replicas"]
+            self._version = table["version"]
+            self._inflight = {i: self._inflight.get(i, 0) for i in range(len(self._replicas))}
+            self._last_refresh = now
+
+    def _pick(self) -> int:
+        """Power-of-two choices on locally tracked in-flight counts."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no replicas"
+                )
+            if n == 1:
+                return 0
+            a, b = random.sample(range(n), 2)
+            return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def _finish(self, idx: int):
+        with self._lock:
+            if idx in self._inflight:
+                self._inflight[idx] = max(0, self._inflight[idx] - 1)
+
+    def _send(self, method, args, kwargs, attempt: int = 0) -> DeploymentResponse:
+        self._refresh()
+        idx = self._pick()
+        with self._lock:
+            replica = self._replicas[idx]
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        ref = replica.handle_request.remote(method, args, kwargs)
+        return DeploymentResponse(ref, self, idx, (method, args, kwargs), attempt)
+
+    # -- public -----------------------------------------------------------
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._send(None, args, kwargs)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))

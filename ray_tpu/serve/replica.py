@@ -1,0 +1,64 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference: serve/_private/replica.py:296 RayServeReplica (handle_request
+at :520). The replica tracks in-flight requests (the router's po2 choice
+and the controller's autoscaler read it) and supports live reconfigure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote(max_concurrency=8)
+class Replica:
+    def __init__(self, deployment_name: str, func_or_class, init_args, init_kwargs,
+                 user_config=None):
+        self._name = deployment_name
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        if isinstance(func_or_class, type):
+            self._callable = func_or_class(*(init_args or ()), **(init_kwargs or {}))
+        else:
+            if init_args or init_kwargs:
+                import functools
+
+                self._callable = functools.partial(
+                    func_or_class, *(init_args or ()), **(init_kwargs or {})
+                )
+            else:
+                self._callable = func_or_class
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+        return True
+
+    def handle_request(self, method: Optional[str], args, kwargs):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = self._callable if method is None else getattr(self._callable, method)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def get_metrics(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}
+
+    def health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if callable(fn):
+            fn()
+        return True

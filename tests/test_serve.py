@@ -1,0 +1,247 @@
+"""Serve library tests (reference surface: python/ray/serve/tests/)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def test_deploy_and_call(serve_session):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Doubler.bind())
+    assert handle.remote(21).result() == 42
+    results = [handle.remote(i) for i in range(10)]
+    assert [r.result() for r in results] == [2 * i for i in range(10)]
+    st = serve.status()
+    assert st["Doubler"]["num_replicas"] == 2
+
+
+def test_function_deployment_and_methods(serve_session):
+    @serve.deployment
+    def add_one(x):
+        return x + 1
+
+    h = serve.run(add_one.bind())
+    assert h.remote(5).result() == 6
+
+    @serve.deployment(name="calc")
+    class Calc:
+        def mul(self, a, b):
+            return a * b
+
+        def __call__(self, x):
+            return x
+
+    h2 = serve.run(Calc.bind())
+    assert h2.mul.remote(6, 7).result() == 42
+
+
+def test_init_args_and_user_config(serve_session):
+    @serve.deployment(user_config={"scale": 10})
+    class Scaled:
+        def __init__(self, base):
+            self.base = base
+            self.scale = 1
+
+        def reconfigure(self, cfg):
+            self.scale = cfg["scale"]
+
+        def __call__(self, x):
+            return self.base + x * self.scale
+
+    h = serve.run(Scaled.bind(100))
+    assert h.remote(2).result() == 120
+
+
+def test_replica_death_recovery(serve_session):
+    @serve.deployment(num_replicas=2)
+    class Worker:
+        def __call__(self, x):
+            return x
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    h = serve.run(Worker.bind())
+    assert h.remote(1).result() == 1
+    # kill one replica out from under the handle
+    controller = ray_tpu.get_actor("__serve_controller__")
+    table = ray_tpu.get(controller.get_routing_table.remote("Worker"), timeout=30)
+    ray_tpu.kill(table["replicas"][0])
+    # requests keep succeeding (retry on death + controller respawns)
+    for i in range(10):
+        assert h.remote(i).result(timeout=30) == i
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if serve.status()["Worker"]["num_replicas"] == 2:
+            break
+        time.sleep(0.25)
+    assert serve.status()["Worker"]["num_replicas"] == 2
+
+
+def test_autoscaling_up(serve_session):
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1,
+        }
+    )
+    class Slow:
+        def __call__(self, x):
+            time.sleep(1.0)
+            return x
+
+    h = serve.run(Slow.bind())
+    assert serve.status()["Slow"]["num_replicas"] == 1
+    # pile up requests from background threads to build a queue
+    results = []
+
+    def fire(i):
+        results.append(h.remote(i).result(timeout=60))
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 30
+    scaled = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["num_replicas"] > 1:
+            scaled = True
+            break
+        time.sleep(0.25)
+    for t in threads:
+        t.join(timeout=60)
+    assert scaled, "autoscaler never scaled up under load"
+    assert sorted(results) == list(range(8))
+
+
+def test_dynamic_batching(serve_session):
+    @serve.deployment
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def _infer(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 10 for x in items]
+
+        def __call__(self, x):
+            return self._infer(x)
+
+        def sizes(self):
+            return self.batch_sizes
+
+    h = serve.run(Batched.bind())
+    out = []
+    threads = [
+        threading.Thread(target=lambda i=i: out.append(h.remote(i).result(timeout=30)))
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert sorted(out) == [10 * i for i in range(8)]
+    sizes = h.sizes.remote().result()
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+
+
+def test_http_proxy(serve_session):
+    @serve.deployment(name="echo")
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.run(Echo.bind())
+    proxy = serve.start_http_proxy()
+    req = urllib.request.Request(
+        proxy.address + "/echo",
+        data=json.dumps({"msg": "hi"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = json.loads(resp.read())
+    proxy.stop()
+    assert body == {"result": {"echo": {"msg": "hi"}}}
+
+
+def test_delete_deployment(serve_session):
+    @serve.deployment
+    def f(x):
+        return x
+
+    h = serve.run(f.bind())
+    assert h.remote(1).result() == 1
+    assert serve.delete("f")
+    with pytest.raises(ValueError):
+        serve.get_deployment_handle("f").remote(1)
+
+
+def test_jitted_model_replica_with_buckets(serve_session):
+    """The TPU serving story: replica wraps a jitted predict fn; bucketed
+    batch sizes keep XLA recompilation bounded (SURVEY.md §7.7)."""
+
+    @serve.deployment
+    class JaxModel:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            self.compiled_shapes = set()
+
+            @jax.jit
+            def predict(x):
+                return (x * 2.0 + 1.0).sum(axis=-1)
+
+            self._predict = predict
+            self._jnp = jnp
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.15, bucket_sizes=[1, 2, 4])
+        def _infer(self, items):
+            x = self._jnp.stack([self._jnp.asarray(i, dtype=self._jnp.float32) for i in items])
+            self.compiled_shapes.add(x.shape)
+            return [float(v) for v in self._predict(x)]
+
+        def __call__(self, vec):
+            return self._infer(vec)
+
+        def shapes(self):
+            return sorted(self.compiled_shapes)
+
+    h = serve.run(JaxModel.bind())
+    out = []
+    threads = [
+        threading.Thread(
+            target=lambda i=i: out.append((i, h.remote([float(i)] * 3).result(timeout=60)))
+        )
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert len(out) == 8
+    for i, v in out:
+        assert v == pytest.approx(3 * (2.0 * i + 1.0))
+    # every executed batch used a bucketed (power-of-two) leading dim
+    shapes = h.shapes.remote().result(timeout=30)
+    assert all(s[0] in (1, 2, 4) for s in shapes), shapes
