@@ -1097,9 +1097,15 @@ class CoreWorker:
     ) -> List[ObjectID]:
         task_id = self._next_task_id(actor_id)
         payload, deps, nested = self._serialize_args(args, kwargs)
-        with self._actor_lock:
-            seq = self._actor_seq.get(actor_id, 0)
-            self._actor_seq[actor_id] = seq + 1
+        if ordered:
+            with self._actor_lock:
+                seq = self._actor_seq.get(actor_id, 0)
+                self._actor_seq[actor_id] = seq + 1
+        else:
+            # unordered calls are out-of-band: they must not consume a seq
+            # from the ordered stream, or _pump_actor waits forever for a
+            # seq that will never enter its heap
+            seq = -1
         return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
         spec = {
             "task_id": task_id,
@@ -1169,9 +1175,8 @@ class CoreWorker:
         self._pump_actor(actor_id)
 
     def _advance_wire(self, actor_id: ActorID, spec: Dict[str, Any]):
-        # EVERY send advances the gate — ordered and unordered calls share
-        # the per-actor seq counter, so an unordered send that skipped the
-        # gate must still move it or later ordered calls wait forever
+        # Ordered calls advance the gate past their own seq; unordered
+        # calls carry seq_no=-1 (out-of-band, no gate interaction)
         with self._actor_wire_cv:
             nxt = self._actor_wire_next.get(actor_id, 0)
             if spec["seq_no"] >= nxt:
@@ -1199,8 +1204,11 @@ class CoreWorker:
         if spec.get("ordered", True):
             deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
             with self._actor_wire_cv:
+                # wait only while the gate is BEHIND us: a timed-out
+                # predecessor fails open and jumps the gate past several
+                # seqs at once, in which case we proceed immediately
                 while (
-                    self._actor_wire_next.get(actor_id, 0) != spec["seq_no"]
+                    self._actor_wire_next.get(actor_id, 0) < spec["seq_no"]
                     and not self._shutdown.is_set()
                 ):
                     if time.monotonic() > deadline:

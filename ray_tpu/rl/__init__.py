@@ -1,0 +1,30 @@
+"""ray_tpu.rl: reinforcement learning — RLModule/Learner/rollouts/PPO.
+
+Reference surface: rllib new API stack (core/rl_module, core/learner,
+evaluation/rollout_worker, algorithms/ppo). Rollouts run on CPU actors;
+learning is a jitted functional step that data-parallelizes over a device
+mesh or across learner actors via the host collective layer.
+"""
+
+from ray_tpu.rl.algorithm import PPO, PPOConfig
+from ray_tpu.rl.env import CartPole, VectorEnv, make_env
+from ray_tpu.rl.learner import LearnerGroup, PPOLearner, PPOLossConfig
+from ray_tpu.rl.rl_module import DiscretePolicyModule, RLModule
+from ray_tpu.rl.rollout_worker import RolloutWorker
+from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
+
+__all__ = [
+    "CartPole",
+    "DiscretePolicyModule",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "PPOLossConfig",
+    "RLModule",
+    "RolloutWorker",
+    "SampleBatch",
+    "VectorEnv",
+    "compute_gae",
+    "make_env",
+]

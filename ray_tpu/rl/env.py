@@ -1,0 +1,132 @@
+"""Environments: a dependency-free CartPole + vectorized wrapper.
+
+The reference consumes Gym/Gymnasium environments (reference:
+rllib/env/vector_env.py, multi_agent_env.py); this build ships the classic
+cart-pole control problem natively (standard published dynamics) so the
+learning tests run with zero extra deps. The API follows the gymnasium
+5-tuple convention: step -> (obs, reward, terminated, truncated, info).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class CartPole:
+    """Pole balancing: push a cart left/right, keep the pole upright.
+
+    Observation: [x, x_dot, theta, theta_dot]; actions: {0: left, 1: right};
+    reward 1 per step; episode ends when |theta| > 12deg, |x| > 2.4, or
+    after ``max_steps``.
+    """
+
+    GRAVITY = 9.8
+    CART_MASS = 1.0
+    POLE_MASS = 0.1
+    POLE_HALF_LEN = 0.5
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * np.pi / 180
+    X_LIMIT = 2.4
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, max_steps: int = 500, seed: Optional[int] = None):
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros(4, np.float64)
+        self._t = 0
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32).copy(), {}
+
+    def step(self, action: int):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_mass = self.CART_MASS + self.POLE_MASS
+        pole_ml = self.POLE_MASS * self.POLE_HALF_LEN
+        cos_t, sin_t = np.cos(theta), np.sin(theta)
+        temp = (force + pole_ml * theta_dot**2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.POLE_HALF_LEN * (4.0 / 3.0 - self.POLE_MASS * cos_t**2 / total_mass)
+        )
+        x_acc = temp - pole_ml * theta_acc * cos_t / total_mass
+        x = x + self.DT * x_dot
+        x_dot = x_dot + self.DT * x_acc
+        theta = theta + self.DT * theta_dot
+        theta_dot = theta_dot + self.DT * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._t += 1
+        terminated = bool(
+            abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+        )
+        truncated = self._t >= self.max_steps
+        return (
+            self._state.astype(np.float32).copy(),
+            1.0,
+            terminated,
+            truncated,
+            {},
+        )
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPole}
+
+
+def make_env(name_or_cls, **kwargs):
+    if isinstance(name_or_cls, str):
+        try:
+            cls = ENV_REGISTRY[name_or_cls]
+        except KeyError:
+            raise ValueError(f"unknown env {name_or_cls!r}") from None
+        return cls(**kwargs)
+    return name_or_cls(**kwargs)
+
+
+class VectorEnv:
+    """N independent env copies with auto-reset (reference:
+    rllib/env/vector_env.py)."""
+
+    def __init__(self, env_fn, num_envs: int, seed: int = 0):
+        self.envs: List[Any] = [env_fn() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self._obs = np.stack(
+            [e.reset(seed=seed + i)[0] for i, e in enumerate(self.envs)]
+        )
+
+    @property
+    def observations(self) -> np.ndarray:
+        return self._obs
+
+    def step(self, actions: np.ndarray):
+        """Returns (obs, rewards, terminateds, truncateds, final_obs).
+
+        ``final_obs[i]`` is the PRE-reset observation for envs that ended
+        this step (== obs[i] otherwise): a truncated episode must bootstrap
+        its value target from that state, not from the auto-reset one
+        (reference: rllib bootstraps on time-limit truncation)."""
+        obs, rewards, terms, truncs, finals = [], [], [], [], []
+        for i, (env, a) in enumerate(zip(self.envs, actions)):
+            o, r, term, trunc, _ = env.step(int(a))
+            finals.append(o)
+            if term or trunc:
+                o, _ = env.reset()
+            obs.append(o)
+            rewards.append(r)
+            terms.append(term)
+            truncs.append(trunc)
+        self._obs = np.stack(obs)
+        return (
+            self._obs,
+            np.asarray(rewards, np.float32),
+            np.asarray(terms, np.bool_),
+            np.asarray(truncs, np.bool_),
+            np.stack(finals),
+        )

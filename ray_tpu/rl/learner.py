@@ -1,0 +1,266 @@
+"""Learner / LearnerGroup: the SGD half of the RL stack.
+
+Reference: rllib/core/learner/learner.py:122 (compute_gradients:454,
+update:894) + learner_group.py:59 (remote learner actors :128-136) +
+torch_learner.py:287 (DDP wrap). TPU-first translation: the PPO loss is a
+jitted functional step; data parallelism comes from sharding the batch
+over a device mesh (XLA inserts the psum) or, across learner actors, from
+host-side allreduce via ray_tpu.util.collective — the same split the
+reference gets from DDP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.rl_module import DiscretePolicyModule
+from ray_tpu.rl.sample_batch import SampleBatch
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PPOLossConfig:
+    clip_eps: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 0.5
+
+
+class PPOLearner:
+    """Single-process PPO learner with a jitted update step."""
+
+    def __init__(
+        self,
+        observation_size: int,
+        num_actions: int,
+        *,
+        hidden=(64, 64),
+        lr: float = 3e-4,
+        loss_config: Optional[PPOLossConfig] = None,
+        seed: int = 0,
+        mesh=None,
+    ):
+        self.net = DiscretePolicyModule(num_actions, tuple(hidden))
+        self.loss_cfg = loss_config or PPOLossConfig()
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(self.loss_cfg.grad_clip),
+            optax.adam(lr),
+        )
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, observation_size), jnp.float32)
+        )["params"]
+        self.opt_state = self.optimizer.init(self.params)
+        self.mesh = mesh
+        self._step = self._build_step()
+
+    def _build_step(self):
+        cfg = self.loss_cfg
+        net = self.net
+        optimizer = self.optimizer
+
+        def loss_fn(params, batch):
+            logits, values = net.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv
+            policy_loss = -jnp.minimum(unclipped, clipped).mean()
+            vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = policy_loss + cfg.vf_coeff * vf_loss - cfg.entropy_coeff * entropy
+            return total, {
+                "policy_loss": policy_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+            }
+
+        def step(params, opt_state, batch):
+            (total, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics = {**metrics, "total_loss": total}
+            return params, opt_state, metrics
+
+        # split form for cross-actor gradient sync: grads leave the jit,
+        # get allreduced on the host plane, then re-enter for the update
+        # (the exact point the reference's DDP hooks into)
+        def grad_step(params, batch):
+            (total, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            return grads, {**metrics, "total_loss": total}
+
+        def apply_step(params, opt_state, grads):
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._grad_step = jax.jit(grad_step)
+        self._apply_step = jax.jit(apply_step)
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # batch sharded over dp: XLA turns the mean-gradients into psum
+            data_sharding = NamedSharding(self.mesh, P("dp"))
+            rep = NamedSharding(self.mesh, P())
+            return jax.jit(
+                step,
+                in_shardings=(rep, rep, data_sharding),
+                out_shardings=(rep, rep, rep),
+            )
+        return jax.jit(step)
+
+    def update(self, batch: SampleBatch, *, minibatch_size: int = 128,
+               num_epochs: int = 4, seed: int = 0,
+               grad_sync=None) -> Dict[str, float]:
+        """One PPO update over the batch. ``grad_sync(grads) -> grads`` is
+        applied to every minibatch gradient before the optimizer step —
+        cross-learner allreduce plugs in here so all replicas take
+        identical optimizer steps (true DDP semantics: Adam state stays in
+        sync because it sees the same averaged gradients)."""
+        rng = np.random.default_rng(seed)
+        metrics: Dict[str, float] = {}
+        for _ in range(num_epochs):
+            shuffled = batch.shuffled(rng)
+            for mb in shuffled.minibatches(minibatch_size):
+                jb = {k: jnp.asarray(v) for k, v in mb.items()}
+                if grad_sync is None:
+                    self.params, self.opt_state, m = self._step(
+                        self.params, self.opt_state, jb
+                    )
+                else:
+                    grads, m = self._grad_step(self.params, jb)
+                    grads = grad_sync(grads)
+                    self.params, self.opt_state = self._apply_step(
+                        self.params, self.opt_state, grads
+                    )
+                metrics = {k: float(v) for k, v in m.items()}
+        return metrics
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params):
+        self.params = params
+
+
+@ray_tpu.remote
+class _RemoteLearner:
+    """One learner actor of a LearnerGroup; gradients sync via the host
+    collective layer (ray_tpu.util.collective allreduce), the analogue of
+    the reference's DDP process group."""
+
+    def __init__(self, rank: int, world: int, group: str, learner_kwargs):
+        self.rank, self.world, self.group = rank, world, group
+        self.learner = PPOLearner(**learner_kwargs)
+        if world > 1:
+            from ray_tpu.util import collective
+
+            collective.init_collective_group(world, rank, group_name=group)
+
+    def update(self, batch: SampleBatch, **kw) -> Dict[str, float]:
+        if self.world > 1:
+            from ray_tpu.util import collective
+
+            world = self.world
+            group = self.group
+
+            def grad_sync(grads):
+                # one allreduce per minibatch: flatten every leaf into a
+                # single f32 vector (fewer, larger host-plane collectives)
+                leaves, treedef = jax.tree_util.tree_flatten(grads)
+                sizes = [int(np.prod(l.shape)) for l in leaves]
+                flat = np.concatenate(
+                    [np.asarray(l, np.float32).ravel() for l in leaves]
+                )
+                summed = collective.allreduce(flat, group_name=group)
+                out, off = [], 0
+                for leaf, size in zip(leaves, sizes):
+                    out.append(
+                        jnp.asarray(
+                            summed[off : off + size] / world, leaf.dtype
+                        ).reshape(leaf.shape)
+                    )
+                    off += size
+                return jax.tree_util.tree_unflatten(treedef, out)
+
+            return self.learner.update(batch, grad_sync=grad_sync, **kw)
+        return self.learner.update(batch, **kw)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, params):
+        self.learner.set_weights(params)
+        return True
+
+
+class LearnerGroup:
+    """1 local learner, or N learner actors with host-collective sync
+    (reference: learner_group.py:59)."""
+
+    def __init__(self, learner_kwargs: Dict[str, Any], num_learners: int = 1,
+                 group_name: str = "ppo_learners"):
+        self.num_learners = num_learners
+        if num_learners <= 1:
+            self.local = PPOLearner(**learner_kwargs)
+            self.actors: List[Any] = []
+        else:
+            self.local = None
+            self.actors = [
+                _RemoteLearner.remote(i, num_learners, group_name, learner_kwargs)
+                for i in range(num_learners)
+            ]
+
+    def update(self, batch: SampleBatch, **kw) -> Dict[str, float]:
+        if self.local is not None:
+            return self.local.update(batch, **kw)
+        n = len(batch)
+        # shards must be EQUAL: each minibatch gradient is a collective, so
+        # every learner must take the same number of optimizer steps or the
+        # allreduce deadlocks — the tail is dropped, loudly
+        shard, dropped = divmod(n, self.num_learners)
+        if dropped:
+            logger.warning(
+                "LearnerGroup: dropping %d/%d tail samples (batch not "
+                "divisible by %d learners)", dropped, n, self.num_learners
+            )
+        refs = [
+            a.update.remote(
+                SampleBatch(
+                    {k: v[i * shard : (i + 1) * shard] for k, v in batch.items()}
+                ),
+                **kw,
+            )
+            for i, a in enumerate(self.actors)
+        ]
+        all_metrics = ray_tpu.get(refs, timeout=300)
+        return all_metrics[0]
+
+    def get_weights(self):
+        if self.local is not None:
+            return self.local.get_weights()
+        return ray_tpu.get(self.actors[0].get_weights.remote(), timeout=60)
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

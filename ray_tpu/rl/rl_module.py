@@ -1,0 +1,71 @@
+"""RLModule: the neural policy/value container (new-stack equivalent).
+
+Reference: rllib/core/rl_module/rl_module.py — a framework-specific module
+exposing forward_inference / forward_train. Here it is one Flax module
+with policy logits + value head; params are plain pytrees that travel
+through the object store to rollout workers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DiscretePolicyModule(nn.Module):
+    """MLP torso with categorical-policy and value heads."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"torso_{i}")(x))
+        logits = nn.Dense(self.num_actions, name="policy_head")(x)
+        value = nn.Dense(1, name="value_head")(x)[..., 0]
+        return logits, value
+
+
+class RLModule:
+    """Bundles the Flax module + params with the RLModule forward surface."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64), seed: int = 0):
+        self.net = DiscretePolicyModule(num_actions, tuple(hidden))
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, observation_size), jnp.float32)
+        )["params"]
+        self._fwd = jax.jit(
+            lambda p, obs: self.net.apply({"params": p}, obs)
+        )
+
+    def forward(self, params, obs):
+        return self._fwd(params, obs)
+
+    def forward_inference(self, obs: np.ndarray, rng: np.random.Generator):
+        """Sample actions for rollout (numpy in/out, CPU-friendly)."""
+        logits, value = self._fwd(self.params, jnp.asarray(obs))
+        logits = np.asarray(logits)
+        value = np.asarray(value)
+        # Gumbel-max categorical sampling
+        g = rng.gumbel(size=logits.shape)
+        actions = np.argmax(logits + g, axis=-1)
+        logp_all = logits - _logsumexp(logits)
+        logp = np.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+        return actions.astype(np.int32), logp.astype(np.float32), value.astype(np.float32)
+
+    def set_params(self, params):
+        self.params = params
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(axis=-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(axis=-1, keepdims=True))

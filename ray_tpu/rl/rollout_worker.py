@@ -1,0 +1,101 @@
+"""RolloutWorker actor: vectorized env stepping on CPU hosts.
+
+Reference: rllib/evaluation/rollout_worker.py:166 (sample:879) — remote
+actors run envs and the current policy, returning SampleBatches; weights
+broadcast from the learner between iterations (the classic TPU split:
+rollouts on CPU workers, SGD on the chips).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import VectorEnv, make_env
+from ray_tpu.rl.rl_module import RLModule
+from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
+                 module_config: Dict[str, Any] = None, gamma: float = 0.99,
+                 lam: float = 0.95):
+        self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
+        cfg = module_config or {}
+        probe = make_env(env_name)
+        self.module = RLModule(
+            cfg.get("observation_size", probe.observation_size),
+            cfg.get("num_actions", probe.num_actions),
+            hidden=cfg.get("hidden", (64, 64)),
+            seed=seed,
+        )
+        self._rng = np.random.default_rng(seed + 1)
+        self.gamma = gamma
+        self.lam = lam
+        # episode-return tracking (the learning-test metric)
+        self._ep_returns = np.zeros(num_envs, np.float32)
+        self._completed: list = []
+
+    def set_weights(self, params) -> bool:
+        self.module.set_params(params)
+        return True
+
+    def sample(self, num_steps: int) -> SampleBatch:
+        """Collect num_steps per env; returns a flat SampleBatch with GAE
+        advantages already attached (postprocessing on the worker, like the
+        reference's sampler postprocessors)."""
+        n = self.envs.num_envs
+        obs_buf = np.empty((num_steps, n, self.module.observation_size), np.float32)
+        act_buf = np.empty((num_steps, n), np.int32)
+        rew_buf = np.empty((num_steps, n), np.float32)
+        done_buf = np.empty((num_steps, n), np.bool_)
+        logp_buf = np.empty((num_steps, n), np.float32)
+        val_buf = np.empty((num_steps, n), np.float32)
+        for t in range(num_steps):
+            obs = self.envs.observations
+            actions, logp, values = self.module.forward_inference(obs, self._rng)
+            next_obs, rewards, terms, truncs, finals = self.envs.step(actions)
+            dones = terms | truncs
+            raw_rewards = rewards
+            bootstrap = truncs & ~terms
+            if bootstrap.any():
+                # time-limit truncation is not a real terminal: fold the
+                # value of the final (pre-reset) state into the reward so
+                # GAE's episode cut doesn't bias targets low
+                _, _, final_vals = self.module.forward_inference(
+                    finals, self._rng
+                )
+                rewards = rewards + self.gamma * final_vals * bootstrap
+            obs_buf[t], act_buf[t] = obs, actions
+            rew_buf[t], done_buf[t] = rewards, dones
+            logp_buf[t], val_buf[t] = logp, values
+            self._ep_returns += raw_rewards  # metric excludes the bootstrap
+            for i in np.nonzero(dones)[0]:
+                self._completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+        _, _, last_values = self.module.forward_inference(
+            self.envs.observations, self._rng
+        )
+        adv, rets = compute_gae(
+            rew_buf, val_buf, done_buf, last_values, gamma=self.gamma, lam=self.lam
+        )
+        flat = lambda a: a.reshape(num_steps * n, *a.shape[2:])  # noqa: E731
+        return SampleBatch(
+            obs=flat(obs_buf),
+            actions=flat(act_buf),
+            rewards=flat(rew_buf),
+            dones=flat(done_buf),
+            logp=flat(logp_buf),
+            values=flat(val_buf),
+            advantages=flat(adv),
+            returns=flat(rets),
+        )
+
+    def episode_returns(self, clear: bool = True):
+        out = list(self._completed)
+        if clear:
+            self._completed = []
+        return out

@@ -130,12 +130,17 @@ def run(target, *, name: Optional[str] = None, wait_for_replicas: bool = True,
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
+        while True:
             table = ray_tpu.get(
                 controller.get_routing_table.remote(dep_name), timeout=30
             )
             if table and table["replicas"]:
                 break
+            if _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"deployment {dep_name!r} has no replicas after {timeout}s "
+                    f"(insufficient cluster resources?)"
+                )
             _time.sleep(0.05)
     return handle
 

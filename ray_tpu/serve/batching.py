@@ -5,10 +5,12 @@ the replica; a flusher calls the wrapped fn with a list when either
 ``max_batch_size`` items are waiting or ``batch_wait_timeout_s`` elapses.
 
 TPU twist (SURVEY.md §7.7): XLA recompiles per input shape, so
-``bucket_sizes`` restricts flush sizes to a fixed set — a full bucket
-flushes immediately; at timeout the largest bucket <= queue length
+``bucket_sizes`` restricts flush sizes to a fixed set — a full *largest*
+bucket flushes immediately; at timeout the largest bucket <= queue length
 flushes (or the whole remainder when it is smaller than every bucket, in
-which case the callable should pad internally)."""
+which case the callable should pad internally). Intermediate buckets wait
+for the timeout on purpose: flushing the moment any bucket fills would
+defeat batching under steady low-concurrency load."""
 
 from __future__ import annotations
 

@@ -143,11 +143,20 @@ class ServeController:
             changed = False
             # prune DEAD replicas; a timeout means the replica is still
             # starting (health would block on PENDING_CREATION) — keep it,
-            # or slow cold starts trigger runaway re-creation
+            # or slow cold starts trigger runaway re-creation. Health RPCs
+            # go out in parallel so one wedged replica costs one window,
+            # not 10s per replica serially.
+            health_refs = [(r, r.health.remote()) for r in dep["replicas"]]
+            if health_refs:
+                ray_tpu.wait(
+                    [ref for _, ref in health_refs],
+                    num_returns=len(health_refs),
+                    timeout=10.0,
+                )
             alive = []
-            for r in dep["replicas"]:
+            for r, ref in health_refs:
                 try:
-                    ray_tpu.get(r.health.remote(), timeout=10)
+                    ray_tpu.get(ref, timeout=0.5)
                     alive.append(r)
                 except ray_tpu.GetTimeoutError:
                     alive.append(r)
@@ -198,10 +207,12 @@ class ServeController:
             auto = dep["spec"].get("autoscaling")
             if not auto or not dep["replicas"]:
                 continue
+            refs = [r.get_metrics.remote() for r in dep["replicas"]]
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=10.0)
             ongoing = 0
-            for r in dep["replicas"]:
+            for ref in refs:
                 try:
-                    ongoing += ray_tpu.get(r.get_metrics.remote(), timeout=10)["ongoing"]
+                    ongoing += ray_tpu.get(ref, timeout=0.5)["ongoing"]
                 except Exception:
                     pass
             target_per = max(float(auto.get("target_ongoing_requests", 2.0)), 0.1)
@@ -211,7 +222,21 @@ class ServeController:
             desired = min(
                 max(desired, auto.get("min_replicas", 1)), auto.get("max_replicas", 8)
             )
-            if desired != len(dep["replicas"]):
+            current = dep.get("autoscale_target", len(dep["replicas"]))
+            if desired < current:
+                # downscale cooldown: a single idle sample between bursts
+                # must not kill live replicas (reference applies a
+                # downscale_delay smoothing window)
+                delay = float(auto.get("downscale_delay_s", 10.0))
+                since = dep.get("downscale_since")
+                now = time.monotonic()
+                if since is None:
+                    dep["downscale_since"] = now
+                    continue
+                if now - since < delay:
+                    continue
+            dep.pop("downscale_since", None)
+            if desired != current:
                 logger.info(
                     "autoscaling %s: ongoing=%d -> %d replicas", name, ongoing, desired
                 )

@@ -11,6 +11,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import ray_tpu
@@ -25,12 +26,21 @@ class DeploymentResponse:
 
     MAX_DEATH_RETRIES = 3
 
-    def __init__(self, ref, handle, replica_idx, call, attempt: int = 0):
+    def __init__(self, ref, handle, replica_key, call, attempt: int = 0):
         self._ref = ref
         self._handle = handle
-        self._replica_idx = replica_idx
+        self._replica_key = replica_key
         self._call = call  # (method, args, kwargs) for the death-retry
         self._attempt = attempt
+        self._finished = False
+
+    def _finish_once(self):
+        if not self._finished:
+            self._finished = True
+            # lock-free: may run from __del__ during cyclic GC, which can
+            # fire on a thread already holding the handle's lock (deque
+            # append is atomic under the GIL; the handle drains it later)
+            self._handle._released.append(self._replica_key)
 
     def result(self, timeout: Optional[float] = 60.0):
         try:
@@ -42,7 +52,15 @@ class DeploymentResponse:
             retry = self._handle._send(*self._call, attempt=self._attempt + 1)
             return retry.result(timeout=timeout)
         finally:
-            self._handle._finish(self._replica_idx)
+            self._finish_once()
+
+    def __del__(self):
+        # a response consumed via .ref (or dropped) must still release its
+        # in-flight slot or po2 routing skews away from the replica forever
+        try:
+            self._finish_once()
+        except Exception:
+            pass
 
     @property
     def ref(self):
@@ -64,8 +82,22 @@ class DeploymentHandle:
         self._lock = threading.Lock()
         self._replicas = []
         self._version = -1
-        self._inflight: Dict[int, int] = {}
+        # keyed by replica actor id, not list position: reconciliation can
+        # reorder/replace the table under in-flight responses
+        self._inflight: Dict[Any, int] = {}
+        # slots released by DeploymentResponse (possibly from __del__);
+        # drained under the lock before every pick
+        self._released: "deque" = deque()
         self._last_refresh = 0.0
+
+    def _drain_released_locked(self):
+        while True:
+            try:
+                key = self._released.popleft()
+            except IndexError:
+                return
+            if key in self._inflight:
+                self._inflight[key] = max(0, self._inflight[key] - 1)
 
     # -- routing ----------------------------------------------------------
 
@@ -86,35 +118,33 @@ class DeploymentHandle:
         with self._lock:
             self._replicas = table["replicas"]
             self._version = table["version"]
-            self._inflight = {i: self._inflight.get(i, 0) for i in range(len(self._replicas))}
+            keys = {r._actor_id for r in self._replicas}
+            self._inflight = {k: v for k, v in self._inflight.items() if k in keys}
             self._last_refresh = now
 
-    def _pick(self) -> int:
+    def _pick(self):
         """Power-of-two choices on locally tracked in-flight counts."""
         with self._lock:
+            self._drain_released_locked()
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no replicas"
                 )
             if n == 1:
-                return 0
-            a, b = random.sample(range(n), 2)
-            return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
-
-    def _finish(self, idx: int):
-        with self._lock:
-            if idx in self._inflight:
-                self._inflight[idx] = max(0, self._inflight[idx] - 1)
+                return self._replicas[0]
+            a, b = random.sample(self._replicas, 2)
+            ka, kb = a._actor_id, b._actor_id
+            return a if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0) else b
 
     def _send(self, method, args, kwargs, attempt: int = 0) -> DeploymentResponse:
         self._refresh()
-        idx = self._pick()
+        replica = self._pick()
+        key = replica._actor_id
         with self._lock:
-            replica = self._replicas[idx]
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            self._inflight[key] = self._inflight.get(key, 0) + 1
         ref = replica.handle_request.remote(method, args, kwargs)
-        return DeploymentResponse(ref, self, idx, (method, args, kwargs), attempt)
+        return DeploymentResponse(ref, self, key, (method, args, kwargs), attempt)
 
     # -- public -----------------------------------------------------------
 

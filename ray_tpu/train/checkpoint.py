@@ -15,6 +15,19 @@ import tempfile
 from typing import Any, Dict, Optional
 
 _DICT_FILE = "checkpoint.pkl"
+# reserved marker for a packed raw-directory checkpoint; namespaced and
+# shape-checked so a user dict can't take this branch by accident
+_PACKED_DIR_KEY = "__raytpu_packed_dir_files__"
+
+
+def _is_packed_dir(data: Dict[str, Any]) -> bool:
+    if set(data) != {_PACKED_DIR_KEY}:
+        return False
+    files = data[_PACKED_DIR_KEY]
+    return isinstance(files, dict) and all(
+        isinstance(k, str) and isinstance(v, (bytes, bytearray))
+        for k, v in files.items()
+    )
 
 
 class Checkpoint:
@@ -47,8 +60,20 @@ class Checkpoint:
         if os.path.exists(file):
             with open(file, "rb") as f:
                 return pickle.load(f)
-        # directory checkpoint without a dict payload: expose the file map
-        return {"_directory": self._path}
+        # directory checkpoint without a dict payload (orbax-style shard
+        # layout): pack the file contents so a cross-node consumer receives
+        # the files, not a path that only exists on this node. NOTE: this
+        # materializes the whole directory in host RAM — fine for model
+        # checkpoints shipped through the object store, but very large
+        # multi-shard dirs should be moved via shared storage paths instead.
+        files: Dict[str, bytes] = {}
+        for root, _dirs, names in os.walk(self._path):
+            for name in names:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, self._path)
+                with open(full, "rb") as f:
+                    files[rel] = f.read()
+        return {_PACKED_DIR_KEY: files}
 
     def to_directory(self, path: Optional[str] = None) -> str:
         if path is None:
@@ -57,6 +82,13 @@ class Checkpoint:
         if self._path is not None:
             if os.path.abspath(self._path) != os.path.abspath(path):
                 shutil.copytree(self._path, path, dirs_exist_ok=True)
+        elif _is_packed_dir(self._data):
+            # unpacked form of a raw-directory checkpoint (see to_dict)
+            for rel, blob in self._data[_PACKED_DIR_KEY].items():
+                full = os.path.join(path, rel)
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                with open(full, "wb") as f:
+                    f.write(blob)
         else:
             with open(os.path.join(path, _DICT_FILE), "wb") as f:
                 pickle.dump(self._data, f, protocol=5)
