@@ -48,6 +48,11 @@ class GPTConfig:
     scan_layers: bool = True
     attn_use_pallas: Optional[bool] = None  # None → auto (TPU only)
     seq_parallel_impl: str = "ring"         # "ring" | "ulysses" (used when sp>1)
+    # mixture-of-experts (0 = dense MLP); experts shard over the ep axis
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def qkv_dim(self) -> int:
@@ -62,9 +67,13 @@ class GPTConfig:
             self.mlp_dim,
             self.vocab_size,
         )
+        if self.moe_num_experts:
+            mlp_params = self.moe_num_experts * 2 * d * f + d * self.moe_num_experts
+        else:
+            mlp_params = 2 * d * f + f + d
         per_layer = (
             4 * d * h * hd          # q,k,v,o
-            + 2 * d * f + f + d     # mlp + biases
+            + mlp_params
             + (2 * d if self.parallel_residual else 4 * d)  # ln scale+bias
         )
         head = 0 if self.tie_embeddings else d * v + v
@@ -197,18 +206,25 @@ class Block(nn.Module):
     cfg: GPTConfig
     mesh: Any = None
 
+    def _mlp(self):
+        if self.cfg.moe_num_experts > 0:
+            from ray_tpu.models.moe import MoeMlp
+
+            return MoeMlp(self.cfg, name="mlp")
+        return Mlp(self.cfg, name="mlp")
+
     @nn.compact
     def __call__(self, x: jax.Array, positions: jax.Array) -> jax.Array:
         cfg = self.cfg
         x = nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
         if cfg.parallel_residual:
             hidden = _layer_norm(cfg, "ln")(x)
-            x = x + Attention(cfg, self.mesh, name="attn")(hidden, positions) + Mlp(
-                cfg, name="mlp"
-            )(hidden)
+            x = x + Attention(cfg, self.mesh, name="attn")(hidden, positions) + self._mlp()(
+                hidden
+            )
         else:
             x = x + Attention(cfg, self.mesh, name="attn")(_layer_norm(cfg, "ln1")(x), positions)
-            x = x + Mlp(cfg, name="mlp")(_layer_norm(cfg, "ln2")(x))
+            x = x + self._mlp()(_layer_norm(cfg, "ln2")(x))
         return nn.with_logical_constraint(x, ("batch", "seq", "act_embed"))
 
 
@@ -225,7 +241,7 @@ class ScannedBlocks(nn.Module):
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, positions), None),
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

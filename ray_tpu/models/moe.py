@@ -1,0 +1,114 @@
+"""Mixture-of-experts MLP with expert parallelism over the ``ep`` mesh axis.
+
+The reference has no MoE at all (SURVEY.md §2.6 EP row: absent); this is a
+TPU-first implementation of the GShard/Switch dispatch: top-k routing with a
+STATIC per-expert capacity (XLA-friendly — no dynamic shapes), dispatch and
+combine as einsums whose expert dimension is sharded over ``ep`` so XLA
+inserts the all-to-all, and a load-balancing auxiliary loss sown into the
+``losses`` collection (summed per layer by the scanned block stack).
+
+Expert weights carry the ("expert", "embed", "mlp") logical axes: ep shards
+the expert dim, tp can still shard the mlp dim inside each expert.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoeMlp(nn.Module):
+    """Drop-in replacement for the dense Mlp block when
+    ``cfg.moe_num_experts > 0``."""
+
+    cfg: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        E, k = cfg.moe_num_experts, cfg.moe_top_k
+        b, t, d = x.shape
+        s = b * t
+        xs = x.reshape(s, d)
+
+        # -- routing (f32 numerics) ---------------------------------------
+        w_router = self.param(
+            "router",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "expert")
+            ),
+            (d, E),
+            cfg.param_dtype,
+        )
+        logits = xs.astype(jnp.float32) @ w_router.astype(jnp.float32)  # [s, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # static capacity: k*s assignments spread over E experts, padded by
+        # the capacity factor; never data-dependent
+        capacity = max(1, int(math.ceil(k * s / E * cfg.moe_capacity_factor)))
+
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [s, k]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9
+        )
+
+        # position-in-expert: slot 0 (first choice) of every token gets
+        # priority over slot 1, matching the GShard assignment order
+        onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [s, k, E]
+        flat = onehot.transpose(1, 0, 2).reshape(k * s, E)       # slot-major
+        pos = ((jnp.cumsum(flat, axis=0) - flat) * flat).sum(-1)  # [k*s]
+        assigned = flat.sum(-1)                                   # 0/1
+        keep = (pos < capacity) * assigned
+        slot_oh = jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [k*s, C]
+        # [k*s, E, C] -> [k, s, E, C] -> sum over k -> [s, E, C]
+        disp_flat = flat[:, :, None] * slot_oh[:, None, :] * keep[:, None, None]
+        dispatch = disp_flat.reshape(k, s, E, capacity).sum(0)
+        gates_flat = gate_vals.transpose(1, 0).reshape(k * s)
+        combine = (disp_flat * gates_flat[:, None, None]).reshape(
+            k, s, E, capacity
+        ).sum(0)
+
+        # -- expert computation (all-to-all via ep sharding) --------------
+        wi = self.param(
+            "wi",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("expert", "embed", "mlp")
+            ),
+            (E, d, cfg.mlp_dim),
+            cfg.param_dtype,
+        )
+        wo = self.param(
+            "wo",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("expert", "mlp", "embed")
+            ),
+            (E, cfg.mlp_dim, d),
+            cfg.param_dtype,
+        )
+        expert_in = jnp.einsum(
+            "sec,sd->ecd", dispatch.astype(cfg.dtype), xs.astype(cfg.dtype)
+        )
+        expert_in = nn.with_logical_constraint(expert_in, ("expert", None, None))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(cfg.dtype))
+        h = nn.gelu(h)
+        h = nn.with_logical_constraint(h, ("expert", None, "act_mlp"))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(cfg.dtype))
+        y = jnp.einsum(
+            "sec,ecd->sd", combine.astype(cfg.dtype), expert_out
+        )
+
+        # -- load-balance aux loss (Switch §2.2 form) ---------------------
+        # f_e: fraction of tokens whose FIRST choice is e; P_e: mean router
+        # prob. Perfectly uniform routing gives aux == 1.
+        f = onehot[:, 0, :].mean(0)
+        p = probs.mean(0)
+        aux = (f * p).sum() * E
+        self.sow("losses", "moe_aux", aux.astype(jnp.float32))
+
+        return y.reshape(b, t, d)

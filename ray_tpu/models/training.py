@@ -138,17 +138,31 @@ def make_train_step(
     model = GPT(cfg, return_hidden=True, mesh=_sp_mesh(mesh))
     active_rules = list(rules if rules is not None else shd.DEFAULT_RULES)
 
+    moe = cfg.moe_num_experts > 0
+
+    def _apply(params, tokens):
+        """Run the model; with MoE also collect the per-layer aux losses
+        (sown into the 'losses' collection by MoeMlp)."""
+        if moe:
+            out, mut = model.apply(
+                {"params": params}, tokens, mutable=["losses"]
+            )
+            aux = sum(jnp.sum(v) for v in jax.tree.leaves(mut["losses"]))
+            return out, aux / cfg.num_layers
+        return model.apply({"params": params}, tokens), jnp.zeros((), jnp.float32)
+
     def loss_fn(params, tokens):
         if mesh is not None:
             # Install the logical-axis rule table so the model's
             # with_logical_constraint calls reach XLA (they are silent
             # no-ops when no rules are set).
             with nn.logical_axis_rules(active_rules):
-                hidden, kernel, bias = model.apply({"params": params}, tokens)
+                (hidden, kernel, bias), aux = _apply(params, tokens)
         else:
-            hidden, kernel, bias = model.apply({"params": params}, tokens)
+            (hidden, kernel, bias), aux = _apply(params, tokens)
         # Blockwise xent: never materializes the [b, t, vocab] logits.
-        return blockwise_next_token_loss(hidden, kernel, bias, tokens)
+        loss = blockwise_next_token_loss(hidden, kernel, bias, tokens)
+        return loss + cfg.moe_aux_weight * aux
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)

@@ -1,0 +1,104 @@
+"""Mixture-of-experts layer + expert-parallel GPT training.
+
+(The reference has no MoE — SURVEY.md §2.6 EP row — so exactness is checked
+against the dense MLP with replicated expert weights, which the GShard
+dispatch must reproduce when no token is dropped.)
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.gpt import GPTConfig, gpt_nano
+from ray_tpu.models.moe import MoeMlp
+from ray_tpu.models.training import (
+    default_optimizer,
+    init_sharded_state,
+    make_train_step,
+)
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+def _moe_cfg(**kw):
+    base = dict(
+        vocab_size=256, num_layers=2, num_heads=4, head_dim=16, embed_dim=32,
+        mlp_dim=64, max_seq_len=64, rotary_dim=8, dtype=jnp.float32,
+        moe_num_experts=4, moe_top_k=2, moe_capacity_factor=2.0,
+    )
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def test_moe_forward_shape_and_aux():
+    cfg = _moe_cfg()
+    layer = MoeMlp(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, cfg.embed_dim))
+    vars_ = layer.init(jax.random.PRNGKey(1), x)
+    y, mut = layer.apply(vars_, x, mutable=["losses"])
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    aux = jax.tree.leaves(mut["losses"])[0]
+    # aux is ~1 for uniform routing, bounded by E for total collapse
+    assert 0.5 < float(aux) < cfg.moe_num_experts + 0.1
+
+
+def test_moe_matches_dense_with_replicated_experts():
+    """With identical experts and ample capacity, top-k dispatch (gates
+    renormalized to sum 1) must equal the single dense expert."""
+    cfg = _moe_cfg(moe_capacity_factor=8.0)
+    E, d, f = cfg.moe_num_experts, cfg.embed_dim, cfg.mlp_dim
+    rng = np.random.default_rng(0)
+    wi1 = rng.normal(size=(d, f)).astype(np.float32) * 0.2
+    wo1 = rng.normal(size=(f, d)).astype(np.float32) * 0.2
+    params = {
+        "router": rng.normal(size=(d, E)).astype(np.float32) * 0.1,
+        "wi": np.broadcast_to(wi1, (E, d, f)).copy(),
+        "wo": np.broadcast_to(wo1, (E, f, d)).copy(),
+    }
+    x = rng.normal(size=(2, 8, d)).astype(np.float32)
+    y = MoeMlp(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, params)}, jnp.asarray(x),
+        mutable=["losses"],
+    )[0]
+    expected = np.asarray(jax.nn.gelu(x @ wi1) @ wo1)
+    np.testing.assert_allclose(np.asarray(y), expected, atol=1e-4)
+
+
+def test_moe_capacity_drop_is_graceful():
+    """Tiny capacity: tokens get dropped (output partially zero) but the
+    layer stays finite and differentiable."""
+    cfg = _moe_cfg(moe_capacity_factor=0.25)
+    layer = MoeMlp(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, cfg.embed_dim))
+    vars_ = layer.init(jax.random.PRNGKey(1), x)
+
+    def loss(p):
+        y, _ = layer.apply({"params": p}, x, mutable=["losses"])
+        return (y**2).sum()
+
+    g = jax.grad(loss)(vars_["params"])
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+
+def test_moe_gpt_trains_on_ep_mesh():
+    """End-to-end: expert-parallel GPT train step on a dp×ep×tp mesh."""
+    cfg = _moe_cfg()
+    mesh = MeshSpec(dp=2, ep=2, tp=2).build()
+    opt = default_optimizer(1e-2)
+    state, shardings = init_sharded_state(
+        cfg, mesh, opt, jax.random.PRNGKey(0), (4, 32)
+    )
+    step = make_train_step(cfg, opt, mesh, state_shardings_tree=shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+    with mesh:
+        state, m1 = step(state, tokens)
+        for _ in range(5):
+            state, m2 = step(state, tokens)
+    assert np.isfinite(float(m2["loss"]))
+    assert float(m2["loss"]) < float(m1["loss"])  # memorizes the batch
+    # expert weights are sharded over ep
+    wi = state.params["blocks"]["layers"]["mlp"]["wi"]
+    spec = wi.sharding.spec
+    assert "ep" in tuple(spec), spec
