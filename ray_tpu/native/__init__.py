@@ -1,0 +1,8 @@
+"""ray_tpu.native: C++ runtime components bound via the C ABI + ctypes.
+
+The reference keeps its hot runtime paths in C++ (src/ray/object_manager/
+plasma, src/ray/raylet); this package holds the TPU build's native
+equivalents, compiled on demand with g++ (the image has no pybind11, so
+bindings go through ctypes). Python fallbacks exist for every component —
+`GlobalConfig.object_store_native` gates the allocator swap.
+"""

@@ -1,0 +1,164 @@
+// Native arena allocator for the plasma-style shared-memory object store.
+//
+// The reference's plasma store allocates from a dlmalloc heap over mmap'd
+// shm (reference: src/ray/object_manager/plasma/dlmalloc.cc,
+// plasma_allocator.cc). This is the TPU build's equivalent: a best-fit
+// offset allocator with O(log n) allocate/free and immediate neighbor
+// coalescing, managing the [0, capacity) byte range of the node's mmap'd
+// arena. The Python PlasmaStore (ray_tpu/_private/object_store.py) owns the
+// metadata and calls in through a C ABI (ctypes); the data plane stays
+// zero-copy mmap on both sides.
+//
+// Exposed C ABI:
+//   arena_create(capacity) -> handle
+//   arena_allocate(handle, size) -> offset or -1
+//   arena_free(handle, offset) -> freed size or -1
+//   arena_allocated_bytes(handle), arena_num_blocks(handle)
+//   arena_largest_free(handle)  (fragmentation probe)
+//   arena_destroy(handle)
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+constexpr uint64_t kAlign = 64;  // cache-line alignment, matches _PyArena
+
+inline uint64_t align_up(uint64_t n) {
+  uint64_t a = (n + kAlign - 1) & ~(kAlign - 1);
+  return a < kAlign ? kAlign : a;
+}
+
+class Arena {
+ public:
+  explicit Arena(uint64_t capacity) : capacity_(capacity), allocated_(0) {
+    if (capacity > 0) {
+      free_by_offset_[0] = capacity;
+      free_by_size_.emplace(capacity, 0);
+    }
+  }
+
+  int64_t Allocate(uint64_t size) {
+    size = align_up(size);
+    std::lock_guard<std::mutex> g(mu_);
+    // best fit: smallest free block that holds `size`
+    auto it = free_by_size_.lower_bound(size);
+    if (it == free_by_size_.end()) return -1;
+    uint64_t block_size = it->first;
+    uint64_t offset = it->second;
+    free_by_size_.erase(it);
+    free_by_offset_.erase(offset);
+    if (block_size > size) {
+      uint64_t rem_off = offset + size;
+      uint64_t rem_size = block_size - size;
+      free_by_offset_[rem_off] = rem_size;
+      free_by_size_.emplace(rem_size, rem_off);
+    }
+    allocated_map_[offset] = size;
+    allocated_ += size;
+    return static_cast<int64_t>(offset);
+  }
+
+  int64_t Free(uint64_t offset) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = allocated_map_.find(offset);
+    if (it == allocated_map_.end()) return -1;  // double free / unknown
+    uint64_t size = it->second;
+    allocated_map_.erase(it);
+    allocated_ -= size;
+
+    uint64_t new_off = offset;
+    uint64_t new_size = size;
+    // coalesce with successor
+    auto succ = free_by_offset_.find(offset + size);
+    if (succ != free_by_offset_.end()) {
+      new_size += succ->second;
+      EraseSizeEntry(succ->second, succ->first);
+      free_by_offset_.erase(succ);
+    }
+    // coalesce with predecessor
+    if (!free_by_offset_.empty()) {
+      auto pred = free_by_offset_.upper_bound(offset);
+      if (pred != free_by_offset_.begin()) {
+        --pred;
+        if (pred->first + pred->second == offset) {
+          new_off = pred->first;
+          new_size += pred->second;
+          EraseSizeEntry(pred->second, pred->first);
+          free_by_offset_.erase(pred);
+        }
+      }
+    }
+    free_by_offset_[new_off] = new_size;
+    free_by_size_.emplace(new_size, new_off);
+    return static_cast<int64_t>(size);
+  }
+
+  uint64_t AllocatedBytes() {
+    std::lock_guard<std::mutex> g(mu_);
+    return allocated_;
+  }
+
+  uint64_t NumBlocks() {
+    std::lock_guard<std::mutex> g(mu_);
+    return allocated_map_.size();
+  }
+
+  uint64_t LargestFree() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (free_by_size_.empty()) return 0;
+    return free_by_size_.rbegin()->first;
+  }
+
+ private:
+  void EraseSizeEntry(uint64_t size, uint64_t offset) {
+    auto range = free_by_size_.equal_range(size);
+    for (auto i = range.first; i != range.second; ++i) {
+      if (i->second == offset) {
+        free_by_size_.erase(i);
+        return;
+      }
+    }
+  }
+
+  std::mutex mu_;
+  uint64_t capacity_;
+  uint64_t allocated_;
+  std::map<uint64_t, uint64_t> free_by_offset_;       // offset -> size
+  std::multimap<uint64_t, uint64_t> free_by_size_;    // size -> offset
+  std::map<uint64_t, uint64_t> allocated_map_;        // offset -> size
+};
+
+}  // namespace
+
+extern "C" {
+
+void* arena_create(uint64_t capacity) {
+  return new (std::nothrow) Arena(capacity);
+}
+
+int64_t arena_allocate(void* h, uint64_t size) {
+  return static_cast<Arena*>(h)->Allocate(size);
+}
+
+int64_t arena_free(void* h, uint64_t offset) {
+  return static_cast<Arena*>(h)->Free(offset);
+}
+
+uint64_t arena_allocated_bytes(void* h) {
+  return static_cast<Arena*>(h)->AllocatedBytes();
+}
+
+uint64_t arena_num_blocks(void* h) {
+  return static_cast<Arena*>(h)->NumBlocks();
+}
+
+uint64_t arena_largest_free(void* h) {
+  return static_cast<Arena*>(h)->LargestFree();
+}
+
+void arena_destroy(void* h) { delete static_cast<Arena*>(h); }
+
+}  // extern "C"
