@@ -51,6 +51,8 @@ class Node:
         num_cpus: Optional[float] = None,
         detect_tpu: bool = True,
         node_name: str = "head",
+        gcs_host: str = "127.0.0.1",
+        gcs_port: int = 0,
     ):
         if session_dir is None:
             session_dir = os.path.join(
@@ -61,7 +63,7 @@ class Node:
         self.gcs: Optional[GcsServer] = None
         if head:
             assert gcs_address is None
-            self.gcs = GcsServer()
+            self.gcs = GcsServer(host=gcs_host, port=gcs_port)
             gcs_address = self.gcs.address
         self.gcs_address = gcs_address
 

@@ -441,6 +441,21 @@ class PlasmaStore:
                 "allocated_bytes": sum(e.size for e in self._entries.values()),
             }
 
+    def list_objects(self) -> List[Dict[str, object]]:
+        """Per-object metadata for the state API (`ray list objects`
+        equivalent; reference: node_manager.proto:415 GetObjectsInfo)."""
+        with self._cv:
+            return [
+                {
+                    "object_id": o.hex(),
+                    "size": e.size,
+                    "sealed": e.sealed,
+                    "pin_count": e.pin_count,
+                    "spilled": not e.resident,
+                }
+                for o, e in self._entries.items()
+            ]
+
     # -- local data-plane access (for the raylet process itself) --
 
     def view(self, offset: int, size: int) -> memoryview:

@@ -563,6 +563,9 @@ class Raylet:
     def rpc_store_stats(self, conn, payload=None):
         return self.store.stats()
 
+    def rpc_store_list(self, conn, payload=None):
+        return self.store.list_objects()
+
     # ------------------------------------------------------------------
     # node-to-node object transfer (pull-based, chunked; reference:
     # src/ray/object_manager/pull_manager.cc / push_manager.cc)

@@ -1,0 +1,225 @@
+"""Job submission: run driver scripts on the cluster with tracked status.
+
+Reference: python/ray/job_submission/ SDK + dashboard/modules/job/
+job_manager.py:508 (JobManager, submit_job:823) — each job runs under a
+supervisor actor on the cluster which spawns the entrypoint as a
+subprocess, streams its output into the GCS KV, and records status
+transitions (PENDING → RUNNING → SUCCEEDED/FAILED/STOPPED).
+
+The entrypoint process receives ``RAYTPU_ADDRESS`` so its
+``ray_tpu.init(address=...)`` joins the same cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+_NS = "job_submission"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@ray_tpu.remote
+class JobSupervisor:
+    """One per job; lives on the cluster (reference: job_manager.py's
+    JobSupervisor actor). Runs the entrypoint, pumps logs to GCS KV."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Dict[str, str], gcs_address: str):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.env_vars = env_vars
+        self.gcs_address = gcs_address
+        self.proc: Optional[subprocess.Popen] = None
+        self._stop = threading.Event()
+
+    def _kv_put(self, key: str, value: bytes):
+        import ray_tpu._private.worker as worker_mod
+
+        worker_mod.global_worker.core.gcs.call(
+            "kv_put", (_NS, f"{self.submission_id}:{key}", value, True)
+        )
+
+    def _set_status(self, status: str, message: str = ""):
+        import pickle
+
+        self._kv_put(
+            "status",
+            pickle.dumps({"status": status, "message": message, "ts": time.time()}),
+        )
+
+    def run(self) -> str:
+        """Blocking: returns the terminal status."""
+        env = dict(os.environ)
+        env.update(self.env_vars)
+        env["RAYTPU_ADDRESS"] = self.gcs_address
+        # the job driver must not inherit this worker's claim on the chip
+        env.pop("JAX_PLATFORMS", None)
+        self._set_status(JobStatus.RUNNING)
+        try:
+            self.proc = subprocess.Popen(
+                self.entrypoint,
+                shell=True,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=False,
+            )
+        except OSError as e:
+            self._set_status(JobStatus.FAILED, f"spawn failed: {e}")
+            return JobStatus.FAILED
+        chunks: List[bytes] = []
+        for line in self.proc.stdout:
+            chunks.append(line)
+            if len(chunks) % 20 == 0:
+                self._kv_put("logs", b"".join(chunks))
+        self.proc.wait()
+        self._kv_put("logs", b"".join(chunks))
+        if self._stop.is_set():
+            status = JobStatus.STOPPED
+        elif self.proc.returncode == 0:
+            status = JobStatus.SUCCEEDED
+        else:
+            status = JobStatus.FAILED
+        self._set_status(status, f"exit code {self.proc.returncode}")
+        return status
+
+    def stop(self) -> bool:
+        self._stop.set()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            return True
+        return False
+
+    def ping(self) -> bool:
+        return True
+
+
+class JobSubmissionClient:
+    """SDK entry point (reference: python/ray/job_submission/
+    JobSubmissionClient). ``address`` is the GCS host:port; when None the
+    already-connected driver is used."""
+
+    def __init__(self, address: Optional[str] = None):
+        if address is not None and not ray_tpu.is_initialized():
+            ray_tpu.init(address=address, log_level="WARNING")
+        if not ray_tpu.is_initialized():
+            raise RuntimeError("not connected: pass address='host:port'")
+        import ray_tpu._private.worker as worker_mod
+
+        self._worker = worker_mod.global_worker
+        host, port = self._worker.core.gcs.address
+        self._gcs_address = f"{host}:{port}"
+        self._supervisors: Dict[str, Any] = {}
+        self._runs: Dict[str, Any] = {}
+
+    def _kv_get(self, submission_id: str, key: str) -> Optional[bytes]:
+        return self._worker.core.gcs.call(
+            "kv_get", (_NS, f"{submission_id}:{key}")
+        )
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        import pickle
+
+        submission_id = submission_id or f"raytpu-job-{uuid.uuid4().hex[:10]}"
+        if ":" in submission_id:
+            raise ValueError("submission_id may not contain ':'")
+        env_vars = dict((runtime_env or {}).get("env_vars", {}))
+        sup = JobSupervisor.options(name=f"_job_supervisor:{submission_id}").remote(
+            submission_id, entrypoint, env_vars, self._gcs_address
+        )
+        self._supervisors[submission_id] = sup
+        self._worker.core.gcs.call(
+            "kv_put",
+            (
+                _NS,
+                f"{submission_id}:meta",
+                pickle.dumps(
+                    {
+                        "submission_id": submission_id,
+                        "entrypoint": entrypoint,
+                        "metadata": metadata or {},
+                        "submitted_at": time.time(),
+                    }
+                ),
+                True,
+            ),
+        )
+        self._worker.core.gcs.call(
+            "kv_put",
+            (_NS, f"{submission_id}:status",
+             pickle.dumps({"status": JobStatus.PENDING, "message": "", "ts": time.time()}),
+             True),
+        )
+        self._runs[submission_id] = sup.run.remote()
+        return submission_id
+
+    def get_job_status(self, submission_id: str) -> str:
+        import pickle
+
+        raw = self._kv_get(submission_id, "status")
+        if raw is None:
+            raise ValueError(f"unknown job {submission_id!r}")
+        return pickle.loads(raw)["status"]
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        import pickle
+
+        meta = self._kv_get(submission_id, "meta")
+        status = self._kv_get(submission_id, "status")
+        if meta is None:
+            raise ValueError(f"unknown job {submission_id!r}")
+        info = pickle.loads(meta)
+        info.update(pickle.loads(status) if status else {})
+        return info
+
+    def get_job_logs(self, submission_id: str) -> str:
+        raw = self._kv_get(submission_id, "logs")
+        return (raw or b"").decode(errors="replace")
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        keys = self._worker.core.gcs.call("kv_keys", (_NS, ""))
+        ids = sorted({k.split(":", 1)[0] for k in keys})
+        return [self.get_job_info(i) for i in ids]
+
+    def stop_job(self, submission_id: str) -> bool:
+        sup = self._supervisors.get(submission_id)
+        if sup is None:
+            try:
+                sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+            except Exception:
+                return False
+        return ray_tpu.get(sup.stop.remote(), timeout=30)
+
+    def wait_until_finish(
+        self, submission_id: str, timeout: float = 600.0, poll_s: float = 0.2
+    ) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(poll_s)
+        raise TimeoutError(f"job {submission_id} still running after {timeout}s")

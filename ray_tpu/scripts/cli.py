@@ -1,0 +1,250 @@
+"""`ray_tpu` CLI: start/stop/status/list/timeline/submit.
+
+The `ray start/stop/...` equivalent (reference: python/ray/scripts/
+scripts.py:529 start, util/state/state_cli.py, job submission CLI).
+argparse-based (zero extra deps); invoked as ``python -m ray_tpu ...``.
+
+Cluster bookkeeping lives under ``/tmp/raytpu_cluster`` (override with
+``RAYTPU_RUN_DIR``): one JSON file per node process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+RUN_DIR = os.environ.get("RAYTPU_RUN_DIR", "/tmp/raytpu_cluster")
+
+
+def _node_files() -> List[str]:
+    if not os.path.isdir(RUN_DIR):
+        return []
+    return sorted(
+        os.path.join(RUN_DIR, f)
+        for f in os.listdir(RUN_DIR)
+        if f.startswith("node-") and f.endswith(".json")
+    )
+
+
+def _live_nodes() -> List[Dict]:
+    nodes = []
+    for path in _node_files():
+        try:
+            with open(path) as f:
+                info = json.load(f)
+            os.kill(info["pid"], 0)  # raises if dead
+            nodes.append(info)
+        except (OSError, ValueError):
+            try:
+                os.unlink(path)  # stale record
+            except OSError:
+                pass
+    return nodes
+
+
+def _head_address(explicit: Optional[str] = None) -> str:
+    if explicit:
+        return explicit
+    for info in _live_nodes():
+        if info.get("head"):
+            return info["gcs_address"]
+    sys.exit("no running head node found — pass --address or `ray_tpu start --head`")
+
+
+def cmd_start(args) -> int:
+    os.makedirs(RUN_DIR, exist_ok=True)
+    cmd = [
+        sys.executable, "-m", "ray_tpu.scripts.node_runner",
+        "--run-dir", RUN_DIR,
+        "--node-name", "head" if args.head else "worker",
+    ]
+    if args.head:
+        cmd += ["--head", "--host", args.host, "--port", str(args.port)]
+    else:
+        cmd += ["--address", _head_address(args.address)]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.object_store_memory is not None:
+        cmd += ["--object-store-memory", str(args.object_store_memory)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,  # survive the CLI process
+    )
+    line = proc.stdout.readline().strip()
+    try:
+        info = json.loads(line)
+    except json.JSONDecodeError:
+        rest = proc.stdout.read()
+        sys.exit(f"node failed to start:\n{line}\n{rest}")
+    role = "head" if args.head else "worker"
+    print(f"started {role} node pid={info['pid']} gcs={info['gcs_address']}")
+    if args.head:
+        print(f"connect with: ray_tpu.init(address='{info['gcs_address']}')")
+    if args.block:
+        proc.wait()
+    return 0
+
+
+def cmd_stop(args) -> int:
+    nodes = _live_nodes()
+    # workers first, head last (workers unregister against a live GCS)
+    for info in sorted(nodes, key=lambda i: i.get("head", False)):
+        sig = signal.SIGKILL if args.force else signal.SIGTERM
+        try:
+            os.kill(info["pid"], sig)
+            print(f"stopped pid={info['pid']} ({info.get('node_name')})")
+        except OSError:
+            pass
+    deadline = time.monotonic() + 10
+    while _live_nodes() and time.monotonic() < deadline:
+        time.sleep(0.2)
+    return 0
+
+
+def cmd_status(args) -> int:
+    from ray_tpu.util.state import list_nodes
+
+    address = _head_address(args.address)
+    nodes = list_nodes(address=address)
+    print(f"cluster at {address}: {sum(n['alive'] for n in nodes)} alive node(s)")
+    for n in nodes:
+        state = "ALIVE" if n["alive"] else "DEAD "
+        res = " ".join(
+            f"{k}={n['available'].get(k, 0):g}/{v:g}"
+            for k, v in sorted(n["resources"].items())
+        )
+        print(f"  [{state}] {n['node_id'].hex()[:12]} @ {n['address'][0]}:{n['address'][1]}  {res}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    address = _head_address(args.address)
+    fn = {
+        "nodes": state_api.list_nodes,
+        "actors": state_api.list_actors,
+        "tasks": state_api.list_tasks,
+        "jobs": state_api.list_jobs,
+        "objects": state_api.list_objects,
+        "placement-groups": state_api.list_placement_groups,
+    }[args.what]
+    rows = fn(address=address)
+    print(json.dumps(rows, indent=2, default=_json_default))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util.state import summarize_tasks
+
+    print(
+        json.dumps(
+            summarize_tasks(address=_head_address(args.address)), indent=2
+        )
+    )
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util.state import timeline
+
+    events = timeline(args.output, address=_head_address(args.address))
+    print(f"wrote {len(events)} trace events to {args.output}")
+    return 0
+
+
+def cmd_submit(args) -> int:
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(_head_address(args.address))
+    sid = client.submit_job(
+        entrypoint=" ".join(args.entrypoint),
+        runtime_env={"env_vars": dict(kv.split("=", 1) for kv in args.env)},
+    )
+    print(f"submitted {sid}")
+    if args.no_wait:
+        print("not waiting (--no-wait); the job dies with this cluster connection")
+        return 0
+    status = client.wait_until_finish(sid, timeout=args.timeout)
+    print(f"status: {status}")
+    print(client.get_job_logs(sid), end="")
+    return 0 if status == JobStatus.SUCCEEDED else 1
+
+
+def _json_default(o):
+    if hasattr(o, "hex"):
+        return o.hex() if not isinstance(o, bytes) else o.hex()
+    if isinstance(o, tuple):
+        return list(o)
+    return str(o)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("start", help="start a head or worker node")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", help="head GCS host:port (worker mode)")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=6379)
+    s.add_argument("--num-cpus", type=float)
+    s.add_argument("--object-store-memory", type=int)
+    s.add_argument("--resources", help="extra resources, JSON")
+    s.add_argument("--block", action="store_true")
+    s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("stop", help="stop all locally started nodes")
+    s.add_argument("--force", action="store_true")
+    s.set_defaults(fn=cmd_stop)
+
+    s = sub.add_parser("status", help="cluster resource overview")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("list", help="list cluster state")
+    s.add_argument(
+        "what",
+        choices=["nodes", "actors", "tasks", "jobs", "objects", "placement-groups"],
+    )
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("summary", help="task counts by name and state")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser("timeline", help="dump a chrome-tracing profile")
+    s.add_argument("--output", default="timeline.json")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("submit", help="run an entrypoint as a tracked job")
+    s.add_argument("--address")
+    s.add_argument("--env", action="append", default=[], metavar="K=V")
+    s.add_argument("--no-wait", action="store_true")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    s.set_defaults(fn=cmd_submit)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

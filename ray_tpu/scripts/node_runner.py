@@ -1,0 +1,72 @@
+"""Standalone node process: hosts the GCS (+raylet) or a worker raylet.
+
+Spawned detached by ``ray_tpu start`` (scripts/cli.py); the CLI equivalent
+of the reference's gcs_server/raylet binaries (reference:
+python/ray/scripts/scripts.py:529 start, _private/services.py). Writes its
+address + pid under the cluster run dir so ``ray_tpu stop/status`` can find
+it; exits cleanly on SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None, help="head GCS host:port (worker mode)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--resources", default="{}", help="extra resources, JSON")
+    p.add_argument("--run-dir", required=True)
+    p.add_argument("--node-name", default="cli-node")
+    args = p.parse_args()
+
+    from ray_tpu._private.node import Node
+
+    kwargs = dict(
+        resources=json.loads(args.resources) or None,
+        num_cpus=args.num_cpus,
+        store_capacity=args.object_store_memory,
+        node_name=args.node_name,
+    )
+    if args.head:
+        node = Node(head=True, gcs_host=args.host, gcs_port=args.port, **kwargs)
+    else:
+        host, port = args.address.rsplit(":", 1)
+        node = Node(head=False, gcs_address=(host, int(port)), **kwargs)
+
+    os.makedirs(args.run_dir, exist_ok=True)
+    info = {
+        "pid": os.getpid(),
+        "head": args.head,
+        "gcs_address": f"{node.gcs_address[0]}:{node.gcs_address[1]}",
+        "session_dir": node.session_dir,
+        "node_name": args.node_name,
+    }
+    with open(os.path.join(args.run_dir, f"node-{os.getpid()}.json"), "w") as f:
+        json.dump(info, f)
+    print(json.dumps(info), flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    node.stop()
+    try:
+        os.unlink(os.path.join(args.run_dir, f"node-{os.getpid()}.json"))
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

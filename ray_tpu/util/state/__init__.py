@@ -1,0 +1,184 @@
+"""State API: cluster-wide listings and summaries.
+
+The `ray list tasks/actors/objects/...` equivalent (reference:
+python/ray/util/state/, dashboard/state_aggregator.py:141 StateAPIManager,
+list_tasks:379). The head GCS already holds nodes/actors/jobs/PGs/task
+events; object listings aggregate from every raylet's store
+(node_manager.proto:413-415 GetTasksInfo/GetObjectsInfo analogue).
+
+Every call accepts an explicit ``address="host:port"`` (CLI / external
+tools) or defaults to the connected driver's GCS.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "list_actors",
+    "list_jobs",
+    "list_nodes",
+    "list_objects",
+    "list_placement_groups",
+    "list_tasks",
+    "summarize_tasks",
+    "timeline",
+]
+
+
+def _gcs_call(method: str, payload=None, *, address: Optional[str] = None):
+    if address is not None:
+        from ray_tpu._private.rpc import RpcClient
+
+        host, port = address.rsplit(":", 1)
+        client = RpcClient((host, int(port)))
+        try:
+            return client.call(method, payload, timeout=30.0)
+        finally:
+            client.close()
+    import ray_tpu._private.worker as worker_mod
+
+    w = worker_mod.global_worker
+    if w is None:
+        raise RuntimeError(
+            "not connected — call ray_tpu.init() or pass address='host:port'"
+        )
+    return w.core.gcs.call(method, payload, timeout=30.0)
+
+
+def list_nodes(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _gcs_call("get_nodes", address=address)
+
+
+def list_actors(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _gcs_call("list_actors", address=address)
+
+
+def list_jobs(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _gcs_call("get_jobs", address=address)
+
+
+def list_placement_groups(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    table = _gcs_call("placement_group_table", address=address)
+    return list(table.values()) if isinstance(table, dict) else table
+
+
+def list_tasks(
+    *,
+    address: Optional[str] = None,
+    detail: bool = False,
+) -> List[Dict[str, Any]]:
+    """One row per task. Events arrive from different processes (RUNNING
+    from the executor, FINISHED from the owner) so GCS arrival order is not
+    lifecycle order: the furthest lifecycle stage wins, timestamp breaks
+    ties."""
+    rank = {"PENDING_ARGS_AVAIL": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
+    events = _gcs_call("get_task_events", address=address)
+    latest: Dict[str, Dict[str, Any]] = {}
+    first_ts: Dict[str, float] = {}
+    for ev in events:
+        tid = ev["task_id"]
+        first_ts.setdefault(tid, ev["ts"])
+        cur = latest.get(tid)
+        if cur is None or (
+            rank.get(ev["state"], 1),
+            ev["ts"],
+        ) >= (rank.get(cur["state"], 1), cur["ts"]):
+            latest[tid] = ev
+    rows = []
+    for tid, ev in latest.items():
+        row = {
+            "task_id": tid,
+            "name": ev["name"],
+            "state": ev["state"],
+            "start_ts": first_ts[tid],
+            "worker_id": ev.get("worker_id"),
+        }
+        if detail:
+            row["last_ts"] = ev["ts"]
+        rows.append(row)
+    return rows
+
+
+def list_objects(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Aggregate every raylet's plasma inventory."""
+    from ray_tpu._private.rpc import RpcClient
+
+    rows: List[Dict[str, Any]] = []
+    for node in list_nodes(address=address):
+        if not node.get("alive"):
+            continue
+        client = RpcClient(tuple(node["address"]))
+        try:
+            for obj in client.call("store_list", timeout=10.0):
+                obj["node_id"] = node["node_id"].hex()
+                rows.append(obj)
+        except Exception:
+            pass  # node died mid-listing: skip it
+        finally:
+            client.close()
+    return rows
+
+
+def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Counts by (name, state) — the `ray summary tasks` equivalent."""
+    by_name: Dict[str, Counter] = defaultdict(Counter)
+    for row in list_tasks(address=address):
+        by_name[row["name"]][row["state"]] += 1
+    return {
+        name: dict(states) for name, states in sorted(by_name.items())
+    }
+
+
+def timeline(
+    filename: Optional[str] = None, *, address: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Chrome-tracing dump of task execution (reference:
+    _private/state.py:416 chrome_tracing_dump; view in ui.perfetto.dev).
+
+    RUNNING→FINISHED/FAILED event pairs become complete ("X") slices on the
+    executing worker's row; unpaired events become instants.
+    """
+    events = _gcs_call("get_task_events", address=address)
+    # GCS arrival order mixes processes; wall-clock order (same host /
+    # NTP-synced hosts) reconstructs the lifecycle for pairing
+    events = sorted(events, key=lambda e: e["ts"])
+    running: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in events:
+        tid = ev["task_id"]
+        if ev["state"] == "RUNNING":
+            running[tid] = ev
+        elif ev["state"] in ("FINISHED", "FAILED") and tid in running:
+            start = running.pop(tid)
+            trace.append(
+                {
+                    "name": ev["name"],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": start["ts"] * 1e6,
+                    "dur": max(0.0, (ev["ts"] - start["ts"]) * 1e6),
+                    "pid": "raytpu",
+                    "tid": start.get("worker_id", "?")[:12],
+                    "args": {"task_id": tid, "state": ev["state"]},
+                }
+            )
+        else:
+            trace.append(
+                {
+                    "name": f"{ev['name']}:{ev['state']}",
+                    "cat": "task_state",
+                    "ph": "i",
+                    "ts": ev["ts"] * 1e6,
+                    "pid": "raytpu",
+                    "tid": ev.get("worker_id", "?")[:12],
+                    "s": "t",
+                }
+            )
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

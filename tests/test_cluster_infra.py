@@ -1,0 +1,181 @@
+"""Cluster infrastructure: state API, job submission, CLI.
+
+(reference surfaces: python/ray/util/state/, dashboard/modules/job/
+job_manager.py, python/ray/scripts/scripts.py)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_state_api_lists(ray_start_regular):
+    from ray_tpu.util import state as state_api
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class Counter:
+        def bump(self):
+            return 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(3)], timeout=30) == [1, 2, 3]
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=30) == 1
+
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+
+    actors = state_api.list_actors()
+    assert len(actors) == 1
+
+    jobs = state_api.list_jobs()
+    assert len(jobs) == 1
+
+    # task events flush on a 1 s cadence
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        tasks = state_api.list_tasks()
+        if any(t["name"] == "work" and t["state"] == "FINISHED" for t in tasks):
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"no FINISHED work task in {state_api.list_tasks()}")
+
+    summary = state_api.summarize_tasks()
+    assert summary["work"]["FINISHED"] == 3
+
+    # objects: put one large object so it lands in plasma
+    import numpy as np
+
+    ref = ray_tpu.put(np.zeros(200_000, np.uint8))
+    objs = state_api.list_objects()
+    assert any(o["size"] >= 200_000 for o in objs), objs
+    del ref
+
+
+def test_timeline_dump(ray_start_regular, tmp_path):
+    from ray_tpu.util.state import timeline
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slow.remote() for _ in range(2)], timeout=30)
+    out = str(tmp_path / "trace.json")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        events = timeline(out)
+        slices = [e for e in events if e["ph"] == "X" and e["name"] == "slow"]
+        if len(slices) >= 2:
+            break
+        time.sleep(0.3)
+    assert len(slices) >= 2, events
+    assert all(e["dur"] >= 0.04e6 for e in slices)
+    assert json.load(open(out))  # valid chrome-tracing JSON
+
+
+def test_job_submission(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"",
+    )
+    status = client.wait_until_finish(sid, timeout=120)
+    assert status == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(sid)
+    info = client.get_job_info(sid)
+    assert info["submission_id"] == sid and info["status"] == JobStatus.SUCCEEDED
+    assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+
+def test_job_submission_failure(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; print('boom'); sys.exit(3)\"",
+    )
+    assert client.wait_until_finish(sid, timeout=120) == JobStatus.FAILED
+    assert "boom" in client.get_job_logs(sid)
+
+
+def test_job_driver_joins_cluster(ray_start_regular, tmp_path):
+    """The submitted entrypoint connects back via RAYTPU_ADDRESS and runs a
+    task on the same cluster (the real job-submission contract)."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os, ray_tpu\n"
+        "ray_tpu.init(address=os.environ['RAYTPU_ADDRESS'], log_level='WARNING')\n"
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 3\n"
+        "print('job result', ray_tpu.get(f.remote(14), timeout=60))\n"
+    )
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -u {script}",
+        runtime_env={"env_vars": {"PYTHONPATH": REPO}},
+    )
+    status = client.wait_until_finish(sid, timeout=180)
+    logs = client.get_job_logs(sid)
+    assert status == JobStatus.SUCCEEDED, logs
+    assert "job result 42" in logs
+
+
+def test_cli_start_status_stop(tmp_path):
+    env = dict(os.environ)
+    env["RAYTPU_RUN_DIR"] = str(tmp_path / "run")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def cli(*args, check=True, timeout=120):
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+        if check:
+            assert out.returncode == 0, (args, out.stdout, out.stderr)
+        return out
+
+    out = cli("start", "--head", "--port", "0", "--num-cpus", "2")
+    assert "started head node" in out.stdout
+    address = [l for l in out.stdout.splitlines() if "gcs=" in l][0].split("gcs=")[1]
+    try:
+        out = cli("status", "--address", address)
+        assert "1 alive node(s)" in out.stdout
+        out = cli("list", "nodes", "--address", address)
+        assert json.loads(out.stdout)[0]["alive"] is True
+        # implicit head discovery from the run dir (no --address)
+        out = cli("status")
+        assert "alive node(s)" in out.stdout
+    finally:
+        cli("stop")
+    assert _eventually_no_nodes(env)
+
+
+def _eventually_no_nodes(env, timeout=15):
+    run_dir = env["RAYTPU_RUN_DIR"]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        files = [
+            f for f in (os.listdir(run_dir) if os.path.isdir(run_dir) else [])
+            if f.startswith("node-")
+        ]
+        if not files:
+            return True
+        time.sleep(0.3)
+    return False
