@@ -52,6 +52,9 @@ class _Config:
         "lineage_max_resubmits": 3,  # per-object lineage re-executions
         "actor_max_inflight": 256,  # pipelined calls per (caller, actor)
         "gcs_rpc_timeout_s": 30.0,
+        # sqlite file for GCS table persistence ("" = in-memory only);
+        # a restarted GCS replays KV/jobs/actors/PGs from it
+        "gcs_persistence_path": "",
         # --- rpc ---
         "rpc_connect_timeout_s": 10.0,
         "rpc_max_frame_bytes": 512 * 1024**2,

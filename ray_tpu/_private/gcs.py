@@ -104,8 +104,24 @@ class GcsServer:
     # which is safe because they only touch _lock briefly
     RPC_INLINE = ("heartbeat",)
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persistence_path: Optional[str] = None,
+    ):
         from concurrent.futures import ThreadPoolExecutor
+
+        # optional sqlite persistence (the Redis-equivalent;
+        # gcs_storage.py): a restarted GCS replays KV/jobs/actors/PGs and
+        # raylets re-register via their heartbeat reconnect
+        self._storage = None
+        if persistence_path or GlobalConfig.gcs_persistence_path:
+            from ray_tpu._private.gcs_storage import GcsStorage
+
+            self._storage = GcsStorage(
+                persistence_path or GlobalConfig.gcs_persistence_path
+            )
 
         self.server = RpcServer("gcs", host, port)
         self._lock = threading.Condition(threading.RLock())
@@ -128,6 +144,8 @@ class GcsServer:
         self._raylet_clients: Dict[NodeID, RpcClient] = {}
         self._task_events: List[Dict[str, Any]] = []
         self._stopped = threading.Event()
+        if self._storage is not None:
+            self._reload_from_storage()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
         self._health_thread = threading.Thread(
@@ -138,6 +156,102 @@ class GcsServer:
     @property
     def address(self) -> Tuple[str, int]:
         return self.server.address
+
+    # ------------------------------------------------------------------
+    # persistence (reference: gcs_table_storage.cc over store_client/)
+    # ------------------------------------------------------------------
+
+    def _persist_actor_locked(self, info: ActorInfo):
+        if self._storage is None:
+            return
+        self._storage.put(
+            "actors",
+            info.actor_id.hex(),
+            {
+                "spec": info.spec,
+                "state": info.state,
+                "address": info.address,
+                "node_id": info.node_id,
+                "worker_id": info.worker_id,
+                "num_restarts": info.num_restarts,
+                "death_cause": info.death_cause,
+            },
+        )
+
+    def _persist_pg_locked(self, info: PlacementGroupInfo):
+        if self._storage is None:
+            return
+        self._storage.put(
+            "pgs",
+            info.pg_id.hex(),
+            {
+                "spec": info.spec,
+                "state": info.state,
+                "bundle_nodes": list(info.bundle_nodes),
+                "failure": info.failure,
+            },
+        )
+
+    def _reload_from_storage(self):
+        resched_actors: List[ActorInfo] = []
+        resched_pgs: List[PlacementGroupInfo] = []
+        for k, v in self._storage.items("kv"):
+            ns, key = k.split("\x00", 1)
+            self._kv.setdefault(ns, {})[key] = v
+        for k, v in self._storage.items("jobs"):
+            self._jobs[k] = v
+        for k, v in self._storage.items("actors"):
+            info = ActorInfo(ActorID.from_hex(k), v["spec"])
+            info.state = v["state"]
+            info.address = v["address"]
+            info.node_id = v["node_id"]
+            info.worker_id = v["worker_id"]
+            info.num_restarts = v["num_restarts"]
+            info.death_cause = v["death_cause"]
+            self._actors[info.actor_id] = info
+            if info.name and info.state != DEAD:
+                self._named_actors[info.name] = info.actor_id
+            if info.state in (PENDING_CREATION, RESTARTING):
+                # creation/restart was in flight when the GCS died: the
+                # lease never completed, so schedule from scratch
+                info.state = PENDING_CREATION
+                resched_actors.append(info)
+        for k, v in self._storage.items("pgs"):
+            info = PlacementGroupInfo(PlacementGroupID.from_hex(k), v["spec"])
+            info.state = v["state"]
+            info.bundle_nodes = list(v["bundle_nodes"])
+            info.failure = v["failure"]
+            self._pgs[info.pg_id] = info
+            if info.state in (PG_PENDING, PG_RESCHEDULING):
+                info.state = PG_PENDING
+                info.bundle_nodes = [None] * len(info.bundle_nodes)
+                resched_pgs.append(info)
+        if resched_actors or resched_pgs:
+            logger.info(
+                "GCS restart: rescheduling %d actors, %d placement groups",
+                len(resched_actors),
+                len(resched_pgs),
+            )
+        # defer actual scheduling until raylets have re-registered
+        def _resched():
+            deadline = time.monotonic() + GlobalConfig.health_check_period_s * 4
+            while time.monotonic() < deadline and not self._stopped.is_set():
+                with self._lock:
+                    if any(n.alive for n in self._nodes.values()):
+                        break
+                time.sleep(0.2)
+            if self._stopped.is_set():
+                return
+            try:
+                for info in resched_pgs:
+                    self._pg_sched_pool.submit(self._schedule_pg, info)
+                for info in resched_actors:
+                    self._actor_sched_pool.submit(self._schedule_actor, info)
+            except RuntimeError:
+                pass  # pools shut down under us: the GCS is stopping again
+
+        if resched_actors or resched_pgs:
+            threading.Thread(target=_resched, daemon=True).start()
 
     # ------------------------------------------------------------------
     # pubsub
@@ -154,6 +268,10 @@ class GcsServer:
             # every published transition also wakes long-poll waiters
             # (wait_for_actor / wait_placement_group)
             self._lock.notify_all()
+            if channel == "actors" and self._storage is not None:
+                info = self._actors.get(message["actor_id"])
+                if info is not None:
+                    self._persist_actor_locked(info)
         for conn in subs:
             conn.notify(channel, message)
 
@@ -179,6 +297,8 @@ class GcsServer:
             if not overwrite and key in space:
                 return False
             space[key] = value
+            if self._storage is not None:
+                self._storage.put("kv", f"{ns}\x00{key}", value)
         return True
 
     def rpc_kv_get(self, conn, payload):
@@ -195,7 +315,10 @@ class GcsServer:
     def rpc_kv_del(self, conn, payload):
         ns, key = payload
         with self._lock:
-            return self._kv.get(ns, {}).pop(key, None) is not None
+            removed = self._kv.get(ns, {}).pop(key, None) is not None
+            if removed and self._storage is not None:
+                self._storage.delete("kv", f"{ns}\x00{key}")
+            return removed
 
     def rpc_kv_keys(self, conn, payload):
         ns, prefix = payload
@@ -294,6 +417,7 @@ class GcsServer:
                     raise ValueError(f"actor name {info.name!r} already taken")
                 self._named_actors[info.name] = actor_id
             self._actors[actor_id] = info
+            self._persist_actor_locked(info)
         self._actor_sched_pool.submit(self._schedule_actor, info)
         return True
 
@@ -526,6 +650,7 @@ class GcsServer:
             survivors: Dict[Any, List[Tuple[int, NodeID]]] = {}
             for p in broken:
                 p.state = PG_RESCHEDULING
+                self._persist_pg_locked(p)
                 survivors[p.pg_id] = [
                     (i, nid)
                     for i, nid in enumerate(p.bundle_nodes)
@@ -551,6 +676,7 @@ class GcsServer:
         info = PlacementGroupInfo(pg_id, spec)
         with self._lock:
             self._pgs[pg_id] = info
+            self._persist_pg_locked(info)
         self._pg_sched_pool.submit(self._schedule_pg, info)
         return True
 
@@ -575,6 +701,7 @@ class GcsServer:
             if info is None or info.state == PG_REMOVED:
                 return False
             info.state = PG_REMOVED
+            self._persist_pg_locked(info)
             self._lock.notify_all()
             assignment = [
                 (i, node_id)
@@ -762,6 +889,7 @@ class GcsServer:
                     info.bundle_nodes = list(plan)
                     info.state = PG_CREATED
                     outcome = "created"
+                    self._persist_pg_locked(info)
                 self._lock.notify_all()
             if outcome == "removed":
                 self._release_bundles(info.pg_id, committed)
@@ -775,6 +903,7 @@ class GcsServer:
         with self._lock:
             info.state = PG_REMOVED
             info.failure = "scheduling failed: no feasible placement in time"
+            self._persist_pg_locked(info)
             self._lock.notify_all()
         self._publish(f"pg:{info.pg_id.hex()}", info.public_view())
 
@@ -796,6 +925,8 @@ class GcsServer:
     def rpc_add_job(self, conn, payload):
         with self._lock:
             self._jobs[payload["job_id"].hex()] = payload
+            if self._storage is not None:
+                self._storage.put("jobs", payload["job_id"].hex(), payload)
         return True
 
     def rpc_get_jobs(self, conn, payload=None):
@@ -825,3 +956,5 @@ class GcsServer:
         with self._lock:
             for c in self._raylet_clients.values():
                 c.close()
+        if self._storage is not None:
+            self._storage.close()

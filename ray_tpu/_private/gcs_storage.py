@@ -1,0 +1,61 @@
+"""GCS table persistence (the Redis-equivalent store client).
+
+The reference persists GCS tables to Redis so a restarted GCS replays
+cluster metadata (reference: src/ray/gcs/gcs_server/gcs_table_storage.cc,
+store_client/redis_store_client.cc). Here the backend is sqlite in WAL
+mode — crash-safe, zero extra deps, single file next to the session dir.
+
+Only durable metadata is persisted: internal KV, jobs, the actor table and
+placement groups. Node liveness is deliberately NOT persisted — raylets
+re-register themselves when their heartbeat detects the restart (the
+NotifyGCSRestart flow, node_manager.proto:358), which also rebuilds the
+live resource view without trusting stale snapshots.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sqlite3
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+_TABLES = ("kv", "jobs", "actors", "pgs")
+
+
+class GcsStorage:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        for t in _TABLES:
+            self._db.execute(
+                f"CREATE TABLE IF NOT EXISTS {t} (k TEXT PRIMARY KEY, v BLOB)"
+            )
+        self._db.commit()
+
+    def put(self, table: str, key: str, value: Any):
+        blob = pickle.dumps(value, protocol=5)
+        with self._lock:
+            self._db.execute(
+                f"INSERT OR REPLACE INTO {table} (k, v) VALUES (?, ?)", (key, blob)
+            )
+            self._db.commit()
+
+    def delete(self, table: str, key: str):
+        with self._lock:
+            self._db.execute(f"DELETE FROM {table} WHERE k = ?", (key,))
+            self._db.commit()
+
+    def items(self, table: str) -> List[Tuple[str, Any]]:
+        with self._lock:
+            rows = self._db.execute(f"SELECT k, v FROM {table}").fetchall()
+        return [(k, pickle.loads(v)) for k, v in rows]
+
+    def close(self):
+        with self._lock:
+            try:
+                self._db.close()
+            except sqlite3.Error:
+                pass

@@ -508,9 +508,43 @@ class Raylet:
             with self._res_cv:
                 available = dict(self.available)
                 total = dict(self.total_resources)
-            self.gcs.call("heartbeat", (self.node_id, available, total), timeout=5.0)
+            ok = self.gcs.call(
+                "heartbeat", (self.node_id, available, total), timeout=5.0
+            )
+            if ok is False and not self._stopped.is_set():
+                # the GCS doesn't know us: it restarted (persistence reload
+                # drops node liveness on purpose) — re-register, replaying
+                # our live resource view (reference: NotifyGCSRestart,
+                # node_manager.proto:358)
+                self._register_with_gcs()
         except Exception:
-            pass
+            if self._stopped.is_set():
+                return
+            # connection to the GCS lost: reconnect and re-register
+            try:
+                new_client = RpcClient(self.gcs_address)
+                old, self.gcs = self.gcs, new_client
+                try:
+                    old.close()
+                except Exception:
+                    pass
+                self._register_with_gcs()
+                logger.info(
+                    "node %s reconnected to restarted GCS", self.node_id.hex()[:8]
+                )
+            except Exception:
+                pass  # GCS still down; next heartbeat retries
+
+    def _register_with_gcs(self):
+        with self._res_cv:
+            available = dict(self.available)
+            total = dict(self.total_resources)
+        self.gcs.call(
+            "register_node",
+            (self.node_id, self.server.address, total, self.labels),
+            timeout=5.0,
+        )
+        self.gcs.call("heartbeat", (self.node_id, available, total), timeout=5.0)
 
     def rpc_get_node_info(self, conn, payload=None):
         with self._res_cv:
