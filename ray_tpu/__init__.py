@@ -31,6 +31,7 @@ from ray_tpu.api import (
     get,
     get_actor,
     kill,
+    nodes,
     put,
     remote,
     wait,
@@ -46,6 +47,7 @@ __all__ = [
     "wait",
     "kill",
     "get_actor",
+    "nodes",
     "ObjectRef",
     "ActorHandle",
     "ActorClass",

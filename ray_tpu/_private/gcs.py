@@ -96,6 +96,7 @@ class NodeInfo:
         self.last_heartbeat = time.monotonic()
         self.store_path: str = labels.get("store_path", "")
         self.store_capacity: int = int(labels.get("store_capacity", "0"))
+        self.pending_demand: List[Dict[str, float]] = []
 
 
 class GcsServer:
@@ -342,6 +343,7 @@ class GcsServer:
     def rpc_heartbeat(self, conn, payload):
         node_id, available = payload[0], payload[1]
         total = payload[2] if len(payload) > 2 else None
+        demand = payload[3] if len(payload) > 3 else None
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info.alive:
@@ -353,6 +355,9 @@ class GcsServer:
             if total is not None:
                 # totals change when placement-group bundles commit/release
                 info.total_resources = total
+            if demand is not None:
+                # parked lease requests: the autoscaler's scale-up signal
+                info.pending_demand = demand
         return True
 
     def rpc_unregister_node(self, conn, payload):
@@ -381,6 +386,7 @@ class GcsServer:
             "alive": n.alive,
             "store_path": n.store_path,
             "store_capacity": n.store_capacity,
+            "demand": list(n.pending_demand),
         }
 
     def _health_loop(self):

@@ -68,12 +68,18 @@ class Raylet:
         self.labels = dict(labels or {})
         self.labels["store_path"] = self.store.path
         self.labels["store_capacity"] = str(self.store.capacity)
+        self.labels.setdefault("node_name", node_name)
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._res_cv = threading.Condition()
         self._peers: Dict[Tuple[str, int], RpcClient] = {}
         self._peers_lock = threading.Lock()
         self._prepared_bundles: Dict[Tuple[Any, int], Dict[str, float]] = {}
         self._committed_bundles: Dict[Tuple[Any, int], Dict[str, float]] = {}
+        # unfulfilled lease requests currently parked in
+        # rpc_request_worker_lease, keyed by request identity; reported in
+        # heartbeats as the autoscaler's demand signal (the reference's
+        # resource_load via ray_syncer)
+        self._demand: Dict[int, Dict[str, float]] = {}
         self._stopped = threading.Event()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
@@ -238,6 +244,22 @@ class Raylet:
                 for k, v in resources.items()
             )
             spill_checked = False
+            demand_key = id(payload)
+            self._demand[demand_key] = dict(resources)
+            try:
+                return self._lease_loop_locked(
+                    resources, actor_id, deadline, allow_spill, need_tpu,
+                    spill_checked,
+                )
+            finally:
+                self._demand.pop(demand_key, None)
+
+    def _lease_loop_locked(
+        self, resources, actor_id, deadline, allow_spill, need_tpu, spill_checked
+    ):
+        """The parked-request wait loop; runs with _res_cv held (the caller
+        registered this request in self._demand for heartbeat reporting)."""
+        if True:
             while not self._stopped.is_set():
                 effective = self._expand_pg_request_locked(resources)
                 have_resources = effective is not None and all(
@@ -508,8 +530,9 @@ class Raylet:
             with self._res_cv:
                 available = dict(self.available)
                 total = dict(self.total_resources)
+                demand = [dict(d) for d in self._demand.values()]
             ok = self.gcs.call(
-                "heartbeat", (self.node_id, available, total), timeout=5.0
+                "heartbeat", (self.node_id, available, total, demand), timeout=5.0
             )
             if ok is False and not self._stopped.is_set():
                 # the GCS doesn't know us: it restarted (persistence reload
@@ -539,12 +562,15 @@ class Raylet:
         with self._res_cv:
             available = dict(self.available)
             total = dict(self.total_resources)
+            demand = [dict(d) for d in self._demand.values()]
         self.gcs.call(
             "register_node",
             (self.node_id, self.server.address, total, self.labels),
             timeout=5.0,
         )
-        self.gcs.call("heartbeat", (self.node_id, available, total), timeout=5.0)
+        self.gcs.call(
+            "heartbeat", (self.node_id, available, total, demand), timeout=5.0
+        )
 
     def rpc_get_node_info(self, conn, payload=None):
         with self._res_cv:

@@ -263,6 +263,11 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
     worker_mod.get_global_worker().core.kill_actor(actor._actor_id, no_restart)
 
 
+def nodes():
+    """Cluster node views from the GCS (the `ray.nodes()` equivalent)."""
+    return worker_mod.get_global_worker().core.gcs.call("get_nodes")
+
+
 def get_actor(name: str) -> ActorHandle:
     core = worker_mod.get_global_worker().core
     view = core.gcs.call("get_actor_by_name", name)

@@ -1,0 +1,20 @@
+"""Autoscaler: demand-driven node provisioning with TPU-slice awareness.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py (StandardAutoscaler),
+resource_demand_scheduler.py (bin-packing), _private/gcp/node.py (TPU pods).
+"""
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (
+    LocalSubprocessNodeProvider,
+    NodeProvider,
+    TPUSliceNodeProvider,
+)
+
+__all__ = [
+    "AutoscalerConfig",
+    "LocalSubprocessNodeProvider",
+    "NodeProvider",
+    "StandardAutoscaler",
+    "TPUSliceNodeProvider",
+]

@@ -1,0 +1,180 @@
+"""StandardAutoscaler: reconcile demand against capacity.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py (StandardAutoscaler
+.update), monitor.py (the head-node loop), resource_demand_scheduler.py
+(demand bin-packing). The demand signal is the set of parked lease
+requests every raylet reports in its heartbeat (gcs.py NodeInfo
+.pending_demand); scale-down watches idle nodes the way the reference
+watches last-used timestamps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_workers: int = 0
+    max_workers: int = 8
+    idle_timeout_s: float = 30.0
+    update_interval_s: float = 1.0
+    # launch at most this many units per round (reference: upscaling_speed)
+    max_launch_batch: int = 4
+
+
+class StandardAutoscaler:
+    def __init__(
+        self,
+        gcs_address: str,
+        provider: NodeProvider,
+        config: Optional[AutoscalerConfig] = None,
+    ):
+        host, port = gcs_address.rsplit(":", 1)
+        self._gcs = RpcClient((host, int(port)))
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self._idle_since: Dict[str, float] = {}  # provider node id -> ts
+        self._launched_at: Dict[str, float] = {}
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- monitor loop ------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, terminate_nodes: bool = True):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if terminate_nodes:
+            self.provider.shutdown()
+        self._gcs.close()
+
+    def _loop(self):
+        while not self._stopped.wait(self.config.update_interval_s):
+            try:
+                self.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+
+    # -- one reconcile round ----------------------------------------------
+
+    def update(self) -> Dict[str, Any]:
+        nodes = self._gcs.call("get_nodes", timeout=10.0)
+        alive = [n for n in nodes if n["alive"]]
+        demand: List[Dict[str, float]] = []
+        for n in alive:
+            demand.extend(n.get("demand") or [])
+
+        managed = self.provider.non_terminated_nodes()
+        report = {"demand": len(demand), "managed": len(managed), "launched": 0,
+                  "terminated": 0}
+
+        # ---- scale up: bin-pack unmet demand into hypothetical free
+        # capacity, then into new provider units
+        free = [dict(n["available"]) for n in alive]
+        unmet: List[Dict[str, float]] = []
+        for shape in demand:
+            if not self._fit(shape, free):
+                unmet.append(shape)
+        if unmet:
+            unit = self.provider.node_resources()
+            units_needed = self._units_for(unmet, unit)
+            headroom = self.config.max_workers - len(managed)
+            to_launch = max(0, min(units_needed, headroom,
+                                   self.config.max_launch_batch))
+            if to_launch:
+                created = self.provider.create_nodes(to_launch)
+                now = time.monotonic()
+                for nid in created:
+                    self._launched_at[nid] = now
+                report["launched"] = len(created)
+                logger.info(
+                    "autoscaler: %d unmet demand shapes -> launching %d "
+                    "unit(s) %s", len(unmet), to_launch, created,
+                )
+
+        # ---- scale down: terminate units idle past the timeout
+        # (a unit is idle when every resource is fully available and it
+        # reports no demand). Provider units are matched to GCS nodes by
+        # name prefix (node_runner --node-name <provider id>).
+        now = time.monotonic()
+        by_prefix: Dict[str, List[Dict[str, Any]]] = {}
+        for n in alive:
+            name = (n.get("labels") or {}).get("node_name", "")
+            for nid in managed:
+                if name.startswith(nid):
+                    by_prefix.setdefault(nid, []).append(n)
+        terminatable = []
+        for nid in managed:
+            if now - self._launched_at.get(nid, 0) < self.config.idle_timeout_s:
+                continue  # grace period while the node boots
+            members = by_prefix.get(nid, [])
+            idle = members and all(
+                not m.get("demand")
+                and all(
+                    m["available"].get(k, 0) >= v
+                    for k, v in m["resources"].items()
+                    if k not in ("node",)
+                )
+                for m in members
+            )
+            if idle:
+                since = self._idle_since.setdefault(nid, now)
+                if now - since >= self.config.idle_timeout_s:
+                    terminatable.append(nid)
+            else:
+                self._idle_since.pop(nid, None)
+        floor = self.config.min_workers
+        for nid in terminatable:
+            if len(self.provider.non_terminated_nodes()) <= floor:
+                break
+            logger.info("autoscaler: terminating idle unit %s", nid)
+            self.provider.terminate_node(nid)
+            self._idle_since.pop(nid, None)
+            report["terminated"] += 1
+        return report
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _fit(shape: Dict[str, float], free: List[Dict[str, float]]) -> bool:
+        for avail in free:
+            if all(avail.get(k, 0) >= v for k, v in shape.items() if v > 0):
+                for k, v in shape.items():
+                    avail[k] = avail.get(k, 0) - v
+                return True
+        return False
+
+    def _units_for(
+        self, shapes: List[Dict[str, float]], unit: Dict[str, float]
+    ) -> int:
+        """First-fit-decreasing pack of the unmet shapes into fresh units."""
+        bins: List[Dict[str, float]] = []
+        shapes = sorted(
+            shapes, key=lambda s: -max(s.values(), default=0.0)
+        )
+        for shape in shapes:
+            if not all(unit.get(k, 0) >= v for k, v in shape.items() if v > 0):
+                continue  # can never fit in this unit type: skip (infeasible)
+            if not self._fit(shape, bins):
+                bins.append(
+                    {k: unit.get(k, 0) - shape.get(k, 0) for k in
+                     set(unit) | set(shape)}
+                )
+        return len(bins)

@@ -1,0 +1,202 @@
+"""Node providers: how the autoscaler creates and destroys capacity.
+
+Reference: python/ray/autoscaler/node_provider.py (the NodeProvider
+interface) + _private/gcp/node.py (TPU-VM pods, where an atomic unit is a
+whole pod slice, not a VM). Two concrete providers ship:
+
+- `LocalSubprocessNodeProvider`: spawns `scripts/node_runner.py`
+  subprocesses joining the head GCS — the fake-multinode provider used by
+  tests and by single-host elasticity.
+- `TPUSliceNodeProvider`: the slice-granular provider. The atomic unit is
+  a SLICE (all hosts of a TPU pod slice created/deleted together — you
+  cannot scale half a slice); host processes are started by pluggable
+  create/delete hooks so the same logic drives subprocess fakes in tests
+  and gcloud/GKE commands in production.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provider contract (reference: autoscaler/node_provider.py)."""
+
+    def create_nodes(self, count: int) -> List[str]:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_resources(self) -> Dict[str, float]:
+        """Resources ONE created unit adds to the cluster."""
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for nid in list(self.non_terminated_nodes()):
+            self.terminate_node(nid)
+
+
+class LocalSubprocessNodeProvider(NodeProvider):
+    def __init__(
+        self,
+        gcs_address: str,
+        *,
+        num_cpus: float = 2.0,
+        resources: Optional[Dict[str, float]] = None,
+        run_dir: Optional[str] = None,
+    ):
+        self.gcs_address = gcs_address
+        self.num_cpus = num_cpus
+        self.extra_resources = dict(resources or {})
+        self.run_dir = run_dir or f"/tmp/raytpu_autoscaler_{os.getpid()}"
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def node_resources(self) -> Dict[str, float]:
+        return {"CPU": self.num_cpus, **self.extra_resources}
+
+    def create_nodes(self, count: int) -> List[str]:
+        created = []
+        for _ in range(count):
+            nid = f"local-{uuid.uuid4().hex[:8]}"
+            cmd = [
+                sys.executable, "-m", "ray_tpu.scripts.node_runner",
+                "--address", self.gcs_address,
+                "--run-dir", os.path.join(self.run_dir, nid),
+                "--node-name", nid,
+                "--num-cpus", str(self.num_cpus),
+            ]
+            if self.extra_resources:
+                cmd += ["--resources", json.dumps(self.extra_resources)]
+            proc = subprocess.Popen(
+                cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            with self._lock:
+                self._procs[nid] = proc
+            created.append(nid)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(provider_node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return [nid for nid, p in self._procs.items() if p.poll() is None]
+
+
+class TPUSliceNodeProvider(NodeProvider):
+    """Slice-granular TPU provider: one create = one whole pod slice.
+
+    ``create_slice(slice_id) -> None`` / ``delete_slice(slice_id)`` hooks
+    perform the actual provisioning. In production they wrap
+    ``gcloud compute tpus tpu-vm create --type=v5e-...`` (the reference's
+    GCPNodeProvider TPU path, autoscaler/_private/gcp/node.py) and start
+    one ``node_runner`` per host with RAYTPU_TPU_SLICE_ID set; the default
+    test hook spawns ``hosts_per_slice`` local subprocesses labeled with
+    the slice id so gang scheduling sees a real (simulated) slice.
+    """
+
+    def __init__(
+        self,
+        gcs_address: str,
+        *,
+        hosts_per_slice: int = 2,
+        chips_per_host: int = 4,
+        num_cpus_per_host: float = 2.0,
+        create_slice: Optional[Callable[[str], None]] = None,
+        delete_slice: Optional[Callable[[str], None]] = None,
+    ):
+        self.gcs_address = gcs_address
+        self.hosts_per_slice = hosts_per_slice
+        self.chips_per_host = chips_per_host
+        self.num_cpus_per_host = num_cpus_per_host
+        self._create_hook = create_slice
+        self._delete_hook = delete_slice
+        self._slices: Dict[str, List[subprocess.Popen]] = {}
+        self._lock = threading.Lock()
+
+    def node_resources(self) -> Dict[str, float]:
+        # one atomic unit == one slice
+        return {
+            "CPU": self.num_cpus_per_host * self.hosts_per_slice,
+            "TPU": float(self.chips_per_host * self.hosts_per_slice),
+        }
+
+    def create_nodes(self, count: int) -> List[str]:
+        created = []
+        for _ in range(count):
+            slice_id = f"slice-{uuid.uuid4().hex[:8]}"
+            if self._create_hook is not None:
+                self._create_hook(slice_id)
+                with self._lock:
+                    self._slices[slice_id] = []
+            else:
+                procs = []
+                for host in range(self.hosts_per_slice):
+                    env = dict(os.environ)
+                    env["RAYTPU_TPU_SLICE_ID"] = slice_id
+                    env["RAYTPU_TPU_TOPOLOGY"] = f"v5e-{self.chips_per_host}"
+                    procs.append(
+                        subprocess.Popen(
+                            [
+                                sys.executable, "-m",
+                                "ray_tpu.scripts.node_runner",
+                                "--address", self.gcs_address,
+                                "--run-dir", f"/tmp/raytpu_{slice_id}",
+                                "--node-name", f"{slice_id}-host{host}",
+                                "--num-cpus", str(self.num_cpus_per_host),
+                                "--resources",
+                                json.dumps({"TPU": float(self.chips_per_host)}),
+                            ],
+                            env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT,
+                            start_new_session=True,
+                        )
+                    )
+                with self._lock:
+                    self._slices[slice_id] = procs
+            created.append(slice_id)
+        return created
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        """Deletes the WHOLE slice — the atomic failure/scaling domain."""
+        with self._lock:
+            procs = self._slices.pop(provider_node_id, None)
+        if procs is None:
+            return
+        if self._delete_hook is not None:
+            self._delete_hook(provider_node_id)
+            return
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._slices.keys())

@@ -1,0 +1,238 @@
+"""Autoscaler: demand-driven scale-up, idle scale-down, TPU slice units.
+
+(reference: python/ray/tests/test_autoscaler.py with a mock NodeProvider +
+test_autoscaler_fake_multinode.py with real subprocess nodes)
+"""
+
+import threading
+import time
+from typing import Dict, List
+
+import pytest
+
+from ray_tpu.autoscaler import (
+    AutoscalerConfig,
+    NodeProvider,
+    StandardAutoscaler,
+    TPUSliceNodeProvider,
+)
+
+
+class MockProvider(NodeProvider):
+    """In-memory provider that also fakes the GCS node views it would add
+    (unit tests for the reconcile logic, no processes involved)."""
+
+    def __init__(self, unit=None):
+        self.unit = unit or {"CPU": 4.0}
+        self.nodes: List[str] = []
+        self.counter = 0
+
+    def node_resources(self):
+        return dict(self.unit)
+
+    def create_nodes(self, count):
+        out = []
+        for _ in range(count):
+            self.counter += 1
+            nid = f"mock-{self.counter}"
+            self.nodes.append(nid)
+            out.append(nid)
+        return out
+
+    def terminate_node(self, nid):
+        self.nodes.remove(nid)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+class FakeGcs:
+    """Stands in for the GCS get_nodes call."""
+
+    def __init__(self):
+        self.views: List[Dict] = []
+
+    def call(self, method, payload=None, timeout=None):
+        assert method == "get_nodes"
+        return self.views
+
+    def close(self):
+        pass
+
+
+def _autoscaler(provider, views, **cfg):
+    a = StandardAutoscaler.__new__(StandardAutoscaler)
+    a.provider = provider
+    a.config = AutoscalerConfig(**cfg)
+    a._gcs = FakeGcs()
+    a._gcs.views = views
+    a._idle_since = {}
+    a._launched_at = {}
+    a._stopped = threading.Event()
+    a._thread = None
+    return a
+
+
+def _view(name, total, avail, demand=()):
+    return {
+        "node_id": name.encode(),
+        "address": ("127.0.0.1", 0),
+        "resources": dict(total),
+        "available": dict(avail),
+        "labels": {"node_name": name},
+        "alive": True,
+        "demand": list(demand),
+    }
+
+
+def test_scale_up_on_unmet_demand():
+    provider = MockProvider({"CPU": 4.0})
+    views = [
+        _view("head", {"CPU": 2.0}, {"CPU": 0.0},
+              demand=[{"CPU": 2.0}, {"CPU": 2.0}, {"CPU": 2.0}]),
+    ]
+    a = _autoscaler(provider, views, max_workers=8)
+    report = a.update()
+    # 3 x 2-CPU shapes → 6 CPU → 2 units of 4 CPU
+    assert report["launched"] == 2
+    assert len(provider.nodes) == 2
+
+
+def test_scale_up_respects_max_workers():
+    provider = MockProvider({"CPU": 1.0})
+    views = [_view("head", {"CPU": 1.0}, {"CPU": 0.0},
+                   demand=[{"CPU": 1.0}] * 10)]
+    a = _autoscaler(provider, views, max_workers=3, max_launch_batch=10)
+    a.update()
+    assert len(provider.nodes) == 3
+
+
+def test_no_scale_up_when_demand_fits_free_capacity():
+    provider = MockProvider()
+    views = [
+        _view("head", {"CPU": 4.0}, {"CPU": 4.0}, demand=[{"CPU": 1.0}]),
+    ]
+    a = _autoscaler(provider, views)
+    assert a.update()["launched"] == 0
+
+
+def test_infeasible_shape_never_launches():
+    provider = MockProvider({"CPU": 2.0})
+    views = [_view("head", {"CPU": 1.0}, {"CPU": 0.0},
+                   demand=[{"TPU": 8.0}])]  # provider unit has no TPU
+    a = _autoscaler(provider, views)
+    assert a.update()["launched"] == 0
+
+
+def test_scale_down_idle_nodes():
+    provider = MockProvider({"CPU": 4.0})
+    provider.create_nodes(2)
+    views = [
+        _view("head", {"CPU": 2.0}, {"CPU": 2.0}),
+        _view("mock-1-x", {"CPU": 4.0, "node": 1.0}, {"CPU": 4.0, "node": 1.0}),
+        _view("mock-2-x", {"CPU": 4.0, "node": 1.0}, {"CPU": 1.0, "node": 1.0}),
+    ]
+    a = _autoscaler(provider, views, idle_timeout_s=0.2, min_workers=0)
+    a._launched_at = {"mock-1": 0.0, "mock-2": 0.0}
+    a.update()  # marks mock-1 idle
+    time.sleep(0.25)
+    report = a.update()
+    assert report["terminated"] == 1
+    assert provider.nodes == ["mock-2"]  # busy node survives
+
+
+def test_scale_down_respects_min_workers():
+    provider = MockProvider({"CPU": 4.0})
+    provider.create_nodes(2)
+    views = [
+        _view("mock-1-x", {"CPU": 4.0}, {"CPU": 4.0}),
+        _view("mock-2-x", {"CPU": 4.0}, {"CPU": 4.0}),
+    ]
+    a = _autoscaler(provider, views, idle_timeout_s=0.1, min_workers=2)
+    a._launched_at = {"mock-1": 0.0, "mock-2": 0.0}
+    time.sleep(0.15)
+    a.update()
+    time.sleep(0.15)
+    a.update()
+    assert len(provider.nodes) == 2
+
+
+def test_end_to_end_subprocess_scale_up(ray_start_cluster):
+    """Real flow: saturate the head node, autoscaler launches a subprocess
+    node, the parked task completes on it."""
+    import ray_tpu
+    from ray_tpu.autoscaler import LocalSubprocessNodeProvider
+
+    cluster = ray_start_cluster
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    provider = LocalSubprocessNodeProvider(cluster.address, num_cpus=2)
+    a = StandardAutoscaler(
+        cluster.address, provider,
+        AutoscalerConfig(max_workers=1, update_interval_s=0.5,
+                         idle_timeout_s=60.0),
+    )
+    a.start()
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        def big(x):
+            import time as _t
+
+            _t.sleep(6)  # long enough that the second task must park
+            return x * 2
+
+        # head has 2 CPUs; two concurrent 2-CPU tasks -> one parks ->
+        # demand -> scale-up -> it completes on the new node
+        refs = [big.remote(i) for i in range(2)]
+        assert sorted(ray_tpu.get(refs, timeout=120)) == [0, 2]
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        a.stop()
+        ray_tpu.shutdown()
+
+
+def test_tpu_slice_provider_gang(ray_start_cluster):
+    """Slice provider brings up all hosts of a slice atomically; a
+    TPU-labeled gang placement group fits on it; terminate removes the
+    whole slice."""
+    import ray_tpu
+    from ray_tpu.util.tpu import slice_placement_group
+
+    cluster = ray_start_cluster
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    provider = TPUSliceNodeProvider(
+        cluster.address, hosts_per_slice=2, chips_per_host=2,
+        num_cpus_per_host=1.0,
+    )
+    try:
+        (slice_id,) = provider.create_nodes(1)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            nodes = [n for n in ray_tpu.nodes() if n["alive"]]
+            tpu_hosts = [
+                n for n in nodes if n["resources"].get("TPU", 0) > 0
+            ]
+            if len(tpu_hosts) == 2:
+                break
+            time.sleep(0.3)
+        assert len(tpu_hosts) == 2, nodes
+        assert all(
+            n["labels"]["tpu_slice_id"] == slice_id for n in tpu_hosts
+        )
+
+        pg = slice_placement_group(num_hosts=2, tpu_per_host=2)
+        assert pg.wait(timeout_seconds=60)
+
+        provider.terminate_node(slice_id)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            alive_tpu = [
+                n for n in ray_tpu.nodes()
+                if n["alive"] and n["resources"].get("TPU", 0) > 0
+            ]
+            if not alive_tpu:
+                break
+            time.sleep(0.5)
+        assert not alive_tpu
+    finally:
+        provider.shutdown()
+        ray_tpu.shutdown()
