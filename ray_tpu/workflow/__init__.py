@@ -1,0 +1,281 @@
+"""Workflow: durable DAG execution with exactly-once step semantics.
+
+Reference: python/ray/workflow/ — workflow_executor.py (DAG state machine),
+workflow_state_from_dag.py (DAG → steps), workflow_storage.py (step-result
+persistence). A workflow is a DAG of ``step``s; every completed step's
+result is checkpointed to storage before its dependents run, so a crashed
+driver resumes from the last completed frontier and finished steps are
+never re-executed (exactly-once per successful step).
+
+Steps execute as cluster tasks (each ``bind`` node runs via
+``ray_tpu.remote``); the DAG itself is pickled on first run so
+``workflow.resume(workflow_id)`` needs only the storage directory.
+
+    @workflow.step
+    def fetch(url): ...
+
+    @workflow.step
+    def combine(a, b): ...
+
+    result = workflow.run(
+        combine.bind(fetch.bind(u1), fetch.bind(u2)), workflow_id="w1"
+    )
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+__all__ = [
+    "StepFunction",
+    "DagNode",
+    "step",
+    "run",
+    "resume",
+    "get_status",
+    "get_output",
+    "list_all",
+    "delete",
+]
+
+_DEFAULT_STORAGE = os.environ.get(
+    "RAYTPU_WORKFLOW_STORAGE", "/tmp/raytpu_workflows"
+)
+
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+
+
+class DagNode:
+    """One step invocation in the DAG (reference: ray.dag DAGNode)."""
+
+    def __init__(self, fn: Callable, args: Tuple, kwargs: Dict, *,
+                 name: str, max_retries: int):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name
+        self.max_retries = max_retries
+
+    def children(self) -> List["DagNode"]:
+        out = [a for a in self.args if isinstance(a, DagNode)]
+        out += [v for v in self.kwargs.values() if isinstance(v, DagNode)]
+        return out
+
+
+class StepFunction:
+    def __init__(self, fn: Callable, *, name: Optional[str] = None,
+                 max_retries: int = 0):
+        self._fn = fn
+        self._name = name or fn.__name__
+        self._max_retries = max_retries
+
+    def bind(self, *args, **kwargs) -> DagNode:
+        return DagNode(
+            self._fn, args, kwargs, name=self._name,
+            max_retries=self._max_retries,
+        )
+
+    def options(self, *, name: Optional[str] = None,
+                max_retries: Optional[int] = None) -> "StepFunction":
+        return StepFunction(
+            self._fn,
+            name=name or self._name,
+            max_retries=self._max_retries if max_retries is None else max_retries,
+        )
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def step(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+         max_retries: int = 0):
+    if fn is None:
+        return lambda f: StepFunction(f, name=name, max_retries=max_retries)
+    return StepFunction(fn, name=name, max_retries=max_retries)
+
+
+# ---------------------------------------------------------------------------
+# storage (reference: workflow_storage.py)
+# ---------------------------------------------------------------------------
+
+
+class _Storage:
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(root, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", step_id + ".pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def save_step(self, step_id: str, result: Any):
+        tmp = self._step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(result, f, protocol=5)
+        os.replace(tmp, self._step_path(step_id))  # atomic: crash-safe
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self._step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save_dag(self, dag: DagNode):
+        import cloudpickle  # vendored with jax/flax deps
+
+        tmp = os.path.join(self.dir, "dag.pkl.tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(dag, f)
+        os.replace(tmp, os.path.join(self.dir, "dag.pkl"))
+
+    def load_dag(self) -> DagNode:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def set_status(self, status: str, error: str = ""):
+        with open(os.path.join(self.dir, "status.pkl"), "wb") as f:
+            pickle.dump({"status": status, "error": error, "ts": time.time()}, f)
+
+    def get_status(self) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self.dir, "status.pkl"), "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return {"status": "NOT_FOUND", "error": "", "ts": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+
+def _assign_step_ids(dag: DagNode) -> Dict[int, str]:
+    """Deterministic ids by post-order traversal (stable across resumes of
+    the same pickled DAG)."""
+    ids: Dict[int, str] = {}
+    counter = [0]
+
+    def visit(node: DagNode):
+        if id(node) in ids:
+            return
+        for child in node.children():
+            visit(child)
+        ids[id(node)] = f"{counter[0]:04d}_{node.name}"
+        counter[0] += 1
+
+    visit(dag)
+    return ids
+
+
+def _execute_dag(dag: DagNode, storage: _Storage) -> Any:
+    ids = _assign_step_ids(dag)
+    memo: Dict[int, Any] = {}
+
+    @ray_tpu.remote
+    def _run_step(fn, args, kwargs):
+        return fn(*args, **kwargs)
+
+    def resolve(node: DagNode) -> Any:
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        step_id = ids[key]
+        if storage.has_step(step_id):
+            value = storage.load_step(step_id)  # exactly-once: replay
+        else:
+            args = tuple(
+                resolve(a) if isinstance(a, DagNode) else a for a in node.args
+            )
+            kwargs = {
+                k: resolve(v) if isinstance(v, DagNode) else v
+                for k, v in node.kwargs.items()
+            }
+            attempts = node.max_retries + 1
+            while True:
+                attempts -= 1
+                try:
+                    value = ray_tpu.get(
+                        _run_step.remote(node.fn, args, kwargs), timeout=None
+                    )
+                    break
+                except Exception:
+                    if attempts <= 0:
+                        raise
+            storage.save_step(step_id, value)
+        memo[key] = value
+        return value
+
+    return resolve(dag)
+
+
+def run(dag: DagNode, *, workflow_id: Optional[str] = None,
+        storage: Optional[str] = None) -> Any:
+    """Execute the DAG durably; returns the final step's result."""
+    import uuid
+
+    if not isinstance(dag, DagNode):
+        raise TypeError("workflow.run expects a DagNode (use step.bind(...))")
+    workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:10]}"
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    store.save_dag(dag)
+    store.set_status(RUNNING)
+    try:
+        result = _execute_dag(dag, store)
+    except Exception as e:
+        store.set_status(FAILED, repr(e))
+        raise
+    store.save_step("__output__", result)
+    store.set_status(SUCCESSFUL)
+    return result
+
+
+def resume(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    """Re-run a workflow from storage; completed steps are not re-executed."""
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    if store.has_step("__output__"):
+        return store.load_step("__output__")
+    dag = store.load_dag()
+    store.set_status(RUNNING)
+    try:
+        result = _execute_dag(dag, store)
+    except Exception as e:
+        store.set_status(FAILED, repr(e))
+        raise
+    store.save_step("__output__", result)
+    store.set_status(SUCCESSFUL)
+    return result
+
+
+def get_status(workflow_id: str, *, storage: Optional[str] = None) -> str:
+    return _Storage(storage or _DEFAULT_STORAGE, workflow_id).get_status()["status"]
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None) -> Any:
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    if not store.has_step("__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no output (not finished?)")
+    return store.load_step("__output__")
+
+
+def list_all(*, storage: Optional[str] = None) -> List[Tuple[str, str]]:
+    root = storage or _DEFAULT_STORAGE
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for wid in sorted(os.listdir(root)):
+        status = _Storage(root, wid).get_status()["status"]
+        out.append((wid, status))
+    return out
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None):
+    shutil.rmtree(os.path.join(storage or _DEFAULT_STORAGE, workflow_id),
+                  ignore_errors=True)

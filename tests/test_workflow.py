@@ -1,0 +1,123 @@
+"""Workflow: durable DAG execution, crash-resume, exactly-once steps.
+
+(reference: python/ray/workflow/tests — recovery tests re-run a workflow
+after killing it and assert completed steps don't re-execute)
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+def test_linear_and_fanin_dag(ray_start_regular, tmp_path):
+    @workflow.step
+    def double(x):
+        return x * 2
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    dag = add.bind(double.bind(3), double.bind(4))
+    out = workflow.run(dag, workflow_id="w_fanin", storage=str(tmp_path))
+    assert out == 14
+    assert workflow.get_status("w_fanin", storage=str(tmp_path)) == "SUCCESSFUL"
+    assert workflow.get_output("w_fanin", storage=str(tmp_path)) == 14
+    assert ("w_fanin", "SUCCESSFUL") in workflow.list_all(storage=str(tmp_path))
+
+
+def test_resume_skips_completed_steps(ray_start_regular, tmp_path):
+    """Step B fails on the first run; resume re-runs ONLY B and the final
+    step — A's side-effect file shows exactly one execution."""
+    marks = tmp_path / "marks"
+    marks.mkdir()
+
+    def _mark(name):
+        n = len([f for f in os.listdir(marks) if f.startswith(name)])
+        (marks / f"{name}.{n}").write_text("x")
+
+    @workflow.step
+    def a(marks_dir):
+        n = len([f for f in os.listdir(marks_dir) if f.startswith("a")])
+        open(os.path.join(marks_dir, f"a.{n}"), "w").close()
+        return 10
+
+    @workflow.step
+    def b(x, marks_dir, fail_flag):
+        if os.path.exists(fail_flag):
+            os.unlink(fail_flag)
+            raise RuntimeError("transient failure")
+        n = len([f for f in os.listdir(marks_dir) if f.startswith("b")])
+        open(os.path.join(marks_dir, f"b.{n}"), "w").close()
+        return x + 5
+
+    flag = str(tmp_path / "fail_once")
+    open(flag, "w").close()
+    dag = b.bind(a.bind(str(marks)), str(marks), flag)
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w_resume", storage=str(tmp_path))
+    assert workflow.get_status("w_resume", storage=str(tmp_path)) == "FAILED"
+    assert len(list(marks.glob("a.*"))) == 1  # a completed + checkpointed
+
+    out = workflow.resume("w_resume", storage=str(tmp_path))
+    assert out == 15
+    # a was NOT re-executed; b ran exactly once successfully
+    assert len(list(marks.glob("a.*"))) == 1
+    assert len(list(marks.glob("b.*"))) == 1
+    assert workflow.get_status("w_resume", storage=str(tmp_path)) == "SUCCESSFUL"
+    # resuming a finished workflow just returns the stored output
+    assert workflow.resume("w_resume", storage=str(tmp_path)) == 15
+
+
+def test_step_retries(ray_start_regular, tmp_path):
+    @workflow.step(max_retries=2)
+    def flaky(flag):
+        if os.path.exists(flag):
+            os.unlink(flag)
+            raise RuntimeError("boom")
+        return "ok"
+
+    flag = str(tmp_path / "flake")
+    open(flag, "w").close()
+    out = workflow.run(
+        flaky.bind(flag), workflow_id="w_retry", storage=str(tmp_path)
+    )
+    assert out == "ok"
+
+
+def test_shared_subdag_runs_once(ray_start_regular, tmp_path):
+    """A diamond DAG: the shared node executes once, not once per parent."""
+    counter = tmp_path / "count"
+
+    @workflow.step
+    def base(path):
+        n = int(open(path).read()) if os.path.exists(path) else 0
+        open(path, "w").write(str(n + 1))
+        return 7
+
+    @workflow.step
+    def inc(x):
+        return x + 1
+
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    shared = base.bind(str(counter))
+    dag = add.bind(inc.bind(shared), inc.bind(shared))
+    assert workflow.run(dag, workflow_id="w_diamond", storage=str(tmp_path)) == 16
+    assert open(counter).read() == "1"
+
+
+def test_delete(ray_start_regular, tmp_path):
+    @workflow.step
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="w_del", storage=str(tmp_path))
+    workflow.delete("w_del", storage=str(tmp_path))
+    assert workflow.list_all(storage=str(tmp_path)) == []
