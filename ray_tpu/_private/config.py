@@ -63,6 +63,7 @@ class _Config:
         "rpc_dispatch_threads": 128,
         # --- task events / observability ---
         "task_events_enabled": True,
+        "log_to_driver": True,  # stream worker stdout/stderr to the driver
         "task_events_buffer_size": 100_000,
         "metrics_report_period_s": 5.0,
         "log_dir": "",

@@ -25,9 +25,11 @@ import logging
 import os
 import pickle
 import queue
+import sys
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -134,6 +136,11 @@ class CoreWorker:
         self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
         self.gcs.call("subscribe", "actors")  # actor address/state updates
         self.gcs.call("subscribe", "nodes")  # node death -> drop stale addrs
+        self.captured_logs: "deque" = deque(maxlen=1000)
+        if mode == "driver" and GlobalConfig.log_to_driver:
+            # worker stdout/stderr streamed back via the log monitors
+            # (reference: log_monitor.py -> gcs pubsub -> driver)
+            self.gcs.call("subscribe", "logs")
         self.raylet = RpcClient(raylet_address)
         reg = self.raylet.call(
             "register_worker",
@@ -1303,6 +1310,12 @@ class CoreWorker:
                     pass
 
     def _on_gcs_notify(self, channel: str, message: Any):
+        if channel == "logs":
+            prefix = f"({message.get('node', '')} worker={message.get('worker', '')[:8]})"
+            for line in message.get("lines", ()):
+                self.captured_logs.append((prefix, line))
+                print(f"{prefix} {line}", file=sys.stderr)
+            return
         if channel == "nodes":
             if message.get("event") == "removed":
                 node = message["node"]

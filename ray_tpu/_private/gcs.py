@@ -954,6 +954,59 @@ class GcsServer:
     def rpc_get_config(self, conn, payload=None):
         return GlobalConfig.dump()
 
+    # ------------------------------------------------------------------
+    # metrics (reference: per-node metrics agent -> Prometheus; here each
+    # process reports cumulative snapshots keyed by pid)
+    # ------------------------------------------------------------------
+
+    def rpc_report_metrics(self, conn, payload):
+        pid, records = payload
+        with self._lock:
+            if not hasattr(self, "_metrics"):
+                self._metrics = {}
+            self._metrics[pid] = records
+        return True
+
+    def rpc_get_metrics(self, conn, payload=None):
+        """Aggregate across reporting processes: sum counters + histogram
+        buckets, last-write-wins gauges."""
+        name_filter = payload
+        with self._lock:
+            per_proc = list(getattr(self, "_metrics", {}).values())
+        merged: Dict[str, Dict[str, Any]] = {}
+        for records in per_proc:
+            for rec in records:
+                if name_filter is not None and rec["name"] != name_filter:
+                    continue
+                out = merged.setdefault(
+                    rec["name"],
+                    {
+                        "name": rec["name"],
+                        "type": rec["type"],
+                        "description": rec["description"],
+                        "series": {},
+                    },
+                )
+                for key, value in rec["series"].items():
+                    cur = out["series"].get(key)
+                    if cur is None:
+                        out["series"][key] = value
+                    elif rec["type"] == "counter":
+                        out["series"][key] = cur + value
+                    elif rec["type"] == "histogram":
+                        out["series"][key] = {
+                            "buckets": [
+                                a + b
+                                for a, b in zip(cur["buckets"], value["buckets"])
+                            ],
+                            "sum": cur["sum"] + value["sum"],
+                            "count": cur["count"] + value["count"],
+                            "boundaries": value["boundaries"],
+                        }
+                    else:  # gauge: last write wins
+                        out["series"][key] = value
+        return list(merged.values())
+
     def stop(self):
         self._stopped.set()
         self.server.stop()

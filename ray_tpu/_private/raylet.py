@@ -90,6 +90,14 @@ class Raylet:
         )
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
+        # tail worker logs -> GCS "logs" pubsub -> driver stdout
+        # (reference: _private/log_monitor.py:102 LogMonitor,
+        # check_log_files_and_publish_updates:309)
+        self._log_offsets: Dict[str, int] = {}
+        self._log_thread = threading.Thread(
+            target=self._log_monitor_loop, name=f"logmon-{node_name}", daemon=True
+        )
+        self._log_thread.start()
         for _ in range(GlobalConfig.worker_pool_prestart):
             self._spawn_worker()
 
@@ -111,6 +119,7 @@ class Raylet:
         env["RAYTPU_GCS_PORT"] = str(self.gcs_address[1])
         env["RAYTPU_SESSION_DIR"] = self.session_dir
         env["RAYTPU_NODE_ID"] = self.node_id.hex()
+        env["PYTHONUNBUFFERED"] = "1"  # prints stream to the log monitor
         if not tpu:
             # CPU workers must not claim the TPU runtime: force the CPU
             # platform and disable the TPU PJRT plugin registration.
@@ -702,6 +711,55 @@ class Raylet:
         period = GlobalConfig.health_check_period_s
         while not self._stopped.wait(period / 2):
             self._heartbeat_now()
+
+    def _log_monitor_loop(self):
+        log_dir = os.path.join(self.session_dir, "logs")
+        while not self._stopped.wait(0.5):
+            try:
+                names = [
+                    n for n in os.listdir(log_dir)
+                    if n.startswith("worker-") and n.endswith(".log")
+                ]
+            except OSError:
+                continue
+            for name in names:
+                path = os.path.join(log_dir, name)
+                try:
+                    size = os.path.getsize(path)
+                    offset = self._log_offsets.get(name, 0)
+                    if size <= offset:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read(min(size - offset, 512 * 1024))
+                    # only ship complete lines; partial tail re-reads next tick
+                    cut = chunk.rfind(b"\n")
+                    if cut < 0:
+                        continue
+                    lines = chunk[:cut].decode(errors="replace").splitlines()
+                except OSError:
+                    continue
+                if not lines:
+                    self._log_offsets[name] = offset + cut + 1
+                    continue
+                try:
+                    self.gcs.call(
+                        "publish",
+                        (
+                            "logs",
+                            {
+                                "worker": name[len("worker-"):-len(".log")],
+                                "node": self.labels.get("node_name", ""),
+                                "lines": lines[:200],
+                            },
+                        ),
+                        timeout=5.0,
+                    )
+                    # advance only after a successful publish so a GCS
+                    # hiccup re-ships rather than drops the lines
+                    self._log_offsets[name] = offset + cut + 1
+                except Exception:
+                    pass
 
     def stop(self, unregister: bool = True):
         if unregister:

@@ -1,0 +1,204 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py (Counter:150, Histogram:215,
+Gauge:290) + the per-node metrics agent (_private/metrics_agent.py,
+OpenCensus→Prometheus). Here every process keeps a local registry; a
+reporter thread pushes cumulative snapshots to the GCS on
+``metrics_report_period_s``; `get_metrics()` aggregates across processes
+and `prometheus_text()` renders Prometheus exposition format for
+scraping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_registry_lock = threading.Lock()
+_registry: List["Metric"] = []
+_reporter_started = False
+
+
+def _ensure_reporter():
+    global _reporter_started
+    with _registry_lock:
+        if _reporter_started:
+            return
+        _reporter_started = True
+    t = threading.Thread(target=_report_loop, name="metrics-report", daemon=True)
+    t.start()
+
+
+def _gcs_client():
+    import ray_tpu._private.worker as worker_mod
+
+    w = worker_mod.global_worker
+    return None if w is None else w.core.gcs
+
+
+def _report_loop():
+    from ray_tpu._private.config import GlobalConfig
+
+    while True:
+        time.sleep(GlobalConfig.metrics_report_period_s)
+        try:
+            flush()
+        except Exception:
+            pass  # not connected / GCS down: keep recording locally
+
+
+def flush():
+    """Push the current snapshot now (also called by the reporter loop)."""
+    gcs = _gcs_client()
+    if gcs is None:
+        return
+    with _registry_lock:
+        records = [m._snapshot() for m in _registry]
+    records = [r for r in records if r["series"]]
+    if records:
+        gcs.call("report_metrics", (os.getpid(), records), timeout=5.0)
+
+
+class Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_reporter()
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]):
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"tags {sorted(extra)} not declared in tag_keys={self.tag_keys}"
+            )
+        return tuple(sorted(merged.items()))
+
+    def _snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            series = {k: self._export(v) for k, v in self._series.items()}
+        return {
+            "name": self.name,
+            "type": self.TYPE,
+            "description": self.description,
+            "series": series,
+        }
+
+    @staticmethod
+    def _export(value):
+        return value
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only go up")
+        key = self._key(tags)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = {
+                    "buckets": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+                self._series[key] = state
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            state["buckets"][idx] += 1
+            state["sum"] += value
+            state["count"] += 1
+        # exported with boundaries so aggregation can merge
+        return value
+
+    def _export(self, value):
+        return {**value, "boundaries": self.boundaries}
+
+
+# ---------------------------------------------------------------------------
+# querying / exposition
+# ---------------------------------------------------------------------------
+
+
+def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Cluster-wide aggregated metrics from the GCS (summed across
+    reporting processes for counters/histograms; last-write for gauges)."""
+    gcs = _gcs_client()
+    if gcs is None:
+        raise RuntimeError("not connected — call ray_tpu.init() first")
+    flush()
+    records = gcs.call("get_metrics", name, timeout=10.0)
+    return records
+
+
+def prometheus_text() -> str:
+    """Render the aggregated metrics in Prometheus exposition format."""
+    lines: List[str] = []
+    for rec in get_metrics():
+        name = rec["name"]
+        lines.append(f"# HELP {name} {rec['description']}")
+        lines.append(f"# TYPE {name} {rec['type']}")
+        for tag_items, value in rec["series"].items():
+            labels = ",".join(f'{k}="{v}"' for k, v in tag_items)
+            labels = "{" + labels + "}" if labels else ""
+            if rec["type"] == "histogram":
+                acc = 0
+                for b, c in zip(value["boundaries"], value["buckets"]):
+                    acc += c
+                    lb = labels[:-1] + f',le="{b}"}}' if labels else f'{{le="{b}"}}'
+                    lines.append(f"{name}_bucket{lb} {acc}")
+                total = sum(value["buckets"])
+                inf_lb = labels[:-1] + ',le="+Inf"}' if labels else '{le="+Inf"}'
+                lines.append(f"{name}_bucket{inf_lb} {total}")
+                lines.append(f"{name}_sum{labels} {value['sum']}")
+                lines.append(f"{name}_count{labels} {value['count']}")
+            else:
+                lines.append(f"{name}{labels} {value}")
+    return "\n".join(lines) + "\n"

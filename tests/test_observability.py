@@ -1,0 +1,87 @@
+"""Observability: user metrics + worker-log streaming to the driver.
+
+(reference: ray.util.metrics Counter/Gauge/Histogram + _private/
+log_monitor.py streaming worker stdout through GCS pubsub)
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = metrics.Gauge("test_depth", "queue depth")
+    g.set(7.0)
+    h = metrics.Histogram(
+        "test_latency", "latency", boundaries=(0.1, 1.0), tag_keys=()
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+
+    recs = {r["name"]: r for r in metrics.get_metrics()}
+    series = recs["test_requests"]["series"]
+    assert series[(("route", "/a"),)] == 3.0
+    assert series[(("route", "/b"),)] == 1.0
+    assert recs["test_depth"]["series"][()] == 7.0
+    hist = recs["test_latency"]["series"][()]
+    assert hist["buckets"] == [1, 1, 1] and hist["count"] == 3
+
+    text = metrics.prometheus_text()
+    assert 'test_requests{route="/a"} 3.0' in text
+    assert "test_latency_bucket" in text and 'le="+Inf"' in text
+
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_metrics_aggregate_across_workers(ray_start_regular):
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util import metrics as m
+
+        cnt = m.Counter("test_cross_proc", "x")
+        cnt.inc(5.0)
+        m.flush()
+        return True
+
+    assert ray_tpu.get([work.remote(), work.remote()], timeout=60) == [True, True]
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        recs = {r["name"]: r for r in metrics.get_metrics("test_cross_proc")}
+        if recs and sum(recs["test_cross_proc"]["series"].values()) >= 10.0:
+            break
+        time.sleep(0.3)
+    # two worker processes each reported a cumulative 5.0 -> sum 10
+    assert sum(recs["test_cross_proc"]["series"].values()) == 10.0
+
+
+def test_worker_logs_stream_to_driver(ray_start_regular):
+    @ray_tpu.remote
+    def chatty():
+        print("hello from the worker side")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+    core = ray_start_regular.core
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if any(
+            "hello from the worker side" in line
+            for _, line in list(core.captured_logs)
+        ):
+            return
+        time.sleep(0.3)
+    pytest.fail(f"worker print never reached the driver: {list(core.captured_logs)[:5]}")
