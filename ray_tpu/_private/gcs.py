@@ -490,14 +490,29 @@ class GcsServer:
             ]
             if not candidates:
                 return None
-            # prefer most-available (spread-ish)
-            return max(
-                candidates,
-                key=lambda n: min(
-                    (n.available_resources.get(k, 0) - v for k, v in resources.items()),
-                    default=0,
-                ),
-            )
+            # Hybrid policy (reference: scheduling/policy/
+            # hybrid_scheduling_policy.h:50,85-118): below the spread
+            # threshold of critical-resource utilization a node counts as
+            # "low load"; pick uniformly among the top-k lowest-utilization
+            # nodes so hot spots spread without stampeding one node.
+            import random as _random
+
+            def utilization(n: NodeInfo) -> float:
+                worst = 0.0
+                for k, v in resources.items():
+                    total = n.total_resources.get(k, 0)
+                    if total <= 0:
+                        continue
+                    used = total - n.available_resources.get(k, 0) + v
+                    worst = max(worst, used / total)
+                return worst
+
+            ranked = sorted(candidates, key=utilization)
+            threshold = GlobalConfig.scheduler_spread_threshold
+            low = [n for n in ranked if utilization(n) <= threshold]
+            pool = low or ranked
+            k = max(1, int(len(pool) * GlobalConfig.scheduler_top_k_fraction))
+            return _random.choice(pool[:k])
 
     def _raylet_client(self, node: NodeInfo) -> RpcClient:
         with self._lock:

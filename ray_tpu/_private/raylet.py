@@ -711,6 +711,44 @@ class Raylet:
         period = GlobalConfig.health_check_period_s
         while not self._stopped.wait(period / 2):
             self._heartbeat_now()
+            self._reap_idle_workers()
+
+    def _reap_idle_workers(self):
+        """Kill pooled workers idle past worker_idle_timeout_s (reference:
+        worker_pool.h idle worker eviction), keeping the prestart floor."""
+        timeout = GlobalConfig.worker_idle_timeout_s
+        if timeout <= 0:
+            return
+        now = time.monotonic()
+        to_kill: List[WorkerHandle] = []
+        with self._res_cv:
+            idle = [
+                h
+                for h in self._workers.values()
+                if h.idle
+                and h.proc is not None  # never reap drivers/external workers
+                and h.registered.is_set()
+                and not h.actor_ids
+                and now - h.last_idle_at > timeout
+            ]
+            floor = GlobalConfig.worker_pool_prestart
+            total_idle = sum(
+                1
+                for h in self._workers.values()
+                if h.idle and h.registered.is_set() and not h.actor_ids
+            )
+            for h in idle:
+                if total_idle <= floor:
+                    break
+                self._workers.pop(h.worker_id, None)
+                total_idle -= 1
+                to_kill.append(h)
+        for h in to_kill:
+            logger.info(
+                "reaping worker %s idle for >%gs", h.worker_id.hex()[:8], timeout
+            )
+            if h.proc.poll() is None:
+                h.proc.terminate()
 
     def _log_monitor_loop(self):
         log_dir = os.path.join(self.session_dir, "logs")

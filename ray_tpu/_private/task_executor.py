@@ -45,6 +45,24 @@ class _ActorState:
         self.queue: "collections.deque" = collections.deque()
         self.cv = threading.Condition()
         self.thread: Optional[threading.Thread] = None
+        # lazily created per-actor asyncio loop for async methods (the
+        # boost::fibers analogue — core_worker/fiber.h:17; here a real
+        # event loop thread so `async def` methods interleave)
+        self._loop = None
+        self._loop_lock = threading.Lock()
+
+    def ensure_loop(self):
+        import asyncio
+
+        with self._loop_lock:
+            if self._loop is None:
+                self._loop = asyncio.new_event_loop()
+                t = threading.Thread(
+                    target=self._loop.run_forever, name="actor-asyncio",
+                    daemon=True,
+                )
+                t.start()
+            return self._loop
 
     def enqueue(self, item):
         with self.cv:
@@ -140,13 +158,25 @@ class TaskExecutor:
             "ref_locations": ref_locations,
         }
 
-    def _run(self, fn, args, kwargs, task_id, name: str):
+    def _run(self, fn, args, kwargs, task_id, name: str, loop=None):
+        import asyncio
+        import inspect
+
         token_tid = getattr(self.core._task_ctx, "task_id", None)
         token_name = getattr(self.core._task_ctx, "task_name", None)
         self.core._task_ctx.task_id = task_id
         self.core._task_ctx.task_name = name
         try:
-            return fn(*args, **kwargs), False
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                if loop is not None:
+                    # async actor method: all coroutines of this actor share
+                    # one event loop so concurrent calls interleave (the
+                    # asyncio equivalent of the reference's fiber actors)
+                    result = asyncio.run_coroutine_threadsafe(result, loop).result()
+                else:
+                    result = asyncio.run(result)  # async normal task
+            return result, False
         except Exception as e:  # noqa: BLE001
             return TaskError(e, name, traceback.format_exc()), True
         finally:
@@ -242,7 +272,16 @@ class TaskExecutor:
             except Exception as e:  # noqa: BLE001
                 value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
             else:
-                value, is_exc = self._run(method, args, kwargs, task_id, spec["name"])
+                import inspect
+
+                loop = (
+                    state.ensure_loop()
+                    if inspect.iscoroutinefunction(getattr(method, "__func__", method))
+                    else None
+                )
+                value, is_exc = self._run(
+                    method, args, kwargs, task_id, spec["name"], loop=loop
+                )
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc
         )

@@ -1,0 +1,126 @@
+"""ActorPool: load-balance work over a fixed set of actors.
+
+Reference: python/ray/util/actor_pool.py — same surface (submit/get_next/
+get_next_unordered/map/map_unordered/has_next/has_free/push/pop_idle) and
+the same pending-submit queue: a submit with no idle actor parks until a
+result hands its actor back.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: deque = deque()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any):
+        """``fn(actor, value) -> ObjectRef``; queues if no actor is idle."""
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = ref
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _return_actor(self, actor):
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.popleft())
+
+    # -- retrieval ---------------------------------------------------------
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in submission order."""
+        if not self.has_next():
+            raise RuntimeError("no more results (get_next past the end)")
+        # the wanted future may still be a pending submit
+        while self._next_return_index not in self._index_to_future:
+            self._drain_one(timeout)
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        try:
+            return ray_tpu.get(ref, timeout=timeout)
+        finally:
+            _, actor = self._future_to_actor.pop(ref, (None, None))
+            if actor is not None:
+                self._return_actor(actor)
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Next result to finish, regardless of submission order."""
+        if not self.has_next():
+            raise RuntimeError("no pending tasks")
+        while not self._future_to_actor:
+            self._drain_one(timeout)  # pending submits only: kick one off
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("timed out waiting for a pool result")
+        ref = ready[0]
+        index, actor = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(index, None)
+        if actor is not None:  # None when _drain_one already returned it
+            self._return_actor(actor)
+        return ray_tpu.get(ref, timeout=timeout)
+
+    def _drain_one(self, timeout: Optional[float]):
+        """Make progress when the wanted work is still queued: wait for any
+        in-flight future so its actor frees up and a pending submit runs."""
+        if not self._future_to_actor:
+            raise RuntimeError("internal: pending submits but no idle actor")
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("timed out waiting for a pool slot")
+        ref = ready[0]
+        entry = self._future_to_actor.get(ref)
+        if entry is None:
+            return
+        index, actor = entry
+        # keep the future for get_next (result not consumed yet) but hand
+        # the actor back so queued submits proceed
+        self._future_to_actor[ref] = (index, None)
+        self._return_actor(actor)
+
+    # -- bulk --------------------------------------------------------------
+
+    def map(self, fn, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- membership --------------------------------------------------------
+
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def push(self, actor: Any):
+        self._return_actor(actor)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,177 @@
+"""multiprocessing.Pool-compatible Pool over cluster actors.
+
+Reference: python/ray/util/multiprocessing/pool.py — the drop-in
+``Pool`` whose workers are actors, so a pool can span nodes.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    """Functions arrive cloudpickled BY VALUE: a plain pickle would
+    reference the caller's __main__/test module, which workers can't
+    import."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            cloudpickle.loads(initializer)(*initargs)
+
+    def run(self, fn, args, kwargs):
+        return cloudpickle.loads(fn)(*args, **(kwargs or {}))
+
+    def run_batch(self, fn, chunk):
+        f = cloudpickle.loads(fn)
+        return [f(*a) for a in chunk]
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        values = ray_tpu.get(self._refs, timeout=timeout)
+        return values[0] if self._single else values
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None, initializer=None,
+                 initargs=()):
+        self._processes = processes or os.cpu_count() or 1
+        init_blob = None if initializer is None else cloudpickle.dumps(initializer)
+        self._workers = [
+            _PoolWorker.remote(init_blob, tuple(initargs))
+            for _ in range(self._processes)
+        ]
+        self._rr = itertools.cycle(range(self._processes))
+        self._closed = False
+
+    def _check(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _next_worker(self):
+        return self._workers[next(self._rr)]
+
+    # -- apply -------------------------------------------------------------
+
+    def apply(self, fn: Callable, args: Tuple = (), kwargs: Optional[dict] = None):
+        return self.apply_async(fn, args, kwargs).get(timeout=None)
+
+    def apply_async(self, fn, args=(), kwargs=None) -> AsyncResult:
+        self._check()
+        ref = self._next_worker().run.remote(
+            cloudpickle.dumps(fn), tuple(args), kwargs
+        )
+        return AsyncResult([ref], single=True)
+
+    # -- map ---------------------------------------------------------------
+
+    @staticmethod
+    def _chunks(items: List[Any], chunksize: int):
+        for i in range(0, len(items), chunksize):
+            yield items[i : i + chunksize]
+
+    def _map_refs(self, fn, star_args: List[Tuple], chunksize: Optional[int]):
+        if chunksize is None:
+            chunksize = max(1, len(star_args) // (self._processes * 4) or 1)
+        blob = cloudpickle.dumps(fn)
+        refs = []
+        sizes = []
+        for chunk in self._chunks(star_args, chunksize):
+            refs.append(self._next_worker().run_batch.remote(blob, chunk))
+            sizes.append(len(chunk))
+        return refs, sizes
+
+    def map(self, fn, iterable: Iterable, chunksize: Optional[int] = None):
+        return self.starmap(fn, [(x,) for x in iterable], chunksize)
+
+    def map_async(self, fn, iterable, chunksize=None) -> "AsyncResult":
+        self._check()
+        refs, _ = self._map_refs(fn, [(x,) for x in iterable], chunksize)
+        return _MapResult(refs)
+
+    def starmap(self, fn, iterable: Iterable[Tuple], chunksize=None):
+        self._check()
+        star = list(iterable)
+        refs, _ = self._map_refs(fn, star, chunksize)
+        out: List[Any] = []
+        for chunk in ray_tpu.get(refs, timeout=None):
+            out.extend(chunk)
+        return out
+
+    def imap(self, fn, iterable, chunksize: Optional[int] = 1):
+        self._check()
+        refs, _ = self._map_refs(fn, [(x,) for x in iterable], chunksize)
+        for ref in refs:
+            for value in ray_tpu.get(ref, timeout=None):
+                yield value
+
+    def imap_unordered(self, fn, iterable, chunksize: Optional[int] = 1):
+        self._check()
+        refs, _ = self._map_refs(fn, [(x,) for x in iterable], chunksize)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=None)
+            for value in ray_tpu.get(ready[0], timeout=None):
+                yield value
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _MapResult(AsyncResult):
+    def __init__(self, refs):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: Optional[float] = None):
+        out: List[Any] = []
+        for chunk in ray_tpu.get(self._refs, timeout=timeout):
+            out.extend(chunk)
+        return out

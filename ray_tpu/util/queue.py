@@ -1,0 +1,125 @@
+"""Distributed Queue backed by an async actor.
+
+Reference: python/ray/util/queue.py — a Queue actor with asyncio.Queue
+inside an async actor so blocking gets don't wedge concurrent puts (the
+exact pattern the reference uses; here it exercises the framework's
+asyncio actor support).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        self._q: asyncio.Queue = asyncio.Queue(maxsize)
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        try:
+            if timeout is None:
+                await self._q.put(item)
+            else:
+                await asyncio.wait_for(self._q.put(item), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def get(self, timeout: Optional[float] = None):
+        try:
+            if timeout is None:
+                return True, await self._q.get()
+            return True, await asyncio.wait_for(self._q.get(), timeout)
+        except asyncio.TimeoutError:
+            return False, None
+
+    async def put_nowait(self, item) -> bool:
+        try:
+            self._q.put_nowait(item)
+            return True
+        except asyncio.QueueFull:
+            return False
+
+    async def get_nowait(self):
+        try:
+            return True, self._q.get_nowait()
+        except asyncio.QueueEmpty:
+            return False, None
+
+    async def qsize(self) -> int:
+        return self._q.qsize()
+
+    async def empty(self) -> bool:
+        return self._q.empty()
+
+    async def full(self) -> bool:
+        return self._q.full()
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        self.maxsize = maxsize
+        opts = dict(actor_options or {})
+        opts.setdefault("max_concurrency", 16)  # async actor: calls interleave
+        self._actor = _QueueActor.options(**opts).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        if not block:
+            ok = ray_tpu.get(self._actor.put_nowait.remote(item), timeout=30)
+            if not ok:
+                raise Full
+            return
+        ok = ray_tpu.get(
+            self._actor.put.remote(item, timeout),
+            timeout=None if timeout is None else timeout + 30,
+        )
+        if not ok:
+            raise Full
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        if not block:
+            ok, item = ray_tpu.get(self._actor.get_nowait.remote(), timeout=30)
+            if not ok:
+                raise Empty
+            return item
+        ok, item = ray_tpu.get(
+            self._actor.get.remote(timeout),
+            timeout=None if timeout is None else timeout + 30,
+        )
+        if not ok:
+            raise Empty
+        return item
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return ray_tpu.get(self._actor.empty.remote(), timeout=30)
+
+    def full(self) -> bool:
+        return ray_tpu.get(self._actor.full.remote(), timeout=30)
+
+    def put_batch(self, items: List[Any]):
+        for item in items:
+            self.put(item)
+
+    def shutdown(self):
+        ray_tpu.kill(self._actor)

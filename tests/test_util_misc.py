@@ -1,0 +1,163 @@
+"""util misc: ActorPool, Queue (async actor), multiprocessing.Pool,
+async actor semantics.
+
+(reference: python/ray/util/actor_pool.py, util/queue.py,
+util/multiprocessing/pool.py, async actors via boost fibers)
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return x * 2
+
+
+def test_actor_pool_ordered_and_unordered(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    results = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert results == [0, 2, 4, 6, 8, 10]  # submission order
+
+    unordered = sorted(
+        pool.map_unordered(lambda a, v: a.double.remote(v), range(6))
+    )
+    assert unordered == [0, 2, 4, 6, 8, 10]
+
+    assert pool.has_free()
+    a = pool.pop_idle()
+    assert a is not None
+    pool.push(a)
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 20
+    assert pool.get_next(timeout=30) == 40
+    assert not pool.has_next()
+
+
+def test_async_actor_concurrent_methods(ray_start_regular):
+    """Two concurrent async calls interleave on the actor's event loop:
+    total wall time ~max, not sum, of the sleeps."""
+
+    @ray_tpu.remote(max_concurrency=4)
+    class AsyncActor:
+        async def slow(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.5)
+            return x
+
+    a = AsyncActor.remote()
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.slow.remote(i) for i in range(4)], timeout=60)
+    elapsed = time.monotonic() - t0
+    assert sorted(out) == [0, 1, 2, 3]
+    assert elapsed < 1.6, f"async calls serialized: {elapsed:.2f}s"
+
+
+def test_queue_blocking_and_nowait(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2 and q.full()
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_queue_producer_consumer(ray_start_regular):
+    from ray_tpu.util.queue import Queue
+
+    q = Queue(maxsize=4)
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ref = producer.remote(q, 10)
+    got = [q.get(timeout=30) for _ in range(10)]
+    assert got == list(range(10))
+    assert ray_tpu.get(ref, timeout=30) is True
+    q.shutdown()
+
+
+def test_mp_pool_map_starmap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    # closures (not importable module globals): cloudpickle ships them by
+    # value, like the reference pool's interactively-defined functions
+    _square = lambda x: x * x  # noqa: E731
+    _addmul = lambda a, b: a * 10 + b  # noqa: E731
+
+    with Pool(2) as pool:
+        assert pool.map(_square, range(8)) == [x * x for x in range(8)]
+        assert pool.starmap(_addmul, [(1, 2), (3, 4)]) == [12, 34]
+        assert pool.apply(_square, (5,)) == 25
+        r = pool.apply_async(_square, (6,))
+        assert r.get(timeout=30) == 36
+        assert sorted(pool.imap_unordered(_square, range(5))) == [0, 1, 4, 9, 16]
+        assert list(pool.imap(_square, range(5))) == [0, 1, 4, 9, 16]
+        m = pool.map_async(_square, range(4))
+        assert m.get(timeout=30) == [0, 1, 4, 9]
+    with pytest.raises(ValueError):
+        pool.map(_square, [1])  # closed
+
+
+def test_idle_worker_reaping():
+    """worker_idle_timeout_s: pooled workers die after idling (reference:
+    worker_pool.h idle eviction)."""
+    import ray_tpu
+
+    worker = ray_tpu.init(
+        num_cpus=2,
+        log_level="WARNING",
+        _system_config={"worker_idle_timeout_s": 1.0, "health_check_period_s": 0.5},
+    )
+    try:
+        @ray_tpu.remote
+        def touch():
+            import os
+
+            return os.getpid()
+
+        pids = ray_tpu.get([touch.remote() for _ in range(2)], timeout=60)
+        node = worker.node
+        raylet = node.raylet
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with raylet._res_cv:
+                pooled = [
+                    h for h in raylet._workers.values() if h.proc is not None
+                ]
+            if not pooled:
+                break
+            time.sleep(0.3)
+        assert not pooled, f"{len(pooled)} idle workers never reaped"
+        # the pool recovers: a new task spawns a fresh worker
+        assert ray_tpu.get(touch.remote(), timeout=60) > 0
+    finally:
+        ray_tpu.shutdown()
