@@ -657,6 +657,7 @@ class CoreWorker:
         name: str = "",
         scheduling_node: Optional[NodeID] = None,
         scheduling_soft: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
     ) -> List[ObjectID]:
         task_id = self._next_task_id()
         fn_id = self.export_function(fn)
@@ -682,6 +683,7 @@ class CoreWorker:
             "caller_id": self.worker_id,
             "scheduling_node": scheduling_node,
             "scheduling_soft": scheduling_soft,
+            "runtime_env": runtime_env,
         }
         with self._pending_lock:
             self._pending[task_id] = spec
@@ -696,7 +698,9 @@ class CoreWorker:
     def _lease_sig(self, spec: Dict[str, Any]) -> Optional[Tuple]:
         if spec.get("scheduling_node") is not None:
             return None  # affinity-constrained: never reuse generic leases
-        return tuple(sorted((spec.get("resources") or {}).items()))
+        env = spec.get("runtime_env") or {}
+        env_sig = tuple(sorted((env.get("env_vars") or {}).items()))
+        return (tuple(sorted((spec.get("resources") or {}).items())), env_sig)
 
     def _maybe_push_from_cache(self, sig: Tuple):
         """Marry waiting specs with idle cached leases (no raylet RPC)."""
@@ -727,7 +731,9 @@ class CoreWorker:
     def _acquire_lease(self, sig: Tuple):
         """Run the lease dance for one worker of shape ``sig`` (submitter
         thread), then hand it to a waiting spec."""
-        resources = dict(sig)
+        res_sig, env_sig = sig
+        resources = dict(res_sig)
+        runtime_env = {"env_vars": dict(env_sig)} if env_sig else None
         lease_raylet = self.raylet
         hops = 0
         try:
@@ -744,6 +750,7 @@ class CoreWorker:
                         {
                             "resources": resources,
                             "job_id": self.job_id,
+                            "runtime_env": runtime_env,
                             "allow_spill": hops == 0,
                             "timeout": 1.0,
                         },
@@ -916,6 +923,7 @@ class CoreWorker:
                     {
                         "resources": spec["resources"],
                         "job_id": spec["job_id"],
+                        "runtime_env": spec.get("runtime_env"),
                         # a redirected request must not bounce again (avoids
                         # spillback ping-pong between two saturated nodes)
                         "allow_spill": hops == 0,

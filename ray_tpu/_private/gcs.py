@@ -549,6 +549,7 @@ class GcsServer:
                         "resources": resources,
                         "actor_id": info.actor_id,
                         "job_id": spec["job_id"],
+                        "runtime_env": spec["options"].get("runtime_env"),
                         # the GCS picks the node itself; a raylet-side
                         # spillback redirect would only confuse this loop
                         "allow_spill": False,

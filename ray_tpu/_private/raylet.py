@@ -30,10 +30,12 @@ logger = logging.getLogger(__name__)
 
 
 class WorkerHandle:
-    def __init__(self, worker_id: WorkerID, proc: Optional[subprocess.Popen], tpu: bool = False):
+    def __init__(self, worker_id: WorkerID, proc: Optional[subprocess.Popen], tpu: bool = False,
+                 env_hash: tuple = ()):
         self.worker_id = worker_id
         self.proc = proc
         self.tpu = tpu
+        self.env_hash = env_hash  # runtime_env env_vars this worker runs with
         self.address: Optional[Tuple[str, int]] = None
         self.registered = threading.Event()
         self.idle = True
@@ -109,9 +111,14 @@ class Raylet:
     # worker pool
     # ------------------------------------------------------------------
 
-    def _spawn_worker(self, tpu: bool = False) -> WorkerHandle:
+    def _spawn_worker(self, tpu: bool = False,
+                      env_vars: Optional[Dict[str, str]] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        if env_vars:
+            # runtime_env: workers are pooled per env_vars set (the
+            # reference keys its worker pool by runtime_env hash)
+            env.update(env_vars)
         env["RAYTPU_WORKER_ID"] = worker_id.hex()
         env["RAYTPU_RAYLET_HOST"] = self.server.host
         env["RAYTPU_RAYLET_PORT"] = str(self.server.port)
@@ -142,7 +149,10 @@ class Raylet:
             )
         finally:
             logfile.close()  # the child holds its own inherited fd
-        handle = WorkerHandle(worker_id, proc, tpu=tpu)
+        handle = WorkerHandle(
+            worker_id, proc, tpu=tpu,
+            env_hash=tuple(sorted((env_vars or {}).items())),
+        )
         with self._res_cv:
             self._workers[worker_id] = handle
         return handle
@@ -252,19 +262,22 @@ class Raylet:
                 )
                 for k, v in resources.items()
             )
+            env = (payload.get("runtime_env") or {}).get("env_vars") or {}
+            env_hash = tuple(sorted(env.items()))
             spill_checked = False
             demand_key = id(payload)
             self._demand[demand_key] = dict(resources)
             try:
                 return self._lease_loop_locked(
                     resources, actor_id, deadline, allow_spill, need_tpu,
-                    spill_checked,
+                    spill_checked, env_hash,
                 )
             finally:
                 self._demand.pop(demand_key, None)
 
     def _lease_loop_locked(
-        self, resources, actor_id, deadline, allow_spill, need_tpu, spill_checked
+        self, resources, actor_id, deadline, allow_spill, need_tpu,
+        spill_checked, env_hash=(),
     ):
         """The parked-request wait loop; runs with _res_cv held (the caller
         registered this request in self._demand for heartbeat reporting)."""
@@ -274,7 +287,11 @@ class Raylet:
                 have_resources = effective is not None and all(
                     self.available.get(k, 0) >= v for k, v in effective.items()
                 )
-                idle = self._pop_idle_locked(need_tpu) if have_resources else None
+                idle = (
+                    self._pop_idle_locked(need_tpu, env_hash)
+                    if have_resources
+                    else None
+                )
                 if have_resources and idle is not None:
                     for k, v in effective.items():
                         self.available[k] = self.available.get(k, 0) - v
@@ -288,7 +305,9 @@ class Raylet:
                     spawning = sum(
                         1
                         for h in self._workers.values()
-                        if not h.registered.is_set() and h.tpu == need_tpu
+                        if not h.registered.is_set()
+                        and h.tpu == need_tpu
+                        and h.env_hash == env_hash
                     )
                     if (
                         spawning == 0
@@ -296,7 +315,7 @@ class Raylet:
                     ):
                         self._res_cv.release()
                         try:
-                            self._spawn_worker(tpu=need_tpu)
+                            self._spawn_worker(tpu=need_tpu, env_vars=dict(env_hash))
                         finally:
                             self._res_cv.acquire()
                 if not have_resources and allow_spill and not spill_checked:
@@ -343,13 +362,15 @@ class Raylet:
             self.available[k] = self.available.get(k, 0) + v
         handle.lease_resources = {}
 
-    def _pop_idle_locked(self, need_tpu: bool = False) -> Optional[WorkerHandle]:
+    def _pop_idle_locked(self, need_tpu: bool = False,
+                         env_hash: tuple = ()) -> Optional[WorkerHandle]:
         for handle in self._workers.values():
             if (
                 handle.idle
                 and handle.registered.is_set()
                 and not handle.actor_ids
                 and handle.tpu == need_tpu
+                and handle.env_hash == env_hash
             ):
                 return handle
         return None

@@ -77,6 +77,23 @@ def _check_options(options: Dict[str, Any]):
     unknown = set(options) - _VALID_OPTIONS
     if unknown:
         raise ValueError(f"unknown options: {sorted(unknown)}")
+    env = options.get("runtime_env")
+    if env is not None:
+        supported = {"env_vars"}
+        extra = set(env) - supported
+        if extra:
+            # pip/conda/working_dir need a per-node env agent (not built);
+            # fail loudly rather than silently ignore
+            raise ValueError(
+                f"runtime_env fields {sorted(extra)} not supported "
+                f"(supported: {sorted(supported)})"
+            )
+        env_vars = env.get("env_vars") or {}
+        if not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env_vars.items()
+        ):
+            raise ValueError("runtime_env env_vars must be str->str")
 
 
 class RemoteFunction:
@@ -105,6 +122,7 @@ class RemoteFunction:
             name=self._options.get("name") or self._fn.__name__,
             scheduling_node=node_id,
             scheduling_soft=soft,
+            runtime_env=self._options.get("runtime_env"),
         )
         return refs[0] if num_returns == 1 else refs
 
@@ -191,6 +209,7 @@ class ActorClass:
             "resources_spec": _resources_from_options(self._options, default_cpu=1.0),
             "scheduling_node": node_id,
             "scheduling_soft": soft,
+            "runtime_env": self._options.get("runtime_env"),
         }
         actor_id = core.create_actor(self._cls, args, kwargs, options)
         return ActorHandle(

@@ -161,3 +161,41 @@ def test_idle_worker_reaping():
         assert ray_tpu.get(touch.remote(), timeout=60) > 0
     finally:
         ray_tpu.shutdown()
+
+
+def test_runtime_env_env_vars(ray_start_regular):
+    """runtime_env env_vars: tasks/actors run in workers spawned with the
+    vars; the pool is keyed by env so plain tasks never see them."""
+    import os as _os
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RAYTPU_TEST_FLAG": "abc"}})
+    def read_env():
+        import os
+
+        return os.environ.get("RAYTPU_TEST_FLAG")
+
+    @ray_tpu.remote
+    def read_plain():
+        import os
+
+        return os.environ.get("RAYTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "abc"
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+    @ray_tpu.remote
+    class EnvActor:
+        def val(self):
+            import os
+
+            return os.environ.get("RAYTPU_TEST_FLAG")
+
+    a = EnvActor.options(
+        runtime_env={"env_vars": {"RAYTPU_TEST_FLAG": "xyz"}}
+    ).remote()
+    assert ray_tpu.get(a.val.remote(), timeout=60) == "xyz"
+
+    with pytest.raises(ValueError):
+        read_env.options(runtime_env={"pip": ["numpy"]})
+    with pytest.raises(ValueError):
+        read_env.options(runtime_env={"env_vars": {"A": 1}})
