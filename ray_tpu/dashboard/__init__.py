@@ -1,0 +1,191 @@
+"""Dashboard: HTTP backend + single-page UI over the cluster state.
+
+Reference: dashboard/head.py:71 DashboardHead + the aiohttp REST modules
+(node/actor/job/metrics/state) + the React SPA. Here: one stdlib
+ThreadingHTTPServer on the head serving JSON APIs backed by the state API
+and metrics aggregation, plus a self-contained HTML page that polls them —
+no build step, no extra deps.
+
+APIs:
+  GET /api/nodes | /api/actors | /api/tasks | /api/jobs | /api/objects
+      /api/placement_groups | /api/summary | /api/cluster
+  GET /metrics           (Prometheus exposition)
+  GET /                  (the UI)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} .ok{color:#0a7d2c} .bad{color:#c0232c}
+ #updated{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>ray_tpu dashboard <span id="updated"></span></h1>
+<div id="cluster"></div>
+<h2>Nodes</h2><div id="nodes"></div>
+<h2>Actors</h2><div id="actors"></div>
+<h2>Jobs</h2><div id="jobs"></div>
+<h2>Task summary</h2><div id="summary"></div>
+<h2>Placement groups</h2><div id="pgs"></div>
+<script>
+function table(rows, cols){
+  if(!rows || !rows.length) return '<em>none</em>';
+  cols = cols || Object.keys(rows[0]);
+  let h = '<table><tr>'+cols.map(c=>`<th>${c}</th>`).join('')+'</tr>';
+  for(const r of rows){
+    h += '<tr>'+cols.map(c=>{
+      let v = r[c];
+      if(typeof v === 'object' && v !== null) v = JSON.stringify(v);
+      if(c === 'alive' || c === 'state')
+        v = `<span class="${(v===true||v==='ALIVE'||v==='CREATED'||v==='FINISHED'||v==='SUCCEEDED')?'ok':'bad'}">${v}</span>`;
+      return `<td>${v}</td>`;
+    }).join('')+'</tr>';
+  }
+  return h+'</table>';
+}
+async function refresh(){
+  const get = async p => (await fetch(p)).json();
+  try{
+    const [cluster,nodes,actors,jobs,summary,pgs] = await Promise.all([
+      get('/api/cluster'), get('/api/nodes'), get('/api/actors'),
+      get('/api/jobs'), get('/api/summary'), get('/api/placement_groups')]);
+    document.getElementById('cluster').innerHTML = table([cluster]);
+    document.getElementById('nodes').innerHTML = table(nodes,
+      ['node_id','address','alive','resources','available','demand']);
+    document.getElementById('actors').innerHTML = table(actors,
+      ['actor_id','class_name','state','name','num_restarts']);
+    document.getElementById('jobs').innerHTML = table(jobs);
+    document.getElementById('summary').innerHTML = table(
+      Object.entries(summary).map(([name,states])=>({name, ...states})));
+    document.getElementById('pgs').innerHTML = table(pgs,
+      ['placement_group_id','name','strategy','state']);
+    document.getElementById('updated').textContent =
+      'updated '+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {str(_to_jsonable(k)): _to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(v) for v in obj]
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if hasattr(obj, "hex") and not isinstance(obj, (int, float)):
+        try:
+            return obj.hex()
+        except TypeError:
+            pass
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+class DashboardServer:
+    """Serves the dashboard for one cluster (run on or near the head)."""
+
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        from ray_tpu.util import state as state_api
+
+        self._state = state_api
+        self.gcs_address = gcs_address
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = outer._route(self.path)
+                except Exception as e:  # noqa: BLE001
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+                if body is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address
+
+    def _route(self, path: str):
+        a = self.gcs_address
+        s = self._state
+        if path in ("/", "/index.html"):
+            return _PAGE.encode(), "text/html; charset=utf-8"
+        if path == "/metrics":
+            from ray_tpu.util.metrics import prometheus_text
+
+            try:
+                return prometheus_text().encode(), "text/plain; version=0.0.4"
+            except RuntimeError:
+                return b"", "text/plain"
+        routes = {
+            "/api/nodes": lambda: s.list_nodes(address=a),
+            "/api/actors": lambda: s.list_actors(address=a),
+            "/api/tasks": lambda: s.list_tasks(address=a),
+            "/api/jobs": lambda: s.list_jobs(address=a),
+            "/api/objects": lambda: s.list_objects(address=a),
+            "/api/placement_groups": lambda: s.list_placement_groups(address=a),
+            "/api/summary": lambda: s.summarize_tasks(address=a),
+            "/api/cluster": lambda: self._cluster_overview(),
+        }
+        fn = routes.get(path.split("?", 1)[0])
+        if fn is None:
+            return None, ""
+        return (
+            json.dumps(_to_jsonable(fn())).encode(),
+            "application/json",
+        )
+
+    def _cluster_overview(self):
+        nodes = self._state.list_nodes(address=self.gcs_address)
+        alive = [n for n in nodes if n["alive"]]
+        totals: dict = {}
+        avail: dict = {}
+        for n in alive:
+            for k, v in n["resources"].items():
+                totals[k] = totals.get(k, 0) + v
+            for k, v in n["available"].items():
+                avail[k] = avail.get(k, 0) + v
+        return {
+            "gcs_address": self.gcs_address,
+            "alive_nodes": len(alive),
+            "dead_nodes": len(nodes) - len(alive),
+            "total_resources": totals,
+            "available_resources": avail,
+        }
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

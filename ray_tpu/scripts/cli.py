@@ -65,7 +65,10 @@ def cmd_start(args) -> int:
         "--node-name", "head" if args.head else "worker",
     ]
     if args.head:
-        cmd += ["--head", "--host", args.host, "--port", str(args.port)]
+        cmd += [
+            "--head", "--host", args.host, "--port", str(args.port),
+            "--dashboard-port", str(args.dashboard_port),
+        ]
     else:
         cmd += ["--address", _head_address(args.address)]
     if args.num_cpus is not None:
@@ -91,6 +94,8 @@ def cmd_start(args) -> int:
     print(f"started {role} node pid={info['pid']} gcs={info['gcs_address']}")
     if args.head:
         print(f"connect with: ray_tpu.init(address='{info['gcs_address']}')")
+        if info.get("dashboard"):
+            print(f"dashboard: http://{info['dashboard']}")
     if args.block:
         proc.wait()
     return 0
@@ -202,6 +207,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--num-cpus", type=float)
     s.add_argument("--object-store-memory", type=int)
     s.add_argument("--resources", help="extra resources, JSON")
+    s.add_argument("--dashboard-port", type=int, default=0,
+                   help="head dashboard port (0 = ephemeral, -1 = off)")
     s.add_argument("--block", action="store_true")
     s.set_defaults(fn=cmd_start)
 

@@ -28,6 +28,8 @@ def main() -> int:
     p.add_argument("--resources", default="{}", help="extra resources, JSON")
     p.add_argument("--run-dir", required=True)
     p.add_argument("--node-name", default="cli-node")
+    p.add_argument("--dashboard-port", type=int, default=8265,
+                   help="head only; -1 disables")
     args = p.parse_args()
 
     from ray_tpu._private.node import Node
@@ -44,6 +46,21 @@ def main() -> int:
         host, port = args.address.rsplit(":", 1)
         node = Node(head=False, gcs_address=(host, int(port)), **kwargs)
 
+    dashboard = None
+    dashboard_addr = None
+    if args.head and args.dashboard_port >= 0:
+        try:
+            from ray_tpu.dashboard import DashboardServer
+
+            dashboard = DashboardServer(
+                f"{node.gcs_address[0]}:{node.gcs_address[1]}",
+                host=args.host,
+                port=args.dashboard_port,
+            )
+            dashboard_addr = f"{dashboard.address[0]}:{dashboard.address[1]}"
+        except OSError:
+            pass  # port taken: node still runs, just without a dashboard
+
     os.makedirs(args.run_dir, exist_ok=True)
     info = {
         "pid": os.getpid(),
@@ -51,6 +68,7 @@ def main() -> int:
         "gcs_address": f"{node.gcs_address[0]}:{node.gcs_address[1]}",
         "session_dir": node.session_dir,
         "node_name": args.node_name,
+        "dashboard": dashboard_addr,
     }
     with open(os.path.join(args.run_dir, f"node-{os.getpid()}.json"), "w") as f:
         json.dump(info, f)
@@ -60,6 +78,8 @@ def main() -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if dashboard is not None:
+        dashboard.stop()
     node.stop()
     try:
         os.unlink(os.path.join(args.run_dir, f"node-{os.getpid()}.json"))
