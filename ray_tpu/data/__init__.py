@@ -9,6 +9,7 @@ from ray_tpu.data.block import (
     concat_blocks,
 )
 from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedDataset
+from ray_tpu.data.plan import LazyDataset, StreamingExecutor
 from ray_tpu.data.read_api import (
     from_arrow,
     from_blocks,
@@ -23,6 +24,8 @@ from ray_tpu.data.read_api import (
 
 __all__ = [
     "ActorPoolStrategy",
+    "LazyDataset",
+    "StreamingExecutor",
     "Block",
     "Dataset",
     "GroupedDataset",

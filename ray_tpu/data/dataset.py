@@ -684,6 +684,16 @@ class Dataset:
         self._fetch_metas()
         return self
 
+    def lazy(self, *, max_in_flight_blocks: int = 4):
+        """Switch to the lazy plan + streaming executor (data/plan.py):
+        transforms record logical ops, consecutive maps fuse into one task
+        per block, and consumption streams with bounded in-flight blocks."""
+        from ray_tpu.data.plan import LazyDataset
+
+        return LazyDataset(
+            self._block_refs, max_in_flight_blocks=max_in_flight_blocks
+        )
+
     # -- output -----------------------------------------------------------
 
     def write_parquet(self, path: str) -> List[str]:

@@ -1,0 +1,220 @@
+"""Lazy logical plans + the streaming executor.
+
+Reference: python/ray/data/_internal/plan.py (ExecutionPlan),
+_internal/logical/ (logical ops), _internal/execution/streaming_executor.py
+:48 (StreamingExecutor) and interfaces.py:250 (PhysicalOperator). The
+TPU-native re-design keeps the two properties that matter:
+
+- **operator fusion**: consecutive row/batch transforms compile into ONE
+  task per block (`_apply_chain_task`), not one task per op per block;
+- **streaming with backpressure**: at most ``max_in_flight_blocks`` block
+  pipelines run at once; results are consumed in order as they finish, so
+  a terabyte-scale dataset flows through bounded memory.
+
+All-to-all ops (shuffle/sort/repartition/groupby) are pipeline barriers:
+the stream materializes into a bulk `Dataset`, the eager implementation
+runs, and the plan continues lazily from its output.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data import block as B
+from ray_tpu.data.dataset import BlockMeta, Dataset, _apply_fn_to_block, _meta_of
+
+
+@dataclasses.dataclass
+class MapOp:
+    """One fusable transform stage (map_batches / map / filter / flat_map)."""
+
+    fn: Callable
+    mode: str  # "batches" | "rows"
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_kwargs: Optional[Dict[str, Any]] = None
+    name: str = "map"
+
+
+@ray_tpu.remote
+def _apply_chain_task(ops: List[MapOp], blk: B.Block):
+    """The fused physical operator: every MapOp of the chain runs on the
+    block inside one task (one scheduling round-trip per block per chain)."""
+    for op in ops:
+        blk = _apply_fn_to_block(
+            op.fn, blk, op.batch_size, op.batch_format, op.fn_kwargs or {}, op.mode
+        )
+    return blk, _meta_of(blk)
+
+
+class StreamingExecutor:
+    """Pull-based bounded execution of a fused chain over source blocks."""
+
+    def __init__(self, max_in_flight_blocks: int = 4):
+        self.max_in_flight = max(1, max_in_flight_blocks)
+
+    def execute(
+        self, source_refs: List[Any], ops: List[MapOp]
+    ) -> Iterator[Tuple[Any, Any]]:
+        """Yields (block_ref, meta_ref) in source order; at most
+        ``max_in_flight`` chains run concurrently (backpressure)."""
+        if not ops:
+            for ref in source_refs:
+                yield ref, None
+            return
+        submitted: List[Any] = []
+        next_src = 0
+        next_out = 0
+        while next_out < len(source_refs):
+            while (
+                next_src < len(source_refs)
+                and next_src - next_out < self.max_in_flight
+            ):
+                submitted.append(
+                    _apply_chain_task.options(num_returns=2).remote(
+                        ops, source_refs[next_src]
+                    )
+                )
+                next_src += 1
+            blk_ref, meta_ref = submitted[next_out]
+            # block until the head-of-line chain finishes (ordered stream)
+            ray_tpu.wait([blk_ref], num_returns=1, timeout=None)
+            yield blk_ref, meta_ref
+            next_out += 1
+
+
+class LazyDataset:
+    """A logical plan over source blocks; nothing runs until consumption.
+
+    Mirrors the reference's lazy Dataset: transforms append logical ops;
+    `materialize()` / `iter_batches()` / `take()` trigger the streaming
+    executor.
+    """
+
+    def __init__(self, source_refs: List[Any], ops: Optional[List[MapOp]] = None,
+                 max_in_flight_blocks: int = 4):
+        self._source_refs = list(source_refs)
+        self._ops: List[MapOp] = list(ops or [])
+        self._max_in_flight = max_in_flight_blocks
+
+    # -- plan building -----------------------------------------------------
+
+    def _with_op(self, op: MapOp) -> "LazyDataset":
+        return LazyDataset(
+            self._source_refs, self._ops + [op], self._max_in_flight
+        )
+
+    def map_batches(self, fn, *, batch_size=None, batch_format="numpy",
+                    fn_kwargs=None, **_ignored) -> "LazyDataset":
+        return self._with_op(MapOp(fn, "batches", batch_size, batch_format,
+                                   fn_kwargs, name="map_batches"))
+
+    def map(self, fn) -> "LazyDataset":
+        return self._with_op(MapOp(fn, "rows", fn_kwargs={"_op": "map"}, name="map"))
+
+    def filter(self, fn) -> "LazyDataset":
+        return self._with_op(
+            MapOp(fn, "rows", fn_kwargs={"_op": "filter"}, name="filter")
+        )
+
+    def flat_map(self, fn) -> "LazyDataset":
+        return self._with_op(
+            MapOp(fn, "rows", fn_kwargs={"_op": "flat_map"}, name="flat_map")
+        )
+
+    # -- barriers (all-to-all): materialize, delegate, stay lazy after ----
+
+    def _barrier(self) -> Dataset:
+        return self.materialize()
+
+    def random_shuffle(self, **kw) -> "LazyDataset":
+        return LazyDataset(
+            self._barrier().random_shuffle(**kw)._block_refs,
+            max_in_flight_blocks=self._max_in_flight,
+        )
+
+    def sort(self, key: str, descending: bool = False) -> "LazyDataset":
+        return LazyDataset(
+            self._barrier().sort(key, descending)._block_refs,
+            max_in_flight_blocks=self._max_in_flight,
+        )
+
+    def repartition(self, n: int) -> "LazyDataset":
+        return LazyDataset(
+            self._barrier().repartition(n)._block_refs,
+            max_in_flight_blocks=self._max_in_flight,
+        )
+
+    # -- execution ---------------------------------------------------------
+
+    def explain(self) -> str:
+        """The logical plan with its physical fusion."""
+        stages = " -> ".join(op.name for op in self._ops) or "(no-op)"
+        return (
+            f"LazyDataset[{len(self._source_refs)} blocks]: {stages}\n"
+            f"  physical: 1 fused task/block x {len(self._source_refs)} blocks, "
+            f"window={self._max_in_flight}"
+        )
+
+    def _stream(self) -> Iterator[Tuple[Any, Any]]:
+        return StreamingExecutor(self._max_in_flight).execute(
+            self._source_refs, self._ops
+        )
+
+    def materialize(self) -> Dataset:
+        blocks, metas = [], []
+        for blk_ref, meta_ref in self._stream():
+            blocks.append(blk_ref)
+            metas.append(meta_ref)
+        return Dataset(blocks, metas)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterator[Any]:
+        """Streamed consumption: each block's fused chain completes just
+        before its batches are yielded; memory stays bounded by the
+        in-flight window."""
+        carry: Optional[B.Block] = None
+        for blk_ref, _ in self._stream():
+            blk = ray_tpu.get(blk_ref)
+            if carry is not None and carry.num_rows:
+                blk = B.concat_blocks([carry, blk])
+                carry = None
+            n = blk.num_rows
+            if batch_size is None:
+                if n:
+                    yield B.block_to_batch(blk, batch_format)
+                continue
+            start = 0
+            while n - start >= batch_size:
+                yield B.block_to_batch(
+                    B.block_slice(blk, start, start + batch_size), batch_format
+                )
+                start += batch_size
+            if start < n:
+                carry = B.block_slice(blk, start, n)
+        if carry is not None and carry.num_rows and not drop_last:
+            yield B.block_to_batch(carry, batch_format)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for blk_ref, _ in self._stream():
+            out.extend(B.block_rows(ray_tpu.get(blk_ref)))
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def count(self) -> int:
+        total = 0
+        for blk_ref, meta_ref in self._stream():
+            if meta_ref is not None:
+                total += ray_tpu.get(meta_ref).num_rows
+            else:
+                total += ray_tpu.get(blk_ref).num_rows
+        return total
+
+    def __repr__(self) -> str:
+        return self.explain().splitlines()[0]
