@@ -64,6 +64,17 @@ def init(
             return global_worker
         logging.basicConfig(level=log_level)
         GlobalConfig.initialize(_system_config)
+        if address is not None and address.startswith("raytpu://"):
+            # Ray Client proxy mode (reference: ray.init("ray://...")):
+            # this process never joins the cluster — a ClientServer-side
+            # driver acts on its behalf (util/client/).
+            from ray_tpu.util.client import ClientCore
+
+            host, port = address[len("raytpu://"):].rsplit(":", 1)
+            core = ClientCore(host, int(port))
+            global_worker = Worker(core, "", is_driver=True, node=None)
+            atexit.register(shutdown)
+            return global_worker
         if address is None:
             node = Node(
                 head=True,

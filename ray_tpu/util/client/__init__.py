@@ -1,0 +1,96 @@
+"""Ray Client equivalent: proxy-mode drivers over a thin RPC bridge.
+
+Reference: python/ray/util/client/ (design: ARCHITECTURE.md) — a client
+process connects with ``ray_tpu.init(address="raytpu://host:port")``; all
+API calls (remote/get/put/wait/actors) are pickled to a ClientServer
+process that acts as the real driver inside the cluster. Functions and
+classes ship cloudpickled by value, results come back pickled; exceptions
+(including TaskError) propagate through the RPC error channel.
+
+The server-side driver OWNS every object the client creates; refs are
+pinned in a server-side registry until the client disconnects (or calls
+``release``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.rpc import RpcClient
+
+__all__ = ["ClientCore", "server"]
+
+
+class _GcsProxy:
+    """Mimics the ``core.gcs`` RpcClient surface used by the public API."""
+
+    def __init__(self, core: "ClientCore"):
+        self._core = core
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None):
+        return self._core._call("gcs_call", method, payload)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._core._call("gcs_address")
+
+
+class ClientCore:
+    """Drop-in for CoreWorker on the client side of the bridge (implements
+    exactly the surface ray_tpu.api uses)."""
+
+    mode = "client"
+
+    def __init__(self, host: str, port: int):
+        self._rpc = RpcClient((host, port))
+        self.gcs = _GcsProxy(self)
+        self.session_dir = ""
+        self.job_id = self._call("job_id")
+
+    # -- bridge ------------------------------------------------------------
+
+    def _call(self, method: str, *args):
+        return self._rpc.call(
+            "client_api", (method, cloudpickle.dumps(args)), timeout=None
+        )
+
+    # -- api surface -------------------------------------------------------
+
+    def submit_task(self, fn, args, kwargs, **options) -> List[ObjectID]:
+        return self._call("submit_task", fn, args, kwargs, options)
+
+    def create_actor(self, cls, args, kwargs, options) -> ActorID:
+        return self._call("create_actor", cls, args, kwargs, options)
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, *,
+                          num_returns: int = 1, ordered: bool = True):
+        return self._call(
+            "submit_actor_task", actor_id, method_name, args, kwargs,
+            num_returns, ordered,
+        )
+
+    def get(self, object_ids: Sequence[ObjectID],
+            timeout: Optional[float] = None) -> List[Any]:
+        return self._call("get", list(object_ids), timeout)
+
+    def put(self, value: Any) -> ObjectID:
+        return self._call("put", value)
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        return self._call("wait", list(refs), num_returns, timeout, fetch_local)
+
+    def kill_actor(self, actor_id, no_restart: bool = True):
+        return self._call("kill_actor", actor_id, no_restart)
+
+    def release(self, ref: ObjectID):
+        return self._call("release", ref)
+
+    def shutdown(self):
+        try:
+            self._call("disconnect")
+        except Exception:
+            pass
+        self._rpc.close()

@@ -1,0 +1,118 @@
+"""ClientServer: the in-cluster half of the Ray Client bridge.
+
+Reference: python/ray/util/client/server/ — a server process that acts as
+the driver on behalf of remote clients. One generic ``client_api`` RPC
+dispatches to the real CoreWorker; every ObjectRef a client sees is pinned
+server-side so the owner's ref-counting doesn't collect it while the
+client still holds it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.rpc import RpcServer, ServerConn
+
+logger = logging.getLogger(__name__)
+
+
+class ClientServer:
+    """Serves proxy-mode clients for one cluster. Requires an initialized
+    driver in this process (``ray_tpu.init`` first, or pass ``address`` to
+    have the server connect itself)."""
+
+    def __init__(self, address: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 10001):
+        import ray_tpu
+        import ray_tpu._private.worker as worker_mod
+
+        if not ray_tpu.is_initialized():
+            if address is None:
+                raise RuntimeError("pass address='host:port' or init first")
+            ray_tpu.init(address=address, log_level="WARNING")
+        self._core = worker_mod.global_worker.core
+        # pin every ref handed to a client: the server driver is the owner
+        # and must not release while clients hold the handle
+        self._held: Dict[bytes, Any] = {}
+        self._lock = threading.Lock()
+        self.server = RpcServer("ray-client-server", host, port)
+        self.server.register("client_api", self._client_api)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    # ------------------------------------------------------------------
+
+    def _pin(self, value: Any) -> Any:
+        if isinstance(value, ObjectID):
+            with self._lock:
+                self._held[value.binary()] = value
+        elif isinstance(value, list):
+            for v in value:
+                self._pin(v)
+        return value
+
+    def _client_api(self, conn: ServerConn, payload):
+        method, blob = payload
+        args = cloudpickle.loads(blob)
+        handler = getattr(self, f"_h_{method}", None)
+        if handler is None:
+            raise ValueError(f"unknown client method {method!r}")
+        return handler(*args)
+
+    # -- handlers ----------------------------------------------------------
+
+    def _h_job_id(self):
+        return self._core.job_id
+
+    def _h_gcs_address(self):
+        return self._core.gcs.address
+
+    def _h_gcs_call(self, method, payload):
+        return self._core.gcs.call(method, payload, timeout=60.0)
+
+    def _h_submit_task(self, fn, args, kwargs, options):
+        return self._pin(self._core.submit_task(fn, args, kwargs, **options))
+
+    def _h_create_actor(self, cls, args, kwargs, options):
+        return self._core.create_actor(cls, args, kwargs, options)
+
+    def _h_submit_actor_task(self, actor_id, method, args, kwargs,
+                             num_returns, ordered):
+        return self._pin(
+            self._core.submit_actor_task(
+                actor_id, method, args, kwargs,
+                num_returns=num_returns, ordered=ordered,
+            )
+        )
+
+    def _h_get(self, refs, timeout):
+        return self._core.get(refs, timeout=timeout)
+
+    def _h_put(self, value):
+        return self._pin(self._core.put(value))
+
+    def _h_wait(self, refs, num_returns, timeout, fetch_local):
+        return self._core.wait(refs, num_returns, timeout, fetch_local)
+
+    def _h_kill_actor(self, actor_id, no_restart):
+        return self._core.kill_actor(actor_id, no_restart)
+
+    def _h_release(self, ref):
+        with self._lock:
+            self._held.pop(ref.binary(), None)
+        return True
+
+    def _h_disconnect(self):
+        return True
+
+    def stop(self):
+        self.server.stop()
+        with self._lock:
+            self._held.clear()

@@ -1,0 +1,94 @@
+"""Ray Client proxy mode: a subprocess connects via raytpu:// and drives
+the cluster through the bridge.
+
+(reference: python/ray/util/client tests — the client process holds no
+raylet/plasma connection; everything proxies through the server driver)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+from ray_tpu.util.client.server import ClientServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIENT_SCRIPT = textwrap.dedent(
+    """
+    import json, sys
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1])
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def add(self, k):
+            self.v += k
+            return self.v
+
+    out = {}
+    out["task"] = ray_tpu.get(double.remote(21), timeout=60)
+    refs = [double.remote(i) for i in range(5)]
+    ready, rest = ray_tpu.wait(refs, num_returns=5, timeout=60)
+    out["wait"] = [len(ready), len(rest)]
+    out["gather"] = ray_tpu.get(refs, timeout=60)
+    ref = ray_tpu.put({"a": 1})
+    out["put_get"] = ray_tpu.get(ref, timeout=60)
+    c = Counter.remote()
+    out["actor"] = [ray_tpu.get(c.add.remote(5), timeout=60),
+                    ray_tpu.get(c.add.remote(7), timeout=60)]
+    out["nodes"] = len(ray_tpu.nodes())
+    try:
+        _boom.remote()  # undefined: errors locally, never reaches the bridge
+    except NameError:
+        out["err"] = "local-nameerror"
+    # a task exception must propagate through the bridge
+    @ray_tpu.remote
+    def fails():
+        raise ValueError("boom-through-bridge")
+    try:
+        ray_tpu.get(fails.remote(), timeout=60)
+        out["task_err"] = "missing"
+    except Exception as e:
+        out["task_err"] = "boom-through-bridge" in str(e)
+    print("CLIENT_RESULT " + json.dumps(out))
+    ray_tpu.shutdown()
+    """
+)
+
+
+def test_client_mode_end_to_end(ray_start_regular):
+    server = ClientServer(port=0)
+    host, port = server.address
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", CLIENT_SCRIPT,
+             f"raytpu://{host}:{port}"],
+            capture_output=True,
+            text=True,
+            timeout=180,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        line = [l for l in proc.stdout.splitlines() if l.startswith("CLIENT_RESULT")][0]
+        out = json.loads(line[len("CLIENT_RESULT "):])
+        assert out["task"] == 42
+        assert out["wait"] == [5, 0]
+        assert out["gather"] == [0, 2, 4, 6, 8]
+        assert out["put_get"] == {"a": 1}
+        assert out["actor"] == [5, 12]
+        assert out["nodes"] == 1
+        assert out["err"] == "local-nameerror"
+        assert out["task_err"] is True
+    finally:
+        server.stop()
