@@ -44,6 +44,12 @@ class _Config:
         "max_workers_per_node": 64,
         "scheduler_spread_threshold": 0.5,
         "scheduler_top_k_fraction": 0.2,
+        # --- memory monitor (reference: memory_monitor.h:52 +
+        # worker_killing_policy*.cc) ---
+        "memory_monitor_enabled": True,
+        # kill workers when node memory usage exceeds this fraction
+        "memory_usage_threshold": 0.95,
+        "memory_monitor_period_s": 1.0,
         # --- health / fault tolerance ---
         "health_check_period_s": 1.0,
         "health_check_failure_threshold": 5,
@@ -64,6 +70,9 @@ class _Config:
         # --- task events / observability ---
         "task_events_enabled": True,
         "log_to_driver": True,  # stream worker stdout/stderr to the driver
+        # opt-in distributed tracing: span context propagates through
+        # nested task submits (reference: util/tracing/tracing_helper.py)
+        "tracing_enabled": False,
         "task_events_buffer_size": 100_000,
         "metrics_report_period_s": 5.0,
         "log_dir": "",
@@ -94,6 +103,20 @@ class _Config:
                 if k not in self._DEFAULTS:
                     raise ValueError(f"Unknown config entry: {k}")
                 self._values[k] = v
+
+    def apply_cluster(self, cluster_config: Dict[str, Any]):
+        """Adopt the cluster-wide config (the head's GlobalConfig.dump()).
+        Local env overrides (RAYTPU_*) keep precedence; otherwise any
+        value the head changed from its default applies here too — this
+        is how a driver's _system_config reaches worker processes."""
+        with self._lock:
+            for k, v in cluster_config.items():
+                if k not in self._DEFAULTS:
+                    continue  # newer head, older worker: skip unknown keys
+                if k in self._values:
+                    continue  # env/local override wins
+                if v != self._DEFAULTS[k]:
+                    self._values[k] = v
 
     def get(self, name: str) -> Any:
         with self._lock:

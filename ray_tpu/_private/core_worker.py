@@ -474,7 +474,7 @@ class CoreWorker:
             "recovering lost object %s: resubmitting task %r (%d resubmits left)",
             oid.hex()[:12], spec["name"], spec["resubmits_left"],
         )
-        self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"])
+        self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"], spec.get("trace"))
         self._submit_queue.put(spec)
         return True
 
@@ -684,12 +684,13 @@ class CoreWorker:
             "scheduling_node": scheduling_node,
             "scheduling_soft": scheduling_soft,
             "runtime_env": runtime_env,
+            "trace": self._trace_ctx(task_id),
         }
         with self._pending_lock:
             self._pending[task_id] = spec
         for r in return_ids:
             self._register_ref(r)
-        self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"])
+        self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"], spec.get("trace"))
         self._submit_queue.put(spec)
         return return_ids
 
@@ -1037,7 +1038,7 @@ class CoreWorker:
                 self._lost_objects.discard(oid.binary())
         with self._pending_lock:
             self._pending.pop(task_id, None)
-        self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"])
+        self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"], spec.get("trace"))
 
     def _fail_task(self, spec: Dict[str, Any], exc: BaseException):
         task_id = spec["task_id"]
@@ -1049,7 +1050,7 @@ class CoreWorker:
             self.memory_store.put(ObjectID.for_task_return(task_id, i + 1), err)
         with self._pending_lock:
             self._pending.pop(task_id, None)
-        self._emit_event(task_id, "FAILED", spec["name"])
+        self._emit_event(task_id, "FAILED", spec["name"], spec.get("trace"))
 
     # ------------------------------------------------------------------
     # actor submission
@@ -1136,6 +1137,7 @@ class CoreWorker:
             "ordered": ordered,
             "caller_id": self.worker_id,
             "retries_left": 0,
+            "trace": self._trace_ctx(task_id),
         }
         with self._pending_lock:
             self._pending[task_id] = spec
@@ -1289,22 +1291,39 @@ class CoreWorker:
         self.gcs.call("kill_actor", (actor_id, no_restart))
 
     # ------------------------------------------------------------------
-    # task events
+    # task events + tracing
     # ------------------------------------------------------------------
 
-    def _emit_event(self, task_id: TaskID, state: str, name: str):
+    def _trace_ctx(self, task_id: TaskID) -> Optional[Dict[str, Any]]:
+        """Span context for a task submitted from the current frame
+        (reference: util/tracing/tracing_helper.py — span context rides
+        inside task metadata so nested submits form one trace). Span id ==
+        task id; the trace root is the first traced task in the chain."""
+        if not GlobalConfig.tracing_enabled:
+            return None
+        parent = getattr(self._task_ctx, "task_id", None) or self._current_task_id
+        trace_id = getattr(self._task_ctx, "trace_id", None) or task_id.hex()
+        return {
+            "trace_id": trace_id,
+            "parent_id": parent.hex() if parent is not None else None,
+        }
+
+    def _emit_event(self, task_id: TaskID, state: str, name: str,
+                    trace: Optional[Dict[str, Any]] = None):
         if not GlobalConfig.task_events_enabled:
             return
+        ev = {
+            "task_id": task_id.hex(),
+            "state": state,
+            "name": name,
+            "ts": time.time(),
+            "worker_id": self.worker_id.hex(),
+        }
+        if trace:
+            ev["trace_id"] = trace.get("trace_id")
+            ev["parent_id"] = trace.get("parent_id")
         with self._events_lock:
-            self._events.append(
-                {
-                    "task_id": task_id.hex(),
-                    "state": state,
-                    "name": name,
-                    "ts": time.time(),
-                    "worker_id": self.worker_id.hex(),
-                }
-            )
+            self._events.append(ev)
 
     def _event_loop(self):
         while not self._shutdown.wait(1.0):

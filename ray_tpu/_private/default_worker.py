@@ -41,6 +41,14 @@ def main():
         worker_id=worker_id,
         session_dir=session_dir,
     )
+    # adopt the cluster-wide config (the driver's _system_config) before
+    # any task runs; local RAYTPU_* env overrides keep precedence
+    from ray_tpu._private.config import GlobalConfig
+
+    try:
+        GlobalConfig.apply_cluster(core.gcs.call("get_config", timeout=10.0))
+    except Exception:
+        logging.getLogger(__name__).warning("could not fetch cluster config")
     server = RpcServer(f"worker-{worker_id.hex()[:8]}")
     TaskExecutor(core, server)
     core.late_register(server.address)

@@ -92,6 +92,16 @@ class Raylet:
         )
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
+        # memory monitor: kill the newest-leased worker under node memory
+        # pressure (reference: common/memory_monitor.h:52 + the
+        # retriable-FIFO worker killing policy, worker_killing_policy.cc)
+        if GlobalConfig.memory_monitor_enabled:
+            self._memmon_thread = threading.Thread(
+                target=self._memory_monitor_loop,
+                name=f"memmon-{node_name}",
+                daemon=True,
+            )
+            self._memmon_thread.start()
         # tail worker logs -> GCS "logs" pubsub -> driver stdout
         # (reference: _private/log_monitor.py:102 LogMonitor,
         # check_log_files_and_publish_updates:309)
@@ -770,6 +780,62 @@ class Raylet:
             )
             if h.proc.poll() is None:
                 h.proc.terminate()
+
+    # -- memory monitor ------------------------------------------------
+
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        """Node memory usage in [0,1] from /proc/meminfo (MemAvailable)."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.split()[0])
+            total = info["MemTotal"]
+            avail = info.get("MemAvailable", info.get("MemFree", total))
+            return 1.0 - avail / total
+        except (OSError, KeyError, ValueError):
+            return 0.0
+
+    def _memory_monitor_loop(self):
+        period = GlobalConfig.memory_monitor_period_s
+        threshold = GlobalConfig.memory_usage_threshold
+        while not self._stopped.wait(period):
+            usage = self._memory_usage_fraction()
+            if usage <= threshold:
+                continue
+            self._kill_for_memory(usage)
+
+    def _kill_for_memory(self, usage: float) -> bool:
+        """Pick a victim: the most recently leased busy worker that hosts
+        no actors (retriable work first — its owner re-submits; actors
+        would need a restart). Returns True if something was killed."""
+        with self._res_cv:
+            busy = [
+                h
+                for h in self._workers.values()
+                if not h.idle
+                and h.proc is not None
+                and h.registered.is_set()
+                and not h.actor_ids
+            ]
+            victim = max(busy, key=lambda h: h.last_idle_at, default=None)
+        if victim is None:
+            return False
+        logger.warning(
+            "memory pressure (%.0f%% > %.0f%%): killing worker %s to "
+            "reclaim memory (its task will error and may retry)",
+            usage * 100,
+            GlobalConfig.memory_usage_threshold * 100,
+            victim.worker_id.hex()[:8],
+        )
+        # hard kill: the worker is presumed wedged in allocation; the
+        # disconnect path reports the death and frees its lease
+        victim.proc.kill()
+        return True
+
+    # -- log monitor ---------------------------------------------------
 
     def _log_monitor_loop(self):
         log_dir = os.path.join(self.session_dir, "logs")

@@ -25,6 +25,17 @@ from ray_tpu._private.rpc import Deferred, RpcServer, ServerConn
 logger = logging.getLogger(__name__)
 
 
+class _NullGate:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_GATE = _NullGate()
+
+
 class _ActorState:
     """Hosts one actor instance plus its in-order execution queue.
 
@@ -158,14 +169,16 @@ class TaskExecutor:
             "ref_locations": ref_locations,
         }
 
-    def _run(self, fn, args, kwargs, task_id, name: str, loop=None):
+    def _run(self, fn, args, kwargs, task_id, name: str, loop=None, trace=None):
         import asyncio
         import inspect
 
         token_tid = getattr(self.core._task_ctx, "task_id", None)
         token_name = getattr(self.core._task_ctx, "task_name", None)
+        token_trace = getattr(self.core._task_ctx, "trace_id", None)
         self.core._task_ctx.task_id = task_id
         self.core._task_ctx.task_name = name
+        self.core._task_ctx.trace_id = (trace or {}).get("trace_id")
         try:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
@@ -182,6 +195,7 @@ class TaskExecutor:
         finally:
             self.core._task_ctx.task_id = token_tid
             self.core._task_ctx.task_name = token_name
+            self.core._task_ctx.trace_id = token_trace
 
     # ------------------------------------------------------------------
 
@@ -238,14 +252,16 @@ class TaskExecutor:
 
     def _execute_normal_task(self, spec) -> Dict[str, Any]:
         task_id = spec["task_id"]
-        self.core._emit_event(task_id, "RUNNING", spec["name"])
+        self.core._emit_event(task_id, "RUNNING", spec["name"], spec.get("trace"))
         try:
             fn = self.core.import_function(spec["fn_id"])
             args, kwargs = self._deserialize_args(spec)
         except Exception as e:  # noqa: BLE001
             value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
         else:
-            value, is_exc = self._run(fn, args, kwargs, task_id, spec["name"])
+            value, is_exc = self._run(
+                fn, args, kwargs, task_id, spec["name"], trace=spec.get("trace")
+            )
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc
         )
@@ -264,8 +280,16 @@ class TaskExecutor:
             return self._reply(
                 self._package_results(task_id, spec["num_returns"], None, False), False
             )
-        with state.sem:
-            self.core._emit_event(task_id, "RUNNING", spec["name"])
+        # control-plane methods bypass the concurrency cap so health/metrics
+        # probes can't starve behind saturated user calls (the reference's
+        # separate control concurrency group —
+        # transport/concurrency_group_manager.h:37)
+        control = spec["method"] in getattr(
+            type(state.instance), "__ray_control_methods__", ()
+        )
+        gate = state.sem if not control else _NULL_GATE
+        with gate:
+            self.core._emit_event(task_id, "RUNNING", spec["name"], spec.get("trace"))
             try:
                 method = getattr(state.instance, spec["method"])
                 args, kwargs = self._deserialize_args(spec)
@@ -280,7 +304,8 @@ class TaskExecutor:
                     else None
                 )
                 value, is_exc = self._run(
-                    method, args, kwargs, task_id, spec["name"], loop=loop
+                    method, args, kwargs, task_id, spec["name"], loop=loop,
+                    trace=spec.get("trace"),
                 )
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc

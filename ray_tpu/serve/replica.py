@@ -16,6 +16,11 @@ import ray_tpu
 
 @ray_tpu.remote(max_concurrency=8)
 class Replica:
+    # health/metrics bypass the user-request concurrency cap (the
+    # reference's control concurrency group): a saturated replica must
+    # still answer the controller's probes, or the autoscaler samples 0
+    __ray_control_methods__ = ("get_metrics", "health")
+
     def __init__(self, deployment_name: str, func_or_class, init_args, init_kwargs,
                  user_config=None):
         self._name = deployment_name

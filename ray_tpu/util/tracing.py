@@ -1,0 +1,104 @@
+"""Tracing: assemble distributed traces from span-annotated task events.
+
+Reference: python/ray/util/tracing/tracing_helper.py — opt-in OpenTelemetry
+spans wrapping every .remote() with context propagated inside task
+metadata. Here: enable with ``ray_tpu.init(_system_config=
+{"tracing_enabled": True})``; every task's span context (span id == task
+id, parent = submitting task, trace root = first traced task) rides in the
+task spec and lands in the GCS task-event stream. This module rebuilds the
+span trees and exports chrome-tracing JSON with flow arrows.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+__all__ = ["get_spans", "get_trace_tree", "export_chrome_trace"]
+
+
+def get_spans(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """One span per traced task: {span_id, trace_id, parent_id, name,
+    start, end, state}."""
+    from ray_tpu.util.state import _gcs_call
+
+    events = _gcs_call("get_task_events", address=address)
+    spans: Dict[str, Dict[str, Any]] = {}
+    for ev in sorted(events, key=lambda e: e["ts"]):
+        if ev.get("trace_id") is None:
+            continue
+        span = spans.setdefault(
+            ev["task_id"],
+            {
+                "span_id": ev["task_id"],
+                "trace_id": ev["trace_id"],
+                "parent_id": ev.get("parent_id"),
+                "name": ev["name"],
+                "start": ev["ts"],
+                "end": None,
+                "state": ev["state"],
+            },
+        )
+        if ev["state"] == "RUNNING":
+            span["start"] = ev["ts"]
+        if ev["state"] in ("FINISHED", "FAILED"):
+            span["end"] = ev["ts"]
+            span["state"] = ev["state"]
+    return list(spans.values())
+
+
+def get_trace_tree(trace_id: str, *, address: Optional[str] = None) -> Dict[str, Any]:
+    """Nested {span, children: [...]} tree for one trace."""
+    spans = [s for s in get_spans(address=address) if s["trace_id"] == trace_id]
+    by_id = {s["span_id"]: {**s, "children": []} for s in spans}
+    roots = []
+    for node in by_id.values():
+        parent = by_id.get(node["parent_id"])
+        if parent is not None:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    if len(roots) == 1:
+        return roots[0]
+    return {"span_id": trace_id, "name": "<trace>", "children": roots}
+
+
+def export_chrome_trace(filename: str, *, address: Optional[str] = None) -> int:
+    """Spans as chrome-tracing complete events + flow arrows parent→child
+    (open in ui.perfetto.dev). Returns the number of events written."""
+    spans = get_spans(address=address)
+    trace: List[Dict[str, Any]] = []
+    for s in spans:
+        end = s["end"] if s["end"] is not None else s["start"]
+        trace.append(
+            {
+                "name": s["name"],
+                "cat": "span",
+                "ph": "X",
+                "ts": s["start"] * 1e6,
+                "dur": max(0.0, (end - s["start"]) * 1e6),
+                "pid": s["trace_id"][:8],
+                "tid": s["span_id"][:8],
+                "args": {k: v for k, v in s.items() if k != "children"},
+            }
+        )
+        if s["parent_id"] and any(x["span_id"] == s["parent_id"] for x in spans):
+            flow_id = int(s["span_id"][:8], 16)
+            parent = next(x for x in spans if x["span_id"] == s["parent_id"])
+            trace.append(
+                {
+                    "name": "submit", "cat": "flow", "ph": "s",
+                    "id": flow_id, "ts": parent["start"] * 1e6,
+                    "pid": s["trace_id"][:8], "tid": s["parent_id"][:8],
+                }
+            )
+            trace.append(
+                {
+                    "name": "submit", "cat": "flow", "ph": "f", "bp": "e",
+                    "id": flow_id, "ts": s["start"] * 1e6,
+                    "pid": s["trace_id"][:8], "tid": s["span_id"][:8],
+                }
+            )
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return len(trace)

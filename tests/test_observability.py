@@ -4,6 +4,7 @@
 log_monitor.py streaming worker stdout through GCS pubsub)
 """
 
+import json
 import time
 
 import pytest
@@ -85,3 +86,53 @@ def test_worker_logs_stream_to_driver(ray_start_regular):
             return
         time.sleep(0.3)
     pytest.fail(f"worker print never reached the driver: {list(core.captured_logs)[:5]}")
+
+
+def test_tracing_nested_spans(tmp_path):
+    """Opt-in tracing: a task submitting a subtask produces parent->child
+    spans in one trace; chrome export renders."""
+    worker = ray_tpu.init(
+        num_cpus=4,
+        log_level="WARNING",
+        _system_config={"tracing_enabled": True},
+    )
+    try:
+        @ray_tpu.remote
+        def child(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def parent(x):
+            return ray_tpu.get(child.remote(x), timeout=60) * 10
+
+        assert ray_tpu.get(parent.remote(3), timeout=60) == 40
+
+        from ray_tpu.util import tracing
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            spans = tracing.get_spans()
+            by_name = {s["name"]: s for s in spans}
+            if (
+                "parent" in by_name
+                and "child" in by_name
+                and by_name["parent"]["end"] is not None
+                and by_name["child"]["end"] is not None
+            ):
+                break
+            time.sleep(0.3)
+        parent_span, child_span = by_name["parent"], by_name["child"]
+        assert child_span["trace_id"] == parent_span["trace_id"]
+        assert child_span["parent_id"] == parent_span["span_id"]
+        assert parent_span["trace_id"] == parent_span["span_id"]  # root
+
+        tree = tracing.get_trace_tree(parent_span["trace_id"])
+        assert tree["name"] == "parent"
+        assert [c["name"] for c in tree["children"]] == ["child"]
+
+        out = str(tmp_path / "spans.json")
+        n = tracing.export_chrome_trace(out)
+        assert n >= 4  # 2 spans + flow arrows
+        assert json.load(open(out))
+    finally:
+        ray_tpu.shutdown()

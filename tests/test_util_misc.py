@@ -199,3 +199,48 @@ def test_runtime_env_env_vars(ray_start_regular):
         read_env.options(runtime_env={"pip": ["numpy"]})
     with pytest.raises(ValueError):
         read_env.options(runtime_env={"env_vars": {"A": 1}})
+
+
+def test_memory_monitor_kills_busy_worker():
+    """Under (simulated) memory pressure the raylet kills the most recent
+    retriable worker; the task fails with a crash error surfaced at get,
+    and a fresh worker serves later tasks."""
+    import ray_tpu
+
+    worker = ray_tpu.init(
+        num_cpus=2,
+        log_level="WARNING",
+        _system_config={"task_max_retries_default": 0},
+    )
+    raylet = worker.node.raylet
+    try:
+        @ray_tpu.remote
+        def hog():
+            time.sleep(30)
+            return "survived"
+
+        ref = hog.remote()
+        # wait until the task is running (a busy worker exists)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with raylet._res_cv:
+                busy = [
+                    h for h in raylet._workers.values()
+                    if not h.idle and h.proc is not None and not h.actor_ids
+                ]
+            if busy:
+                break
+            time.sleep(0.1)
+        assert busy, "task never started"
+
+        assert raylet._kill_for_memory(0.99) is True
+        with pytest.raises(ray_tpu.RayTpuError):
+            ray_tpu.get(ref, timeout=60)
+
+        @ray_tpu.remote
+        def ok():
+            return 1
+
+        assert ray_tpu.get(ok.remote(), timeout=60) == 1
+    finally:
+        ray_tpu.shutdown()
