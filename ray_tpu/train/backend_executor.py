@@ -89,6 +89,10 @@ class BackendExecutor:
         group_name = f"{self.collective_group}-{time.monotonic_ns()}"
         self.group.execute("setup_collective", group_name, timeout=120.0)
         self.active_collective_group = group_name
+        if self.backend.init_jax_distributed:
+            # every rank joins the jax.distributed world NOW (before any
+            # other jax call in the worker) — the init_process_group moment
+            self.group.execute("init_jax_distributed", timeout=300.0)
 
     def start_training(
         self,

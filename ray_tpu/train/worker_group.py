@@ -56,6 +56,23 @@ class TrainWorker:
         os.environ["JAX_COORDINATOR_ADDRESS"] = address
         return True
 
+    def init_jax_distributed(self) -> bool:
+        """The dist.init_process_group moment (reference:
+        train/torch/config.py:113): join the gang's jax.distributed world
+        so device_count spans every rank. On CPU workers the collectives
+        ride gloo; on TPU hosts the coordination service uses the native
+        backend. Must run before ANY other jax call in this process."""
+        import jax
+
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )
+        return True
+
     def setup_collective(self, group_name: str) -> bool:
         """Join the gang's host collective group (the DDP-equivalent plane
         for host tensors; device tensors use in-program XLA collectives)."""

@@ -175,3 +175,37 @@ def test_checkpoint_retention(ray_start_regular, tmp_path):
     kept = sorted(p for p in os.listdir(tmp_path / "t6") if p.startswith("checkpoint"))
     assert len(kept) == 2
     assert result.checkpoint.to_dict()["step"] == 4
+
+
+def test_jax_distributed_multiprocess_bringup(ray_start_regular):
+    """JaxConfig(init_jax_distributed=True): two worker processes join one
+    jax.distributed world through the coordinator the backend wires up,
+    and a cross-process allgather sees both ranks' contributions (the
+    dist.init_process_group parity point, reference train/torch/config.py
+    :113)."""
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+    from ray_tpu.train.backend_executor import JaxConfig
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        from ray_tpu.train import session
+
+        assert jax.process_count() == 2
+        # global view spans both ranks' local devices
+        assert jax.device_count() == 2 * jax.local_device_count()
+        mine = jnp.ones((2,)) * (session.get_world_rank() + 1)
+        total = float(multihost_utils.process_allgather(mine).sum())
+        session.report({"total": total, "rank": session.get_world_rank()})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        backend_config=JaxConfig(init_jax_distributed=True),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # ranks 1 and 2 each contribute 2 elements: 2*1 + 2*2 = 6
+    assert result.metrics["total"] == 6.0
