@@ -976,11 +976,11 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     def rpc_report_metrics(self, conn, payload):
-        pid, records = payload
+        reporter, records = payload  # cluster-unique "worker_id:pid" key
         with self._lock:
             if not hasattr(self, "_metrics"):
                 self._metrics = {}
-            self._metrics[pid] = records
+            self._metrics[reporter] = records
         return True
 
     def rpc_get_metrics(self, conn, payload=None):

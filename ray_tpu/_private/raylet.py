@@ -291,57 +291,56 @@ class Raylet:
     ):
         """The parked-request wait loop; runs with _res_cv held (the caller
         registered this request in self._demand for heartbeat reporting)."""
-        if True:
-            while not self._stopped.is_set():
-                effective = self._expand_pg_request_locked(resources)
-                have_resources = effective is not None and all(
-                    self.available.get(k, 0) >= v for k, v in effective.items()
+        while not self._stopped.is_set():
+            effective = self._expand_pg_request_locked(resources)
+            have_resources = effective is not None and all(
+                self.available.get(k, 0) >= v for k, v in effective.items()
+            )
+            idle = (
+                self._pop_idle_locked(need_tpu, env_hash)
+                if have_resources
+                else None
+            )
+            if have_resources and idle is not None:
+                for k, v in effective.items():
+                    self.available[k] = self.available.get(k, 0) - v
+                idle.idle = False
+                idle.lease_resources = dict(effective)
+                if actor_id is not None:
+                    idle.actor_ids.append(actor_id)
+                return {"worker_id": idle.worker_id, "address": idle.address}
+            if have_resources and idle is None:
+                self._reap_dead_locked()
+                spawning = sum(
+                    1
+                    for h in self._workers.values()
+                    if not h.registered.is_set()
+                    and h.tpu == need_tpu
+                    and h.env_hash == env_hash
                 )
-                idle = (
-                    self._pop_idle_locked(need_tpu, env_hash)
-                    if have_resources
-                    else None
-                )
-                if have_resources and idle is not None:
-                    for k, v in effective.items():
-                        self.available[k] = self.available.get(k, 0) - v
-                    idle.idle = False
-                    idle.lease_resources = dict(effective)
-                    if actor_id is not None:
-                        idle.actor_ids.append(actor_id)
-                    return {"worker_id": idle.worker_id, "address": idle.address}
-                if have_resources and idle is None:
-                    self._reap_dead_locked()
-                    spawning = sum(
-                        1
-                        for h in self._workers.values()
-                        if not h.registered.is_set()
-                        and h.tpu == need_tpu
-                        and h.env_hash == env_hash
-                    )
-                    if (
-                        spawning == 0
-                        and len(self._workers) < GlobalConfig.max_workers_per_node
-                    ):
-                        self._res_cv.release()
-                        try:
-                            self._spawn_worker(tpu=need_tpu, env_vars=dict(env_hash))
-                        finally:
-                            self._res_cv.acquire()
-                if not have_resources and allow_spill and not spill_checked:
-                    # locally saturated: redirect to a node with free capacity
-                    spill_checked = True
+                if (
+                    spawning == 0
+                    and len(self._workers) < GlobalConfig.max_workers_per_node
+                ):
                     self._res_cv.release()
                     try:
-                        spill = self._find_spill_node(resources, against="available")
+                        self._spawn_worker(tpu=need_tpu, env_vars=dict(env_hash))
                     finally:
                         self._res_cv.acquire()
-                    if spill is not None:
-                        return {"retry_at": spill}
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return None
-                self._res_cv.wait(min(remaining, 0.5))
+            if not have_resources and allow_spill and not spill_checked:
+                # locally saturated: redirect to a node with free capacity
+                spill_checked = True
+                self._res_cv.release()
+                try:
+                    spill = self._find_spill_node(resources, against="available")
+                finally:
+                    self._res_cv.acquire()
+                if spill is not None:
+                    return {"retry_at": spill}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            self._res_cv.wait(min(remaining, 0.5))
         return None
 
     def _reap_dead_locked(self):
@@ -861,11 +860,17 @@ class Raylet:
                     cut = chunk.rfind(b"\n")
                     if cut < 0:
                         continue
-                    lines = chunk[:cut].decode(errors="replace").splitlines()
+                    raw_lines = chunk[:cut].split(b"\n")
+                    # cap the batch; the offset advances only past what is
+                    # actually published, so the remainder ships next tick
+                    # instead of being skipped
+                    batch = raw_lines[:200]
+                    published_bytes = sum(len(l) + 1 for l in batch)
+                    lines = [l.decode(errors="replace") for l in batch]
                 except OSError:
                     continue
                 if not lines:
-                    self._log_offsets[name] = offset + cut + 1
+                    self._log_offsets[name] = offset + published_bytes
                     continue
                 try:
                     self.gcs.call(
@@ -875,14 +880,14 @@ class Raylet:
                             {
                                 "worker": name[len("worker-"):-len(".log")],
                                 "node": self.labels.get("node_name", ""),
-                                "lines": lines[:200],
+                                "lines": lines,
                             },
                         ),
                         timeout=5.0,
                     )
                     # advance only after a successful publish so a GCS
                     # hiccup re-ships rather than drops the lines
-                    self._log_offsets[name] = offset + cut + 1
+                    self._log_offsets[name] = offset + published_bytes
                 except Exception:
                     pass
 

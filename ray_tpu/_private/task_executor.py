@@ -210,7 +210,16 @@ class TaskExecutor:
                 raise RuntimeError(
                     f"actor {spec['actor_id'].hex()[:8]} not hosted on this worker"
                 )
-            if spec.get("ordered", True) and state.max_concurrency == 1:
+            control = spec.get("method") in getattr(
+                type(state.instance), "__ray_control_methods__", ()
+            )
+            if control:
+                # control-plane probes jump BOTH queues: a wedged ordered
+                # actor (or saturated concurrency gate) must still answer
+                self.server._pool.submit(
+                    self._resolve_with, d, self._execute_actor_task, spec
+                )
+            elif spec.get("ordered", True) and state.max_concurrency == 1:
                 if state.thread is None:
                     state.thread = threading.Thread(
                         target=self._actor_exec_loop,

@@ -37,7 +37,13 @@ class JobStatus:
 @ray_tpu.remote
 class JobSupervisor:
     """One per job; lives on the cluster (reference: job_manager.py's
-    JobSupervisor actor). Runs the entrypoint, pumps logs to GCS KV."""
+    JobSupervisor actor). Runs the entrypoint, pumps logs to GCS KV.
+
+    ``run`` blocks for the job's whole lifetime on the actor's single
+    ordered thread, so stop/ping are control methods — they run on the
+    dispatch pool and can terminate a wedged job."""
+
+    __ray_control_methods__ = ("stop", "ping")
 
     def __init__(self, submission_id: str, entrypoint: str,
                  env_vars: Dict[str, str], gcs_address: str):
@@ -79,6 +85,8 @@ class JobSupervisor:
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=False,
+                start_new_session=True,  # own process group: stop() kills
+                # the whole tree, not just the `sh -c` wrapper
             )
         except OSError as e:
             self._set_status(JobStatus.FAILED, f"spawn failed: {e}")
@@ -102,7 +110,15 @@ class JobSupervisor:
     def stop(self) -> bool:
         self._stop.set()
         if self.proc is not None and self.proc.poll() is None:
-            self.proc.terminate()
+            import signal
+
+            try:
+                # the entrypoint runs under `sh -c`: signal the whole
+                # process group or only the shell dies and the real job
+                # keeps running
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except (OSError, ProcessLookupError):
+                self.proc.terminate()
             return True
         return False
 

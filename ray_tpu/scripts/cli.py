@@ -77,19 +77,33 @@ def cmd_start(args) -> int:
         cmd += ["--object-store-memory", str(args.object_store_memory)]
     if args.resources:
         cmd += ["--resources", args.resources]
-    proc = subprocess.Popen(
-        cmd,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        start_new_session=True,  # survive the CLI process
-    )
-    line = proc.stdout.readline().strip()
-    try:
-        info = json.loads(line)
-    except json.JSONDecodeError:
-        rest = proc.stdout.read()
-        sys.exit(f"node failed to start:\n{line}\n{rest}")
+    # child output goes to a file, never a pipe: a pipe would wedge the
+    # node once the buffer fills (nobody reads it after the CLI exits)
+    log_path = os.path.join(RUN_DIR, f"node-{int(time.time())}.out")
+    with open(log_path, "ab") as logfile:
+        proc = subprocess.Popen(
+            cmd,
+            stdout=logfile,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,  # survive the CLI process
+        )
+    info_path = os.path.join(RUN_DIR, f"node-{proc.pid}.json")
+    deadline = time.monotonic() + 60
+    info = None
+    while time.monotonic() < deadline:
+        if os.path.exists(info_path):
+            try:
+                with open(info_path) as f:
+                    info = json.load(f)
+                break
+            except (OSError, json.JSONDecodeError):
+                pass  # mid-write: retry
+        if proc.poll() is not None:
+            with open(log_path, errors="replace") as f:
+                sys.exit(f"node failed to start (rc={proc.returncode}):\n{f.read()}")
+        time.sleep(0.1)
+    if info is None:
+        sys.exit(f"node did not come up within 60s (log: {log_path})")
     role = "head" if args.head else "worker"
     print(f"started {role} node pid={info['pid']} gcs={info['gcs_address']}")
     if args.head:

@@ -36,12 +36,15 @@ class ClientServer:
                 raise RuntimeError("pass address='host:port' or init first")
             ray_tpu.init(address=address, log_level="WARNING")
         self._core = worker_mod.global_worker.core
-        # pin every ref handed to a client: the server driver is the owner
-        # and must not release while clients hold the handle
-        self._held: Dict[bytes, Any] = {}
+        # pin every ref handed to a client, PER CONNECTION: the server
+        # driver is the owner and must not release while that client holds
+        # the handle; a disconnect (graceful or crash) drops its pins
+        self._held: Dict[int, Dict[bytes, Any]] = {}
         self._lock = threading.Lock()
+        self._conn_local = threading.local()
         self.server = RpcServer("ray-client-server", host, port)
         self.server.register("client_api", self._client_api)
+        self.server.on_disconnect = self._drop_conn_pins
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -51,15 +54,23 @@ class ClientServer:
 
     def _pin(self, value: Any) -> Any:
         if isinstance(value, ObjectID):
+            conn_id = getattr(self._conn_local, "conn_id", 0)
             with self._lock:
-                self._held[value.binary()] = value
+                self._held.setdefault(conn_id, {})[value.binary()] = value
         elif isinstance(value, list):
             for v in value:
                 self._pin(v)
         return value
 
+    def _drop_conn_pins(self, conn: ServerConn):
+        with self._lock:
+            dropped = self._held.pop(id(conn), None)
+        if dropped:
+            logger.info("client disconnected: released %d pinned refs", len(dropped))
+
     def _client_api(self, conn: ServerConn, payload):
         method, blob = payload
+        self._conn_local.conn_id = id(conn)
         args = cloudpickle.loads(blob)
         handler = getattr(self, f"_h_{method}", None)
         if handler is None:
@@ -105,11 +116,15 @@ class ClientServer:
         return self._core.kill_actor(actor_id, no_restart)
 
     def _h_release(self, ref):
+        conn_id = getattr(self._conn_local, "conn_id", 0)
         with self._lock:
-            self._held.pop(ref.binary(), None)
+            self._held.get(conn_id, {}).pop(ref.binary(), None)
         return True
 
     def _h_disconnect(self):
+        conn_id = getattr(self._conn_local, "conn_id", 0)
+        with self._lock:
+            self._held.pop(conn_id, None)
         return True
 
     def stop(self):

@@ -55,6 +55,8 @@ def _report_loop():
 
 def flush():
     """Push the current snapshot now (also called by the reporter loop)."""
+    import ray_tpu._private.worker as worker_mod
+
     gcs = _gcs_client()
     if gcs is None:
         return
@@ -62,7 +64,9 @@ def flush():
         records = [m._snapshot() for m in _registry]
     records = [r for r in records if r["series"]]
     if records:
-        gcs.call("report_metrics", (os.getpid(), records), timeout=5.0)
+        # reporter key must be cluster-unique: pids collide across nodes
+        reporter = f"{worker_mod.global_worker.core.worker_id.hex()}:{os.getpid()}"
+        gcs.call("report_metrics", (reporter, records), timeout=5.0)
 
 
 class Metric:

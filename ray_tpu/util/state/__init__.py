@@ -13,6 +13,7 @@ tools) or defaults to the connected driver's GCS.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from collections import Counter, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
@@ -29,16 +30,27 @@ __all__ = [
 ]
 
 
+_client_cache: Dict[str, Any] = {}
+_client_lock = threading.Lock()
+
+
+def _cached_client(address: str):
+    """One persistent RpcClient per address: the dashboard polls these
+    endpoints every 2s and must not churn TCP connects on the head."""
+    from ray_tpu._private.rpc import RpcClient
+
+    with _client_lock:
+        client = _client_cache.get(address)
+        if client is None or client.closed:
+            host, port = address.rsplit(":", 1)
+            client = RpcClient((host, int(port)))
+            _client_cache[address] = client
+        return client
+
+
 def _gcs_call(method: str, payload=None, *, address: Optional[str] = None):
     if address is not None:
-        from ray_tpu._private.rpc import RpcClient
-
-        host, port = address.rsplit(":", 1)
-        client = RpcClient((host, int(port)))
-        try:
-            return client.call(method, payload, timeout=30.0)
-        finally:
-            client.close()
+        return _cached_client(address).call(method, payload, timeout=30.0)
     import ray_tpu._private.worker as worker_mod
 
     w = worker_mod.global_worker
@@ -105,21 +117,17 @@ def list_tasks(
 
 def list_objects(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
     """Aggregate every raylet's plasma inventory."""
-    from ray_tpu._private.rpc import RpcClient
-
     rows: List[Dict[str, Any]] = []
     for node in list_nodes(address=address):
         if not node.get("alive"):
             continue
-        client = RpcClient(tuple(node["address"]))
+        raylet_addr = "{}:{}".format(*node["address"])
         try:
-            for obj in client.call("store_list", timeout=10.0):
+            for obj in _cached_client(raylet_addr).call("store_list", timeout=10.0):
                 obj["node_id"] = node["node_id"].hex()
                 rows.append(obj)
         except Exception:
             pass  # node died mid-listing: skip it
-        finally:
-            client.close()
     return rows
 
 

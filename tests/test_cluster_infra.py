@@ -173,9 +173,29 @@ def _eventually_no_nodes(env, timeout=15):
     while time.monotonic() < deadline:
         files = [
             f for f in (os.listdir(run_dir) if os.path.isdir(run_dir) else [])
-            if f.startswith("node-")
+            if f.startswith("node-") and f.endswith(".json")
         ]
         if not files:
             return True
         time.sleep(0.3)
     return False
+
+
+def test_stop_running_job(ray_start_regular):
+    """stop_job must terminate a job whose supervisor is busy in run():
+    stop/ping are control methods that bypass the ordered queue."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; print('up', flush=True); time.sleep(120)\"",
+    )
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if client.get_job_status(sid) == JobStatus.RUNNING:
+            break
+        time.sleep(0.2)
+    assert client.get_job_status(sid) == JobStatus.RUNNING
+    assert client.stop_job(sid) is True
+    status = client.wait_until_finish(sid, timeout=60)
+    assert status == JobStatus.STOPPED
