@@ -980,15 +980,28 @@ class GcsServer:
         with self._lock:
             if not hasattr(self, "_metrics"):
                 self._metrics = {}
-            self._metrics[reporter] = records
+            self._metrics[reporter] = (time.time(), records)
         return True
+
+    def _live_metric_records(self):
+        """Snapshot of per-process metric reports, evicting reporters that
+        stopped refreshing (dead workers — like a Prometheus target dropping
+        out of a scrape, their series disappear rather than accumulate)."""
+        stale_after = 12 * GlobalConfig.metrics_report_period_s
+        now = time.time()
+        with self._lock:
+            metrics = getattr(self, "_metrics", {})
+            for reporter in [
+                r for r, (ts, _) in metrics.items() if now - ts > stale_after
+            ]:
+                del metrics[reporter]
+            return [records for _, records in metrics.values()]
 
     def rpc_get_metrics(self, conn, payload=None):
         """Aggregate across reporting processes: sum counters + histogram
         buckets, last-write-wins gauges."""
         name_filter = payload
-        with self._lock:
-            per_proc = list(getattr(self, "_metrics", {}).values())
+        per_proc = self._live_metric_records()
         merged: Dict[str, Dict[str, Any]] = {}
         for records in per_proc:
             for rec in records:

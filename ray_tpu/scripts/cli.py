@@ -79,15 +79,24 @@ def cmd_start(args) -> int:
         cmd += ["--resources", args.resources]
     # child output goes to a file, never a pipe: a pipe would wedge the
     # node once the buffer fills (nobody reads it after the CLI exits)
-    log_path = os.path.join(RUN_DIR, f"node-{int(time.time())}.out")
-    with open(log_path, "ab") as logfile:
+    tmp_log = os.path.join(RUN_DIR, f"node-start-{os.getpid()}.out")
+    with open(tmp_log, "ab") as logfile:
         proc = subprocess.Popen(
             cmd,
             stdout=logfile,
             stderr=subprocess.STDOUT,
             start_new_session=True,  # survive the CLI process
         )
+    # key the log by the child pid (unique, matches the .json convention)
+    log_path = os.path.join(RUN_DIR, f"node-{proc.pid}.out")
+    os.replace(tmp_log, log_path)
     info_path = os.path.join(RUN_DIR, f"node-{proc.pid}.json")
+    # a SIGKILLed predecessor never unlinks its info file; with pid reuse
+    # the wait loop below would read its stale contents
+    try:
+        os.unlink(info_path)
+    except FileNotFoundError:
+        pass
     deadline = time.monotonic() + 60
     info = None
     while time.monotonic() < deadline:

@@ -10,10 +10,15 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
+    PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
     choice,
     generate_variants,
     grid_search,
@@ -40,8 +45,13 @@ __all__ = [
     "ASHAScheduler",
     "AsyncHyperBandScheduler",
     "Checkpoint",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
     "FIFOScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Searcher",
     "ResultGrid",
     "Trial",
     "TrialScheduler",

@@ -1,17 +1,24 @@
-"""Trial schedulers: FIFO, ASHA (async successive halving), median stopping.
+"""Trial schedulers: FIFO, ASHA, median stopping, HyperBand, PBT.
 
 Reference: python/ray/tune/schedulers/async_hyperband.py (ASHA rungs and
-cutoff quantile), trial_scheduler.py (decision protocol), median_stopping_rule.py.
-Decisions are made per reported result; STOP kills the trial actor early.
+cutoff quantile), trial_scheduler.py (decision protocol),
+median_stopping_rule.py, hyperband.py (synchronous brackets with
+pause/resume), pbt.py:49 (_explore: perturb-or-resample mutations).
+Decisions are made per reported result; STOP kills the trial actor early,
+PAUSE checkpoints + parks it for a later resume decision, EXPLOIT (PBT)
+restarts it from a fitter trial's checkpoint with a mutated config.
 """
 
 from __future__ import annotations
 
 import collections
-from typing import Any, Dict, List, Optional
+import random
+from typing import Any, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+PAUSE = "PAUSE"
+EXPLOIT = "EXPLOIT"
 
 
 class TrialScheduler:
@@ -21,11 +28,23 @@ class TrialScheduler:
         if getattr(self, "mode", None) is None:
             self.mode = mode or "max"
 
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        pass
+
     def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
         return CONTINUE
 
     def on_trial_complete(self, trial_id: str):
         pass
+
+    def trials_to_resume(self) -> List[str]:
+        """Paused trials the tuner should relaunch now (from their own
+        latest checkpoint)."""
+        return []
+
+    def trials_to_stop(self) -> List[str]:
+        """Paused trials the tuner should terminate without resuming."""
+        return []
 
 
 class FIFOScheduler(TrialScheduler):
@@ -123,3 +142,227 @@ class MedianStoppingRule(TrialScheduler):
             return CONTINUE
         median = others[len(others) // 2]
         return STOP if means[trial_id] < median else CONTINUE
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous successive-halving brackets with pause/resume.
+
+    Reference: tune/schedulers/hyperband.py — trials are grouped into
+    brackets; every trial in a bracket runs to the current milestone and
+    is PAUSEd there; once all live bracket members have reported at the
+    milestone, the top 1/eta are resumed with an eta-times-larger budget
+    and the rest are terminated. Unlike ASHA (async quantile cutoffs) the
+    halving decision is synchronous, so no trial is stopped on a cutoff
+    computed from a partial population.
+    """
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        max_t: int = 81,
+        reduction_factor: float = 3,
+        bracket_size: Optional[int] = None,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.bracket_size = bracket_size
+        self.time_attr = time_attr
+        self._brackets: List[Dict[str, Any]] = []
+        self._trial_bracket: Dict[str, Dict[str, Any]] = {}
+        self._resume: List[str] = []
+        self._stop: List[str] = []
+
+    def _new_bracket(self) -> Dict[str, Any]:
+        b = {
+            "milestone": max(1, int(self.max_t / (self.eta ** 2))),
+            "live": set(),        # trials not yet halved away
+            "paused": set(),      # live trials parked at the milestone
+            "scores": {},         # trial_id -> score at current milestone
+        }
+        self._brackets.append(b)
+        return b
+
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        size = self.bracket_size
+        b = self._brackets[-1] if self._brackets else None
+        if b is None or (size and len(b["live"]) >= size):
+            b = self._new_bracket()
+        b["live"].add(trial_id)
+        self._trial_bracket[trial_id] = b
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric is None or self.metric not in result:
+            return CONTINUE
+        b = self._trial_bracket.get(trial_id)
+        if b is None:
+            return CONTINUE
+        t = result.get(self.time_attr) or 0
+        if t >= self.max_t:
+            return STOP
+        if t < b["milestone"] or trial_id in b["paused"]:
+            return CONTINUE
+        sign = 1.0 if (self.mode or "max") == "max" else -1.0
+        b["paused"].add(trial_id)
+        b["scores"][trial_id] = sign * float(result[self.metric])
+        self._maybe_halve(b)
+        return PAUSE
+
+    def on_trial_complete(self, trial_id: str):
+        b = self._trial_bracket.pop(trial_id, None)
+        if b is None:
+            return
+        b["live"].discard(trial_id)
+        b["paused"].discard(trial_id)
+        b["scores"].pop(trial_id, None)
+        self._maybe_halve(b)
+
+    def _maybe_halve(self, b: Dict[str, Any]):
+        if not b["live"] or b["paused"] != b["live"]:
+            return  # someone is still running toward the milestone
+        ranked = sorted(b["scores"], key=b["scores"].get, reverse=True)
+        keep = max(1, int(len(ranked) / self.eta))
+        promoted, dropped = ranked[:keep], ranked[keep:]
+        b["milestone"] = min(self.max_t, int(b["milestone"] * self.eta))
+        b["live"] = set(promoted)
+        b["paused"] = set()
+        b["scores"] = {}
+        self._resume.extend(promoted)
+        self._stop.extend(dropped)
+        for tid in dropped:
+            self._trial_bracket.pop(tid, None)
+
+    def trials_to_resume(self) -> List[str]:
+        out, self._resume = self._resume, []
+        return out
+
+    def trials_to_stop(self) -> List[str]:
+        out, self._stop = self._stop, []
+        return out
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT: bottom-quantile trials clone a top-quantile trial's checkpoint
+    (exploit) and mutate its config (explore).
+
+    Reference: tune/schedulers/pbt.py — ``_explore`` (:49) multiplies
+    continuous values by 1.2/0.8 (or resamples with ``resample_probability``)
+    and steps categorical values to a neighboring choice; exploitation picks
+    a random member of the top quantile. Decisions fire every
+    ``perturbation_interval`` units of ``time_attr``.
+    """
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        perturbation_factors: Tuple[float, float] = (1.2, 0.8),
+        time_attr: str = "training_iteration",
+        seed: Optional[int] = None,
+    ):
+        if not 0.0 < quantile_fraction <= 0.5:
+            raise ValueError("quantile_fraction must be in (0, 0.5]")
+        self.metric = metric
+        self.mode = mode
+        self.perturbation_interval = perturbation_interval
+        self.hyperparam_mutations = hyperparam_mutations or {}
+        self.quantile_fraction = quantile_fraction
+        self.resample_probability = resample_probability
+        self.perturbation_factors = perturbation_factors
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, float] = {}
+        self._pending: Dict[str, Tuple[Dict[str, Any], str]] = {}
+        self.num_perturbations = 0
+
+    def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
+        self._configs[trial_id] = dict(config)
+
+    def on_trial_complete(self, trial_id: str):
+        self._scores.pop(trial_id, None)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        if self.metric is None or self.metric not in result:
+            return CONTINUE
+        t = result.get(self.time_attr) or 0
+        sign = 1.0 if (self.mode or "max") == "max" else -1.0
+        self._scores[trial_id] = sign * float(result[self.metric])
+        if t - self._last_perturb.get(trial_id, 0) < self.perturbation_interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        ranked = sorted(self._scores, key=self._scores.get)
+        n = len(ranked)
+        k = max(1, int(n * self.quantile_fraction))
+        if n < 2 or 2 * k > n:
+            return CONTINUE
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial_id not in bottom:
+            return CONTINUE
+        donor = self._rng.choice(top)
+        new_cfg = self._explore(self._configs.get(donor, {}))
+        self._pending[trial_id] = (new_cfg, donor)
+        return EXPLOIT
+
+    def get_exploit(self, trial_id: str) -> Tuple[Dict[str, Any], str]:
+        """(mutated config, donor trial id) for a trial that got EXPLOIT.
+
+        Does not commit: the tuner may still skip the exploit (donor has no
+        checkpoint yet) — it calls :meth:`commit_exploit` once the relaunch
+        actually happened, so ``_configs`` only ever reflects configs that
+        trials really run."""
+        return self._pending.pop(trial_id)
+
+    def commit_exploit(self, trial_id: str, new_cfg: Dict[str, Any]):
+        self._configs[trial_id] = dict(new_cfg)
+        self.num_perturbations += 1
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain, SampleFrom
+
+        new = dict(config)
+        for key, spec in self.hyperparam_mutations.items():
+            resample = (
+                self._rng.random() < self.resample_probability or key not in new
+            )
+            if callable(spec) and not isinstance(spec, Domain):
+                if resample:
+                    new[key] = spec()
+                continue
+            if isinstance(spec, SampleFrom):
+                # SampleFrom.sample() returns self; resolve against the
+                # partially-mutated config like generate_variants does
+                if resample:
+                    new[key] = spec.fn(new)
+                continue
+            if isinstance(spec, Domain):
+                if resample:
+                    new[key] = spec.sample(self._rng)
+                elif isinstance(new.get(key), (int, float)):
+                    new[key] = self._perturb_scalar(new[key])
+                continue
+            if isinstance(spec, (list, tuple)):
+                choices = list(spec)
+                if resample or new.get(key) not in choices:
+                    new[key] = self._rng.choice(choices)
+                else:  # step to a neighboring choice, as the reference does
+                    i = choices.index(new[key])
+                    j = max(0, min(len(choices) - 1, i + self._rng.choice((-1, 1))))
+                    new[key] = choices[j]
+                continue
+            if isinstance(new.get(key), (int, float)):
+                new[key] = self._perturb_scalar(new[key])
+        return new
+
+    def _perturb_scalar(self, value):
+        factor = self._rng.choice(self.perturbation_factors)
+        out = value * factor
+        return int(round(out)) if isinstance(value, int) else out

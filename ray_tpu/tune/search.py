@@ -136,3 +136,77 @@ def generate_variants(
                     _set_path(cfg, p, v)
             configs.append(cfg)
     return configs
+
+
+class Searcher:
+    """Suggest-based search algorithm interface.
+
+    Reference: tune/search/searcher.py — ``suggest(trial_id)`` proposes a
+    config (or None when exhausted), ``on_trial_complete`` feeds the final
+    result back so model-based searchers can update their posterior.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str]):
+        if self.metric is None:
+            self.metric = metric
+        if mode:
+            self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(
+        self, trial_id: str, result: Optional[Dict[str, Any]] = None
+    ):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid/random sweep as a Searcher (reference: search/basic_variant.py)."""
+
+    def __init__(
+        self,
+        param_space: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1,
+        seed: Optional[int] = None,
+    ):
+        super().__init__()
+        self._variants = generate_variants(param_space or {}, num_samples, seed)
+        self._next = 0
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """Cap in-flight suggestions (reference: search/concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode):
+        super().set_search_properties(metric, mode)
+        self.searcher.set_search_properties(metric, mode)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)

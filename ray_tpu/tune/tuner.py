@@ -30,7 +30,9 @@ from ray_tpu.tune import search as search_mod
 
 logger = logging.getLogger(__name__)
 
-PENDING, RUNNING, TERMINATED, ERROR = "PENDING", "RUNNING", "TERMINATED", "ERROR"
+PENDING, RUNNING, PAUSED, TERMINATED, ERROR = (
+    "PENDING", "RUNNING", "PAUSED", "TERMINATED", "ERROR",
+)
 
 
 @dataclasses.dataclass
@@ -40,6 +42,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[sched_mod.TrialScheduler] = None
+    search_alg: Optional[search_mod.Searcher] = None
     trial_resources: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
 
@@ -212,12 +215,26 @@ class Tuner:
         cfgs = self.tune_config
         scheduler = cfgs.scheduler or sched_mod.FIFOScheduler()
         scheduler.set_metric(cfgs.metric, cfgs.mode)
+        searcher = cfgs.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(cfgs.metric, cfgs.mode)
         fn = self._resolve_trainable()
         exp_dir = self.experiment_dir
         exp_name = self.run_config.name or os.path.basename(exp_dir)
 
+        trials: List[Trial]
         if self._restored_trials is not None:
             trials = self._restored_trials
+            # the searcher's state is not persisted with the experiment;
+            # re-suggesting would duplicate every restored trial
+            if searcher is not None:
+                logger.warning(
+                    "Tuner.restore ignores search_alg: restored trials "
+                    "already cover the suggested configs"
+                )
+                searcher = None
+        elif searcher is not None:
+            trials = []  # suggested lazily inside the loop
         else:
             variants = search_mod.generate_variants(
                 self.param_space, cfgs.num_samples, seed=cfgs.seed
@@ -229,46 +246,116 @@ class Tuner:
         for t in trials:
             t.path = t.path or os.path.join(exp_dir, t.trial_id)
 
-        limit = cfgs.max_concurrent_trials or len(trials)
+        # default concurrency mirrors the non-searcher path (all trials at
+        # once, resource-bounded by the cluster scheduler). The searcher path
+        # needs a finite cap regardless: a model-based Searcher may suggest
+        # forever, and the suggestion top-up loop is bounded by this limit.
+        limit = cfgs.max_concurrent_trials or (
+            len(trials) if trials else max(cfgs.num_samples, 8)
+        )
         actors: Dict[str, Any] = {}
         run_refs: Dict[str, Any] = {}
-        seen: Dict[str, int] = {}
+        seen: Dict[str, int] = {}      # per-session report index (poll cursor)
+        iters: Dict[str, int] = {}     # lifetime iteration count (survives relaunch)
         ckpt_mgrs: Dict[str, CheckpointManager] = {}
         pending = [t for t in trials if t.status == PENDING]
         running: List[Trial] = []
-        by_id = {t.trial_id: t for t in trials}
+        paused: Dict[str, Trial] = {}
+        for t in trials:
+            scheduler.on_trial_add(t.trial_id, t.config)
 
-        def _launch(trial: Trial):
+        def _suggest_trial() -> Optional[Trial]:
+            tid = f"{exp_name}_{len(trials):05d}_{uuid.uuid4().hex[:6]}"
+            cfg = searcher.suggest(tid)
+            if cfg is None:
+                return None
+            trial = Trial(trial_id=tid, config=cfg)
+            trial.path = os.path.join(exp_dir, trial.trial_id)
+            trials.append(trial)
+            scheduler.on_trial_add(tid, cfg)
+            return trial
+
+        def _launch(trial: Trial, resume_ckpt: Optional[Checkpoint] = None):
             opts = dict(self.tune_config.trial_resources or {"num_cpus": 1})
             actor = _TrialActor.options(**opts).remote()
             actors[trial.trial_id] = actor
             run_refs[trial.trial_id] = actor.run.remote(
-                fn, trial.config, trial.trial_id, trial.path, exp_name, None
+                fn, trial.config, trial.trial_id, trial.path, exp_name, resume_ckpt
             )
             seen[trial.trial_id] = 0
-            ckpt_mgrs[trial.trial_id] = CheckpointManager(
-                trial.path, self.run_config.checkpoint_config or CheckpointConfig()
-            )
+            iters.setdefault(trial.trial_id, 0)
+            if trial.trial_id not in ckpt_mgrs:
+                ckpt_mgrs[trial.trial_id] = CheckpointManager(
+                    trial.path,
+                    self.run_config.checkpoint_config or CheckpointConfig(),
+                )
             trial.status = RUNNING
             running.append(trial)
 
-        def _finalize(trial: Trial, error: Optional[str], early: bool = False):
-            trial.status = ERROR if error else TERMINATED
-            trial.error = error
-            trial.early_stopped = early
-            running.remove(trial)
-            actor = actors.pop(trial.trial_id, None)
-            run_refs.pop(trial.trial_id, None)
+        def _kill_actor(trial_id: str):
+            actor = actors.pop(trial_id, None)
+            run_refs.pop(trial_id, None)
             if actor is not None:
                 try:
                     ray_tpu.kill(actor)
                 except Exception:
                     pass
+
+        def _finalize(trial: Trial, error: Optional[str], early: bool = False):
+            trial.status = ERROR if error else TERMINATED
+            trial.error = error
+            trial.early_stopped = early
+            if trial in running:
+                running.remove(trial)
+            paused.pop(trial.trial_id, None)
+            _kill_actor(trial.trial_id)
             scheduler.on_trial_complete(trial.trial_id)
+            if searcher is not None:
+                searcher.on_trial_complete(trial.trial_id, trial.last_result)
             self._save_state(trials)
 
-        def _drain_reports(trial: Trial) -> Optional[str]:
-            """Pull new reports; returns STOP if the scheduler says so."""
+        def _pause(trial: Trial):
+            _kill_actor(trial.trial_id)
+            running.remove(trial)
+            trial.status = PAUSED
+            paused[trial.trial_id] = trial
+
+        def _exploit(trial: Trial):
+            """PBT: restart from a fitter trial's checkpoint, mutated config."""
+            new_cfg, donor_id = scheduler.get_exploit(trial.trial_id)
+            donor_ckpt = None
+            if donor_id in ckpt_mgrs:
+                donor_ckpt = ckpt_mgrs[donor_id].latest
+            if donor_ckpt is None:
+                donor_ckpt = _latest_checkpoint_on_disk(
+                    os.path.join(exp_dir, donor_id)
+                )
+            if donor_ckpt is None:
+                logger.info(
+                    "PBT exploit skipped: donor %s has no checkpoint", donor_id
+                )
+                return
+            logger.info(
+                "PBT: trial %s exploits %s with config %s",
+                trial.trial_id, donor_id, new_cfg,
+            )
+            _kill_actor(trial.trial_id)
+            running.remove(trial)
+            trial.config = new_cfg
+            _launch(trial, resume_ckpt=donor_ckpt)
+            commit = getattr(scheduler, "commit_exploit", None)
+            if commit is not None:
+                commit(trial.trial_id, new_cfg)
+
+        def _drain_reports(trial: Trial, act: bool = True) -> Optional[str]:
+            """Pull new reports; returns the first decisive scheduler verdict.
+
+            With ``act=True`` draining stops at the first decisive verdict:
+            reports the trainable produced after a PAUSE/STOP point are
+            discarded (not registered, not checkpointed), so a paused trial
+            resumes from the milestone itself — overshoot work past the
+            decision is thrown away, as in the reference's pause semantics.
+            """
             actor = actors[trial.trial_id]
             try:
                 reports = ray_tpu.get(
@@ -276,41 +363,100 @@ class Tuner:
                 )
             except Exception:
                 return None
-            decision = None
             for entry in reports:
                 seen[trial.trial_id] += 1
+                iters[trial.trial_id] += 1
                 metrics = dict(entry["metrics"])
-                metrics.setdefault("training_iteration", seen[trial.trial_id])
+                metrics.setdefault("training_iteration", iters[trial.trial_id])
                 metrics["trial_id"] = trial.trial_id
                 trial.metrics_history.append(metrics)
                 trial.last_result = metrics
                 if "checkpoint" in entry:
                     ckpt_mgrs[trial.trial_id].register(entry["checkpoint"], metrics)
+                if not act:
+                    # post-completion drain: record metrics/checkpoints only —
+                    # feeding on_result here would mutate pause/exploit state
+                    # for a trial that is about to be finalized
+                    continue
                 d = scheduler.on_result(trial.trial_id, metrics)
-                if d == sched_mod.STOP:
-                    decision = sched_mod.STOP
-            return decision
+                if d != sched_mod.CONTINUE:
+                    return d
+            return None
 
-        while pending or running:
+        resume_queue: List[str] = []
+
+        def _resume(trial: Trial):
+            ckpt = ckpt_mgrs[trial.trial_id].latest
+            if ckpt is None:
+                logger.warning(
+                    "resuming paused trial %s without a checkpoint: the "
+                    "trainable restarts from scratch (report checkpoints so "
+                    "pause/resume schedulers can restore progress)",
+                    trial.trial_id,
+                )
+            _launch(trial, resume_ckpt=ckpt)
+
+        def _drain_scheduler():
+            """Collect pause-scheduler verdicts; resume within capacity."""
+            for tid in scheduler.trials_to_stop():
+                if tid in paused:
+                    _finalize(paused[tid], None, early=True)
+            resume_queue.extend(scheduler.trials_to_resume())
+            while resume_queue and len(running) < limit:
+                tid = resume_queue.pop(0)
+                if tid in paused:
+                    _resume(paused.pop(tid))
+
+        search_done = searcher is None
+        while pending or running or paused or not search_done:
+            # top up from the search algorithm (lazy suggestion)
+            while not search_done and len(running) + len(pending) < limit:
+                t = _suggest_trial()
+                if t is None:
+                    if not running and not pending and not paused:
+                        search_done = True  # exhausted: nothing can free capacity
+                    break
+                pending.append(t)
             while pending and len(running) < limit:
                 _launch(pending.pop(0))
+            if not running and not pending:
+                if paused:
+                    _drain_scheduler()
+                    if paused and not running and not resume_queue:
+                        logger.warning(
+                            "resuming %d paused trials without a scheduler "
+                            "decision (anti-deadlock)", len(paused),
+                        )
+                        for tid in list(paused):
+                            _resume(paused.pop(tid))
+                    continue
+                if search_done:
+                    break
+                time.sleep(0.05)
+                continue
             refs = [run_refs[t.trial_id] for t in running]
             done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.25)
             done_set = set(done)
             for trial in list(running):
                 decision = _drain_reports(trial)
                 ref = run_refs.get(trial.trial_id)
-                if ref in done_set:
+                if ref in done_set and decision is None:
                     err = None
                     try:
                         ray_tpu.get(ref)
-                        _drain_reports(trial)  # reports landed after last poll
+                        # reports landed after the last poll; decisions moot
+                        _drain_reports(trial, act=False)
                     except Exception as e:  # noqa: BLE001
                         err = f"{type(e).__name__}: {e}"
                     _finalize(trial, err)
                 elif decision == sched_mod.STOP:
-                    logger.info("ASHA stopping trial %s early", trial.trial_id)
+                    logger.info("scheduler stopping trial %s early", trial.trial_id)
                     _finalize(trial, None, early=True)
+                elif decision == sched_mod.PAUSE:
+                    _pause(trial)
+                elif decision == sched_mod.EXPLOIT:
+                    _exploit(trial)
+            _drain_scheduler()
 
         self._save_state(trials)
 

@@ -31,19 +31,32 @@ __all__ = [
 
 
 _client_cache: Dict[str, Any] = {}
+_client_locks: Dict[str, threading.Lock] = {}
 _client_lock = threading.Lock()
 
 
 def _cached_client(address: str):
     """One persistent RpcClient per address: the dashboard polls these
-    endpoints every 2s and must not churn TCP connects on the head."""
+    endpoints every 2s and must not churn TCP connects on the head.
+
+    The connect happens under a per-address lock — RpcClient's constructor
+    blocks retrying TCP for up to the connect timeout, and one dead node
+    must not stall state queries against every other node."""
     from ray_tpu._private.rpc import RpcClient
 
     with _client_lock:
         client = _client_cache.get(address)
-        if client is None or client.closed:
-            host, port = address.rsplit(":", 1)
-            client = RpcClient((host, int(port)))
+        if client is not None and not client.closed:
+            return client
+        addr_lock = _client_locks.setdefault(address, threading.Lock())
+    with addr_lock:
+        with _client_lock:
+            client = _client_cache.get(address)
+            if client is not None and not client.closed:
+                return client
+        host, port = address.rsplit(":", 1)
+        client = RpcClient((host, int(port)))
+        with _client_lock:
             _client_cache[address] = client
         return client
 

@@ -172,3 +172,113 @@ def test_jax_trainer_sweep(ray_start_regular, tmp_path):
     assert not grid.errors
     best = grid.get_best_result()
     assert best.metrics["loss"] == pytest.approx(0.3)  # lr=0.1 * 3 steps
+
+
+def _resumable_objective(total_iters, delay=0.01):
+    """Trainable that checkpoints every step and resumes from ckpt."""
+
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for step in range(start, total_iters):
+            tune.report(
+                {
+                    "score": config["lr"] * (step + 1),
+                    "training_iteration": step + 1,
+                },
+                checkpoint=tune.Checkpoint.from_dict({"step": step + 1}),
+            )
+            time.sleep(delay)
+
+    return objective
+
+
+def test_pbt_exploits_bottom_quantile(ray_start_regular, tmp_path):
+    """The worst trial must clone a top trial's checkpoint + mutated config.
+
+    The reported score is a pure function of the config (not the step) so
+    the quantile ranking is immune to wall-clock skew between trials."""
+
+    def objective(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for step in range(start, 12):
+            tune.report(
+                {"score": config["lr"], "training_iteration": step + 1},
+                checkpoint=tune.Checkpoint.from_dict({"step": step + 1}),
+            )
+            time.sleep(0.1)
+
+    pbt = tune.PopulationBasedTraining(
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.05, 0.1, 0.9, 1.0]},
+        quantile_fraction=0.25,
+        resample_probability=0.25,
+        seed=0,
+    )
+    grid = Tuner(
+        objective,
+        param_space={"lr": tune.grid_search([0.05, 0.1, 0.9, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt),
+        run_config=ray_tpu.train.RunConfig(name="pbt", storage_path=str(tmp_path)),
+    ).fit()
+    assert not grid.errors
+    assert pbt.num_perturbations >= 1, "PBT never exploited anything"
+    # the lr=1.0 trial is top-quantile throughout, so it is never exploited
+    best = grid.get_best_result()
+    assert best.metrics["score"] == pytest.approx(1.0)
+    # at least one trial's live config differs from the grid value it was
+    # created with (exploit replaced it with a mutated donor config)
+    original = [0.05, 0.1, 0.9, 1.0]  # grid order == trial creation order
+    ordered = sorted(grid.trials, key=lambda t: t.trial_id)
+    changed = [
+        t for t, lr0 in zip(ordered, original) if t.config["lr"] != lr0
+    ]
+    assert changed, "no trial's config was replaced by exploit"
+
+
+def test_hyperband_synchronous_halving(ray_start_regular, tmp_path):
+    """All trials pause at the milestone; top 1/eta resume, rest stop."""
+    hb = tune.HyperBandScheduler(max_t=12, reduction_factor=2, bracket_size=4)
+    grid = Tuner(
+        _resumable_objective(12, delay=0.1),
+        param_space={"lr": tune.grid_search([0.1, 0.2, 0.9, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=hb),
+        run_config=ray_tpu.train.RunConfig(name="hb", storage_path=str(tmp_path)),
+    ).fit()
+    assert not grid.errors
+    by_lr = {t.config["lr"]: t for t in grid.trials}
+    # the two worst trials were halved away at the first milestone (t=3);
+    # pausing is async so they may overshoot it by a few reports, but they
+    # must not run to completion
+    for lr in (0.1, 0.2):
+        t = by_lr[lr]
+        assert t.early_stopped
+        assert t.last_result["training_iteration"] < 12
+    # the best trial survived every rung and ran to max_t
+    assert by_lr[1.0].last_result["training_iteration"] >= 10
+    best = grid.get_best_result()
+    assert best.metrics["score"] == pytest.approx(12.0)
+
+
+def test_searcher_basic_variant_and_limiter(ray_start_regular, tmp_path):
+    def objective(config):
+        tune.report({"score": -((config["x"] - 2.0) ** 2)})
+
+    searcher = tune.ConcurrencyLimiter(
+        tune.BasicVariantGenerator(
+            {"x": tune.grid_search([0.0, 1.0, 2.0, 3.0])}
+        ),
+        max_concurrent=2,
+    )
+    grid = Tuner(
+        objective,
+        tune_config=TuneConfig(
+            metric="score", mode="max", search_alg=searcher,
+            max_concurrent_trials=2,
+        ),
+        run_config=ray_tpu.train.RunConfig(name="sa", storage_path=str(tmp_path)),
+    ).fit()
+    assert len(grid) == 4
+    assert not grid.errors
+    assert grid.get_best_result().metrics["score"] == 0.0
