@@ -1,30 +1,46 @@
-"""ray_tpu.rl: reinforcement learning — RLModule/Learner/rollouts/PPO.
+"""ray_tpu.rl: reinforcement learning — RLModule/Learner/rollouts + PPO,
+DQN (prioritized replay, double-Q), IMPALA (V-trace, async pipeline).
 
 Reference surface: rllib new API stack (core/rl_module, core/learner,
-evaluation/rollout_worker, algorithms/ppo). Rollouts run on CPU actors;
-learning is a jitted functional step that data-parallelizes over a device
-mesh or across learner actors via the host collective layer.
+evaluation/rollout_worker, algorithms/{ppo,dqn,impala},
+utils/replay_buffers). Rollouts run on CPU actors; learning is a jitted
+functional step that data-parallelizes over a device mesh or across
+learner actors via the host collective layer.
 """
 
 from ray_tpu.rl.algorithm import PPO, PPOConfig
+from ray_tpu.rl.dqn import DQN, DQNConfig, DQNLearner, DQNRolloutWorker, QNetwork
 from ray_tpu.rl.env import CartPole, VectorEnv, make_env
+from ray_tpu.rl.impala import Impala, ImpalaConfig, ImpalaLearner, vtrace
 from ray_tpu.rl.learner import LearnerGroup, PPOLearner, PPOLossConfig
+from ray_tpu.rl.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rl.rl_module import DiscretePolicyModule, RLModule
 from ray_tpu.rl.rollout_worker import RolloutWorker
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
     "CartPole",
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
+    "DQNRolloutWorker",
     "DiscretePolicyModule",
+    "Impala",
+    "ImpalaConfig",
+    "ImpalaLearner",
     "LearnerGroup",
     "PPO",
     "PPOConfig",
     "PPOLearner",
     "PPOLossConfig",
+    "PrioritizedReplayBuffer",
+    "QNetwork",
     "RLModule",
+    "ReplayBuffer",
     "RolloutWorker",
     "SampleBatch",
     "VectorEnv",
     "compute_gae",
     "make_env",
+    "vtrace",
 ]

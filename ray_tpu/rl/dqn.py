@@ -1,0 +1,286 @@
+"""DQN: off-policy Q-learning over a (prioritized) replay buffer.
+
+Reference: rllib/algorithms/dqn/dqn.py (training_step: sample rollouts →
+store → replay → train → target-net sync) with double-Q targets
+(dqn_torch_policy.py) and PER. TPU-first translation: the update is one
+jitted function (online + target params in, new params + per-sample TD
+errors out); rollouts run epsilon-greedy on CPU actors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.env import EpisodeReturnTracker, VectorEnv, make_env
+from ray_tpu.rl.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class QNetwork(nn.Module):
+    """MLP mapping observations to one Q-value per action."""
+
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"torso_{i}")(x))
+        return nn.Dense(self.num_actions, name="q_head")(x)
+
+
+@ray_tpu.remote
+class DQNRolloutWorker:
+    """Epsilon-greedy transition collection on a vectorized env."""
+
+    def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
+        probe = make_env(env_name)
+        self.net = QNetwork(probe.num_actions, tuple(hidden))
+        self.num_actions = probe.num_actions
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, probe.observation_size), jnp.float32),
+        )["params"]
+        self._fwd = jax.jit(lambda p, o: self.net.apply({"params": p}, o))
+        self._rng = np.random.default_rng(seed + 1)
+        self._episodes = EpisodeReturnTracker(num_envs)
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int, epsilon: float) -> SampleBatch:
+        """Collect ``num_steps`` transitions per env: (s, a, r, s', done).
+
+        Time-limit truncations are stored with done=False — the target must
+        still bootstrap from s' there, exactly like the reference separates
+        terminated from truncated when building Q targets."""
+        n = self.envs.num_envs
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self.envs.observations
+            q = np.asarray(self._fwd(self.params, jnp.asarray(obs)))
+            actions = q.argmax(axis=-1)
+            explore = self._rng.random(n) < epsilon
+            actions = np.where(
+                explore, self._rng.integers(0, self.num_actions, n), actions
+            ).astype(np.int32)
+            next_obs, rewards, terms, truncs, finals = self.envs.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            # s' is the PRE-reset state for ended episodes
+            next_l.append(finals)
+            done_l.append(terms)  # truncation is not a terminal for targets
+            self._episodes.track(rewards, terms | truncs)
+        return SampleBatch(
+            obs=np.concatenate(obs_l),
+            actions=np.concatenate(act_l),
+            rewards=np.concatenate(rew_l),
+            new_obs=np.concatenate(next_l),
+            dones=np.concatenate(done_l),
+        )
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        return self._episodes.drain(clear)
+
+
+class DQNLearner:
+    """Double-DQN update as one jitted step returning per-sample TD error."""
+
+    def __init__(self, observation_size: int, num_actions: int, *,
+                 hidden: Sequence[int] = (64, 64), lr: float = 1e-3,
+                 gamma: float = 0.99, grad_clip: float = 10.0, seed: int = 0):
+        self.net = QNetwork(num_actions, tuple(hidden))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, observation_size), jnp.float32),
+        )["params"]
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        gamma_ = gamma
+        net = self.net
+        optimizer = self.optimizer
+
+        def loss_fn(params, target_params, batch):
+            q = net.apply({"params": params}, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            # double-Q: online net picks the argmax, target net evaluates it
+            q_next_online = net.apply({"params": params}, batch["new_obs"])
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next_target = net.apply({"params": target_params}, batch["new_obs"])
+            q_best = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
+            not_done = 1.0 - batch["dones"].astype(jnp.float32)
+            target = batch["rewards"] + gamma_ * not_done * jax.lax.stop_gradient(q_best)
+            td_error = q_taken - target
+            weights = batch.get("weights")
+            huber = optax.huber_loss(q_taken, target, delta=1.0)
+            loss = jnp.mean(huber * weights) if weights is not None else jnp.mean(huber)
+            return loss, td_error
+
+        def step(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._step = jax.jit(step)
+
+    def update(self, batch: SampleBatch) -> Tuple[float, np.ndarray]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "batch_indexes"}
+        self.params, self.opt_state, loss, td = self._step(
+            self.params, self.target_params, self.opt_state, jb
+        )
+        return float(loss), np.asarray(td)
+
+    def sync_target(self):
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: str = "CartPole-v1"
+    num_rollout_workers: int = 1
+    num_envs_per_worker: int = 4
+    rollout_fragment_length: int = 32
+    buffer_size: int = 50_000
+    prioritized_replay: bool = True
+    per_alpha: float = 0.6
+    per_beta: float = 0.4
+    learning_starts: int = 1_000
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    target_update_interval: int = 500  # in update steps
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_steps: int = 5_000  # in env steps
+    gamma: float = 0.99
+    lr: float = 1e-3
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Iteration driver: sample → store → replay-train → target sync."""
+
+    def __init__(self, config: DQNConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.workers = [
+            DQNRolloutWorker.remote(
+                config.env,
+                num_envs=config.num_envs_per_worker,
+                seed=config.seed + 1000 * i,
+                hidden=config.hidden,
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self.learner = DQNLearner(
+            probe.observation_size, probe.num_actions,
+            hidden=config.hidden, lr=config.lr, gamma=config.gamma,
+            seed=config.seed,
+        )
+        if config.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_size, alpha=config.per_alpha, seed=config.seed
+            )
+        else:
+            self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
+        self._env_steps = 0
+        self._updates = 0
+        self._iteration = 0
+        self._broadcast_weights()
+
+    def _broadcast_weights(self):
+        weights = self.learner.get_weights()
+        ray_tpu.get(
+            [w.set_weights.remote(weights) for w in self.workers], timeout=120
+        )
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        cfg = self.config
+        batches = ray_tpu.get(
+            [
+                w.sample.remote(cfg.rollout_fragment_length, self.epsilon)
+                for w in self.workers
+            ],
+            timeout=600,
+        )
+        batch = SampleBatch.concat(batches)
+        self._env_steps += len(batch)
+        self.buffer.add(batch)
+
+        losses = []
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    mb = self.buffer.sample(cfg.train_batch_size, beta=cfg.per_beta)
+                    loss, td = self.learner.update(mb)
+                    self.buffer.update_priorities(mb["batch_indexes"], td)
+                else:
+                    mb = self.buffer.sample(cfg.train_batch_size)
+                    loss, _ = self.learner.update(mb)
+                losses.append(loss)
+                self._updates += 1
+                if self._updates % cfg.target_update_interval == 0:
+                    self.learner.sync_target()
+            self._broadcast_weights()
+
+        episode_returns: List[float] = []
+        for w in self.workers:
+            episode_returns.extend(
+                ray_tpu.get(w.episode_returns.remote(), timeout=60)
+            )
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._env_steps,
+            "num_updates": self._updates,
+            "epsilon": self.epsilon,
+            "buffer_size": len(self.buffer),
+            "mean_loss": float(np.mean(losses)) if losses else float("nan"),
+            "episode_return_mean": float(np.mean(episode_returns))
+            if episode_returns else float("nan"),
+            "episodes_this_iter": len(episode_returns),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

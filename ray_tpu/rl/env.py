@@ -130,3 +130,24 @@ class VectorEnv:
             np.asarray(truncs, np.bool_),
             np.stack(finals),
         )
+
+
+class EpisodeReturnTracker:
+    """Per-env cumulative return bookkeeping shared by rollout workers:
+    accumulates raw rewards and banks the total when an episode ends."""
+
+    def __init__(self, num_envs: int):
+        self._returns = np.zeros(num_envs, np.float32)
+        self._completed: List[float] = []
+
+    def track(self, rewards: np.ndarray, ended: np.ndarray):
+        self._returns += rewards
+        for i in np.nonzero(ended)[0]:
+            self._completed.append(float(self._returns[i]))
+            self._returns[i] = 0.0
+
+    def drain(self, clear: bool = True) -> List[float]:
+        out = list(self._completed)
+        if clear:
+            self._completed = []
+        return out

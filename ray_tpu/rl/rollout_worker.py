@@ -13,7 +13,7 @@ from typing import Any, Dict
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl.env import VectorEnv, make_env
+from ray_tpu.rl.env import EpisodeReturnTracker, VectorEnv, make_env
 from ray_tpu.rl.rl_module import RLModule
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 
@@ -36,8 +36,7 @@ class RolloutWorker:
         self.gamma = gamma
         self.lam = lam
         # episode-return tracking (the learning-test metric)
-        self._ep_returns = np.zeros(num_envs, np.float32)
-        self._completed: list = []
+        self._episodes = EpisodeReturnTracker(num_envs)
 
     def set_weights(self, params) -> bool:
         self.module.set_params(params)
@@ -72,10 +71,7 @@ class RolloutWorker:
             obs_buf[t], act_buf[t] = obs, actions
             rew_buf[t], done_buf[t] = rewards, dones
             logp_buf[t], val_buf[t] = logp, values
-            self._ep_returns += raw_rewards  # metric excludes the bootstrap
-            for i in np.nonzero(dones)[0]:
-                self._completed.append(float(self._ep_returns[i]))
-                self._ep_returns[i] = 0.0
+            self._episodes.track(raw_rewards, dones)  # excludes the bootstrap
         _, _, last_values = self.module.forward_inference(
             self.envs.observations, self._rng
         )
@@ -94,8 +90,43 @@ class RolloutWorker:
             returns=flat(rets),
         )
 
+    def sample_trajectory(self, num_steps: int) -> SampleBatch:
+        """Time-major fragment for off-policy correction (IMPALA/V-trace).
+
+        Unlike :meth:`sample` this keeps the [T, num_envs] structure and
+        attaches the behavior policy's log-probs instead of GAE — the
+        learner recomputes values/target-logp under its (newer) policy and
+        corrects the off-policyness with V-trace."""
+        n = self.envs.num_envs
+        d = self.module.observation_size
+        obs_buf = np.empty((num_steps, n, d), np.float32)
+        act_buf = np.empty((num_steps, n), np.int32)
+        rew_buf = np.empty((num_steps, n), np.float32)
+        done_buf = np.empty((num_steps, n), np.bool_)
+        logp_buf = np.empty((num_steps, n), np.float32)
+        for t in range(num_steps):
+            obs = self.envs.observations
+            actions, logp, _ = self.module.forward_inference(obs, self._rng)
+            _, rewards, terms, truncs, finals = self.envs.step(actions)
+            raw_rewards = rewards
+            bootstrap = truncs & ~terms
+            if bootstrap.any():
+                _, _, final_vals = self.module.forward_inference(
+                    finals, self._rng
+                )
+                rewards = rewards + self.gamma * final_vals * bootstrap
+            obs_buf[t], act_buf[t] = obs, actions
+            rew_buf[t], done_buf[t] = rewards, terms | truncs
+            logp_buf[t] = logp
+            self._episodes.track(raw_rewards, terms | truncs)
+        return SampleBatch(
+            obs=obs_buf,
+            actions=act_buf,
+            rewards=rew_buf,
+            dones=done_buf,
+            behavior_logp=logp_buf,
+            bootstrap_obs=self.envs.observations.copy(),
+        )
+
     def episode_returns(self, clear: bool = True):
-        out = list(self._completed)
-        if clear:
-            self._completed = []
-        return out
+        return self._episodes.drain(clear)

@@ -262,7 +262,11 @@ class Tuner:
         running: List[Trial] = []
         paused: Dict[str, Trial] = {}
         for t in trials:
-            scheduler.on_trial_add(t.trial_id, t.config)
+            # restored TERMINATED/ERROR trials never run again — feeding
+            # them to a bracket scheduler would leave permanent ghosts in
+            # its live sets
+            if t.status == PENDING:
+                scheduler.on_trial_add(t.trial_id, t.config)
 
         def _suggest_trial() -> Optional[Trial]:
             tid = f"{exp_name}_{len(trials):05d}_{uuid.uuid4().hex[:6]}"
@@ -424,10 +428,12 @@ class Tuner:
                     _drain_scheduler()
                     if paused and not running and not resume_queue:
                         logger.warning(
-                            "resuming %d paused trials without a scheduler "
-                            "decision (anti-deadlock)", len(paused),
+                            "resuming paused trials without a scheduler "
+                            "decision (anti-deadlock, %d parked)", len(paused),
                         )
                         for tid in list(paused):
+                            if len(running) >= limit:
+                                break
                             _resume(paused.pop(tid))
                     continue
                 if search_done:
