@@ -23,6 +23,7 @@ from ray_tpu.api import (
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,
+    ObjectRefGenerator,
     ObjectStoreFullError,
     RayTpuError,
     RemoteFunction,
@@ -49,6 +50,7 @@ __all__ = [
     "get_actor",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "ActorClass",
     "RemoteFunction",

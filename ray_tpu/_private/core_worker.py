@@ -662,7 +662,11 @@ class CoreWorker:
         task_id = self._next_task_id()
         fn_id = self.export_function(fn)
         payload, deps, nested = self._serialize_args(args, kwargs)
-        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        # num_returns="dynamic": one top-level return holding an
+        # ObjectRefGenerator; the executing worker creates the per-item
+        # returns at indices >= 2 (reference: ray_option_utils.py:157-159)
+        n_static = 1 if num_returns == "dynamic" else num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(n_static)]
         spec = {
             "task_id": task_id,
             "job_id": self.job_id,
@@ -1036,6 +1040,18 @@ class CoreWorker:
                         self._lineage[oid.binary()] = spec
             with self._locations_lock:
                 self._lost_objects.discard(oid.binary())
+        if (
+            spec.get("num_returns") == "dynamic"
+            and reply["status"] == "ok"
+            and spec.get("max_retries_initial", 0) > 0
+        ):
+            # dynamic items (indices >= 2) arrive only as location hints;
+            # pin the creating spec so they reconstruct on node loss too
+            tid_bin = task_id.binary()
+            with self._pending_lock:
+                for oid_bin in reply.get("ref_locations") or {}:
+                    if oid_bin.startswith(tid_bin):
+                        self._lineage[oid_bin] = spec
         with self._pending_lock:
             self._pending.pop(task_id, None)
         self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"], spec.get("trace"))
@@ -1046,7 +1062,8 @@ class CoreWorker:
             exc if isinstance(exc, RayTpuError) else TaskError(exc, spec["name"]),
             is_exception=True,
         ).to_bytes()
-        for i in range(spec["num_returns"]):
+        n = spec["num_returns"]
+        for i in range(1 if n == "dynamic" else n):
             self.memory_store.put(ObjectID.for_task_return(task_id, i + 1), err)
         with self._pending_lock:
             self._pending.pop(task_id, None)

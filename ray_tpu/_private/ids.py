@@ -178,3 +178,27 @@ class ObjectID(BaseID):
 
 
 ObjectRef = ObjectID  # public alias used throughout the API layer
+
+
+class ObjectRefGenerator:
+    """The value of a ``num_returns="dynamic"`` task's single return: an
+    iterable of the ObjectRefs the task created, one per yielded item
+    (reference: _raylet.pyx ObjectRefGenerator + ray_option_utils.py:157-159
+    accepting ``num_returns="dynamic"``). ``ray_tpu.get`` on the task's
+    return ref produces this object; each contained ref resolves to one
+    yielded value."""
+
+    def __init__(self, refs):
+        self._refs = list(refs)
+
+    def __iter__(self):
+        return iter(self._refs)
+
+    def __len__(self):
+        return len(self._refs)
+
+    def __getitem__(self, i):
+        return self._refs[i]
+
+    def __repr__(self):
+        return f"ObjectRefGenerator({len(self._refs)} refs)"

@@ -123,6 +123,10 @@ class TaskExecutor:
         """Returns (results, ref_locations): per-return (oid, kind, data)
         triples plus location hints for any ObjectRefs nested in the values,
         so a cross-node caller can pull them (ownership-based directory)."""
+        if num_returns == "dynamic":
+            if is_exception:
+                return self._package_results(task_id, 1, value, True)
+            return self._package_dynamic_results(task_id, value)
         if is_exception:
             values = [value] * num_returns
         elif num_returns == 1:
@@ -158,6 +162,47 @@ class TaskExecutor:
             else:
                 self.core.plasma.put_serialized(oid, sobj)
                 out.append((oid, "plasma", None))
+        return out, ref_locations
+
+    def _package_dynamic_results(self, task_id, value):
+        """num_returns="dynamic": store each yielded item as its own return
+        object (indices >= 2, local plasma) and package an
+        ObjectRefGenerator over them as the task's single static return.
+        The caller learns the item locations through the reply's
+        ref_locations, exactly like any other ObjectRef nested in a return
+        value (ownership-based directory)."""
+        from ray_tpu._private.ids import ObjectRefGenerator
+
+        node = tuple(self.core.raylet.address)
+        refs: List[ObjectID] = []
+        try:
+            items = list(value)  # drives the generator; user code may raise
+        except Exception as e:  # noqa: BLE001
+            return self._package_results(
+                task_id, 1,
+                TaskError(e, "dynamic-return generator", traceback.format_exc()),
+                True,
+            )
+        item_locations: Dict[bytes, Tuple[str, int]] = {}
+        for j, item in enumerate(items):
+            oid = ObjectID.for_task_return(task_id, j + 2)
+            # same nested-ref promotion as the static-return path: refs
+            # inside a yielded value must reach plasma + ship locations
+            sobj, nested = serialization.serialize_and_collect_refs(item)
+            if nested:
+                try:
+                    self.core._resolve_deps([], nested)
+                except Exception:
+                    logger.exception("failed to promote refs in dynamic item")
+                item_locations.update(self.core._dep_locations([], nested))
+            self.core.plasma.put_serialized(oid, sobj)
+            refs.append(oid)
+        out, ref_locations = self._package_results(
+            task_id, 1, ObjectRefGenerator(refs), False
+        )
+        ref_locations.update(item_locations)
+        for oid in refs:
+            ref_locations.setdefault(oid.binary(), node)
         return out, ref_locations
 
     def _reply(self, results_and_locs, is_exc: bool) -> Dict[str, Any]:

@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ActorID, ObjectID
-from ray_tpu._private.ids import ObjectRef  # re-export
+from ray_tpu._private.ids import ObjectRef, ObjectRefGenerator  # re-export
 from ray_tpu._private.core_worker import (  # re-export error types
     ActorDiedError,
     GetTimeoutError,
@@ -124,7 +124,8 @@ class RemoteFunction:
             scheduling_soft=soft,
             runtime_env=self._options.get("runtime_env"),
         )
-        return refs[0] if num_returns == 1 else refs
+        # "dynamic" has one static return: the ObjectRefGenerator
+        return refs[0] if num_returns == 1 or num_returns == "dynamic" else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -140,6 +141,11 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def options(self, num_returns: int = 1) -> "ActorMethod":
+        if num_returns == "dynamic":
+            raise ValueError(
+                'num_returns="dynamic" is only supported for tasks, '
+                "not actor methods"
+            )
         return ActorMethod(self._handle, self._method_name, num_returns)
 
     def remote(self, *args, **kwargs):

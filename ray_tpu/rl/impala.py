@@ -220,6 +220,12 @@ class Impala:
         for _ in range(num_updates):
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                     timeout=600)
+            if not ready:
+                raise TimeoutError(
+                    "no rollout fragment completed within 600s "
+                    f"({len(self._inflight)} in flight) — a rollout worker "
+                    "is likely stuck"
+                )
             ref = ready[0]
             worker = self._inflight.pop(ref)
             batch = ray_tpu.get(ref, timeout=60)

@@ -175,3 +175,43 @@ def test_task_retry_on_worker_death(ray_start_regular):
 
     d = tempfile.mkdtemp()
     assert ray_tpu.get(flaky.remote(d), timeout=60) == "recovered"
+
+
+@ray_tpu.remote(num_returns="dynamic")
+def _squares(n):
+    for i in range(n):
+        yield i * i
+
+
+def test_dynamic_num_returns(ray_start_regular):
+    """num_returns="dynamic": the task is a generator; its single static
+    return resolves to an ObjectRefGenerator over one ref per yield
+    (reference: _private/ray_option_utils.py:157-159)."""
+    ref = _squares.remote(5)
+    gen = ray_tpu.get(ref, timeout=30)
+    assert isinstance(gen, ray_tpu.ObjectRefGenerator)
+    assert len(gen) == 5
+    assert [ray_tpu.get(r, timeout=30) for r in gen] == [0, 1, 4, 9, 16]
+
+
+def test_dynamic_num_returns_large_items(ray_start_regular):
+    @ray_tpu.remote(num_returns="dynamic")
+    def chunks(n):
+        for i in range(n):
+            yield np.full((50_000,), i, np.float32)
+
+    gen = ray_tpu.get(chunks.remote(3), timeout=30)
+    for i, r in enumerate(gen):
+        arr = ray_tpu.get(r, timeout=30)
+        assert arr.shape == (50_000,)
+        assert arr[0] == i
+
+
+def test_dynamic_num_returns_generator_error(ray_start_regular):
+    @ray_tpu.remote(num_returns="dynamic")
+    def bad():
+        yield 1
+        raise ValueError("boom in generator")
+
+    with pytest.raises(Exception, match="boom in generator"):
+        ray_tpu.get(bad.remote(), timeout=30)

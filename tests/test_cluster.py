@@ -145,3 +145,22 @@ def test_wait_fetches_remote(ray_start_cluster):
     ref = make.remote()
     ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=30.0)
     assert ready == [ref] and not_ready == []
+
+
+def test_dynamic_returns_cross_node(ray_start_cluster):
+    """Dynamic-return items live in the producing node's plasma; the driver
+    on the head node must resolve them via the reply's location hints."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"producer": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(num_returns="dynamic", resources={"producer": 1.0})
+    def chunks(n):
+        for i in range(n):
+            yield np.full((100_000,), i, np.float32)  # 400 KB each → plasma
+
+    gen = ray_tpu.get(chunks.remote(3), timeout=60)
+    assert len(gen) == 3
+    for i, r in enumerate(gen):
+        arr = ray_tpu.get(r, timeout=60)
+        assert arr.shape == (100_000,) and float(arr[0]) == i

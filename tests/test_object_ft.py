@@ -90,3 +90,25 @@ def test_lost_put_raises_object_lost(ray_start_cluster):
     time.sleep(1.0)
     with pytest.raises((ray_tpu.ObjectLostError, ray_tpu.GetTimeoutError)):
         ray_tpu.get(inner_ref, timeout=15)
+
+
+def test_dynamic_returns_reconstruction_after_node_death(ray_start_cluster):
+    """Dynamic-return items pin the creating spec as lineage: killing the
+    node that holds them must trigger re-execution, like static returns."""
+    cluster = ray_start_cluster
+    node_b = cluster.add_node(num_cpus=2, resources={"B": 2.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    @ray_tpu.remote(num_returns="dynamic", resources={"B": 0.001}, max_retries=3)
+    def chunks(n):
+        for i in range(n):
+            yield np.full(100_000, i, dtype=np.int64)  # 800 KB -> plasma
+
+    gen = ray_tpu.get(chunks.remote(3), timeout=60)
+    refs = list(gen)
+    cluster.remove_node(node_b)
+    time.sleep(1.0)
+    cluster.add_node(num_cpus=2, resources={"B": 2.0})
+    for i, r in enumerate(refs):
+        arr = ray_tpu.get(r, timeout=90)
+        assert len(arr) == 100_000 and arr[0] == i

@@ -157,8 +157,12 @@ def test_replay_buffer_ring_and_sampling():
     assert len(buf) == 10  # 12 added, ring capacity 10
     s = buf.sample(32)
     assert s["obs"].shape == (32, 2)
-    # oldest entries were overwritten: value 0 appears at most twice
-    assert (s["obs"][:, 0] == 0).sum() <= (s["obs"][:, 0] == 2).sum() + 32 * 0
+    # ring layout after 3 batches of 4 into capacity 10: batch 2 wrapped
+    # into slots {8,9,0,1}, leaving exactly two value-0 rows (slots 2,3)
+    col = buf._cols["obs"][:, 0]
+    assert (col == 0).sum() == 2
+    assert (col == 1).sum() == 4
+    assert (col == 2).sum() == 4
 
 
 def test_prioritized_replay_concentrates_on_high_priority():
