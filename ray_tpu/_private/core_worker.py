@@ -156,8 +156,13 @@ class CoreWorker:
             (reg["store_path"], reg["store_capacity"]) if reg else None
         )
         self.plasma: Optional[PlasmaClient] = None
+        # set once plasma is attached: a worker's task server starts before
+        # late_register returns, and a pushed task must not observe
+        # plasma=None (the lease can land between registration and attach)
+        self.runtime_ready = threading.Event()
         if self._store_info:
             self.plasma = PlasmaClient(self._store_info[0], self._store_info[1], self.raylet.call)
+            self.runtime_ready.set()
 
         # function/class import cache
         import weakref as _weakref
@@ -203,6 +208,10 @@ class CoreWorker:
         # a leased worker runs queued same-shape tasks back to back instead
         # of a lease round-trip per task)
         self._idle_leases: Dict[Tuple, List] = {}
+        self._env_by_sig: Dict[Tuple, Dict[str, Any]] = {}
+        # dynamic-returns: top-level return oid -> item oids whose lineage
+        # pins live only as long as the generator ref does
+        self._dynamic_children: Dict[bytes, List[bytes]] = {}
         self._lease_waiting: Dict[Tuple, Any] = {}  # sig -> deque[spec]
         self._lease_inflight: Dict[Tuple, int] = {}  # sig -> lease rpcs out
         self._lease_lock = threading.Lock()
@@ -217,6 +226,15 @@ class CoreWorker:
         self._local_refs_lock = threading.Lock()
         # async submission queue + submitter pool (lease-per-task with reuse)
         self._shutdown = threading.Event()
+        # dropped-ref cleanup runs on this thread, never in the finalizer
+        # (finalizers must not lock or RPC — see _on_ref_deleted)
+        import collections as _collections
+
+        self._gc_pending: "_collections.deque" = _collections.deque()
+        self._gc_thread = threading.Thread(
+            target=self._ref_gc_loop, name="ref-gc", daemon=True
+        )
+        self._gc_thread.start()
         self._submit_queue: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
         self._submitters = [
             threading.Thread(target=self._submit_loop, name=f"submitter-{i}", daemon=True)
@@ -239,6 +257,7 @@ class CoreWorker:
         self.node_id = reg["node_id"]
         self._store_info = (reg["store_path"], reg["store_capacity"])
         self.plasma = PlasmaClient(self._store_info[0], self._store_info[1], self.raylet.call)
+        self.runtime_ready.set()
 
     # ------------------------------------------------------------------
     # id helpers
@@ -349,6 +368,28 @@ class CoreWorker:
         weakref.finalize(ref, self._on_ref_deleted, binary)
 
     def _on_ref_deleted(self, binary: bytes):
+        """Weakref-finalizer callback. MUST stay lock-free and non-blocking:
+        finalizers run at arbitrary allocation points — including inside
+        another frame that holds an executor/RPC lock — so taking any lock
+        or making an RPC here can deadlock the whole process (observed: GC
+        fired inside ThreadPoolExecutor.submit on the rpc server pool, and
+        the plasma-delete RPC it then issued could never be dispatched).
+        deque.append is atomic; the ref-gc thread does the real work."""
+        self._gc_pending.append(binary)
+
+    def _ref_gc_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                binary = self._gc_pending.popleft()
+            except IndexError:
+                time.sleep(0.05)
+                continue
+            try:
+                self._process_ref_deleted(binary)
+            except Exception:
+                logger.exception("ref gc failed for %s", binary.hex()[:16])
+
+    def _process_ref_deleted(self, binary: bytes):
         with self._local_refs_lock:
             n = self._local_refs.get(binary, 0) - 1
             if n > 0:
@@ -361,6 +402,17 @@ class CoreWorker:
         self.memory_store.delete(oid)
         with self._pending_lock:
             self._lineage.pop(binary, None)
+            # dropping a dynamic task's generator ref releases the lineage
+            # pinned for item refs the user does NOT hold; held item refs
+            # were adopted in get() and release via their own finalizers
+            children = self._dynamic_children.pop(binary, ())
+        if children:
+            with self._local_refs_lock:
+                held = {c for c in children if self._local_refs.get(c, 0) > 0}
+            with self._pending_lock:
+                for child in children:
+                    if child not in held:
+                        self._lineage.pop(child, None)
         try:
             if self.plasma is not None:
                 self.plasma.delete(oid)
@@ -412,7 +464,20 @@ class CoreWorker:
                     raise
                 self._schedule_release(oid, view, value)
                 results[oid] = value
+        for value in results.values():
+            self._adopt_dynamic_refs(value)
         return [results[oid] for oid in object_ids]
+
+    def _adopt_dynamic_refs(self, value: Any):
+        """Register the item refs inside a fetched ObjectRefGenerator so
+        their lineage pins live as long as the user holds them — not just as
+        long as the generator's top-level ref (the common `get(t.remote())`
+        pattern drops that temporary immediately)."""
+        from ray_tpu._private.ids import ObjectRefGenerator
+
+        if isinstance(value, ObjectRefGenerator):
+            for ref in value:
+                self._register_ref(ref)
 
     def _plasma_get_with_recovery(
         self, plasma_ids: List[ObjectID], deadline: Optional[float]
@@ -703,8 +768,13 @@ class CoreWorker:
     def _lease_sig(self, spec: Dict[str, Any]) -> Optional[Tuple]:
         if spec.get("scheduling_node") is not None:
             return None  # affinity-constrained: never reuse generic leases
+        from ray_tpu._private.runtime_env_packaging import runtime_env_key
+
         env = spec.get("runtime_env") or {}
-        env_sig = tuple(sorted((env.get("env_vars") or {}).items()))
+        env_sig = runtime_env_key(env)
+        if env_sig:
+            # the sig must round-trip back to the full env for lease requests
+            self._env_by_sig[env_sig] = env
         return (tuple(sorted((spec.get("resources") or {}).items())), env_sig)
 
     def _maybe_push_from_cache(self, sig: Tuple):
@@ -738,7 +808,7 @@ class CoreWorker:
         thread), then hand it to a waiting spec."""
         res_sig, env_sig = sig
         resources = dict(res_sig)
-        runtime_env = {"env_vars": dict(env_sig)} if env_sig else None
+        runtime_env = self._env_by_sig.get(env_sig) if env_sig else None
         lease_raylet = self.raylet
         hops = 0
         try:
@@ -1046,12 +1116,17 @@ class CoreWorker:
             and spec.get("max_retries_initial", 0) > 0
         ):
             # dynamic items (indices >= 2) arrive only as location hints;
-            # pin the creating spec so they reconstruct on node loss too
+            # pin the creating spec so they reconstruct on node loss too.
+            # The pins release with the generator's top-level ref
+            # (_on_ref_deleted) instead of leaking for the process lifetime.
             tid_bin = task_id.binary()
+            top_bin = ObjectID.for_task_return(task_id, 1).binary()
             with self._pending_lock:
+                children = self._dynamic_children.setdefault(top_bin, [])
                 for oid_bin in reply.get("ref_locations") or {}:
                     if oid_bin.startswith(tid_bin):
                         self._lineage[oid_bin] = spec
+                        children.append(oid_bin)
         with self._pending_lock:
             self._pending.pop(task_id, None)
         self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"], spec.get("trace"))

@@ -25,6 +25,10 @@ from ray_tpu._private import object_store
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, NodeID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
+from ray_tpu._private.runtime_env_packaging import (
+    ensure_extracted,
+    runtime_env_key,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +76,8 @@ class Raylet:
         self.labels["store_capacity"] = str(self.store.capacity)
         self.labels.setdefault("node_name", node_name)
         self._workers: Dict[WorkerID, WorkerHandle] = {}
+        # runtime_env key -> resolved env spec, for spawning pooled workers
+        self._env_specs: Dict[tuple, Dict[str, Any]] = {}
         self._res_cv = threading.Condition()
         self._peers: Dict[Tuple[str, int], RpcClient] = {}
         self._peers_lock = threading.Lock()
@@ -122,13 +128,29 @@ class Raylet:
     # ------------------------------------------------------------------
 
     def _spawn_worker(self, tpu: bool = False,
-                      env_vars: Optional[Dict[str, str]] = None) -> WorkerHandle:
+                      runtime_env: Optional[Dict[str, Any]] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
+        renv = runtime_env or {}
         env = dict(os.environ)
-        if env_vars:
-            # runtime_env: workers are pooled per env_vars set (the
-            # reference keys its worker pool by runtime_env hash)
-            env.update(env_vars)
+        if renv.get("env_vars"):
+            # runtime_env: workers are pooled per runtime_env hash (the
+            # reference keys its worker pool the same way)
+            env.update(renv["env_vars"])
+        # working_dir / py_modules: extract once per node into the session
+        # cache; the worker starts with cwd inside the working_dir and the
+        # extracted roots on PYTHONPATH (reference:
+        # _private/runtime_env/{working_dir,py_modules}.py)
+        cwd = None
+        env_paths: List[str] = []
+        if renv.get("working_dir"):
+            cwd = ensure_extracted(
+                self.session_dir, renv["working_dir"], self.gcs.call
+            )
+            env_paths.append(cwd)
+        for uri in renv.get("py_modules") or ():
+            env_paths.append(
+                ensure_extracted(self.session_dir, uri, self.gcs.call)
+            )
         env["RAYTPU_WORKER_ID"] = worker_id.hex()
         env["RAYTPU_RAYLET_HOST"] = self.server.host
         env["RAYTPU_RAYLET_PORT"] = str(self.server.port)
@@ -142,10 +164,11 @@ class Raylet:
             # platform and disable the TPU PJRT plugin registration.
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
-        # ensure the worker can import ray_tpu regardless of the driver's cwd
+        # ensure the worker can import ray_tpu regardless of the driver's cwd;
+        # runtime_env roots come first so working_dir modules shadow others
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = os.pathsep.join(
-            p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
+            p for p in (*env_paths, pkg_root, env.get("PYTHONPATH", "")) if p
         )
         log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
@@ -154,14 +177,14 @@ class Raylet:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.default_worker"],
                 env=env,
+                cwd=cwd,
                 stdout=logfile,
                 stderr=subprocess.STDOUT,
             )
         finally:
             logfile.close()  # the child holds its own inherited fd
         handle = WorkerHandle(
-            worker_id, proc, tpu=tpu,
-            env_hash=tuple(sorted((env_vars or {}).items())),
+            worker_id, proc, tpu=tpu, env_hash=runtime_env_key(renv),
         )
         with self._res_cv:
             self._workers[worker_id] = handle
@@ -272,8 +295,10 @@ class Raylet:
                 )
                 for k, v in resources.items()
             )
-            env = (payload.get("runtime_env") or {}).get("env_vars") or {}
-            env_hash = tuple(sorted(env.items()))
+            renv = payload.get("runtime_env") or {}
+            env_hash = runtime_env_key(renv)
+            if env_hash:
+                self._env_specs[env_hash] = renv
             spill_checked = False
             demand_key = id(payload)
             self._demand[demand_key] = dict(resources)
@@ -324,7 +349,10 @@ class Raylet:
                 ):
                     self._res_cv.release()
                     try:
-                        self._spawn_worker(tpu=need_tpu, env_vars=dict(env_hash))
+                        self._spawn_worker(
+                            tpu=need_tpu,
+                            runtime_env=self._env_specs.get(env_hash),
+                        )
                     finally:
                         self._res_cv.acquire()
             if not have_resources and allow_spill and not spill_checked:

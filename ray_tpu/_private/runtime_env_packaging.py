@@ -1,0 +1,203 @@
+"""runtime_env working_dir / py_modules packaging.
+
+Reference: _private/runtime_env/packaging.py (zip a local directory into a
+content-addressed package, upload to GCS KV, download + extract into a
+per-node cache) and _private/runtime_env/{working_dir,py_modules}.py (the
+extracted working_dir becomes the worker's cwd and joins sys.path; each
+py_module's parent joins sys.path). Here the raylet resolves packages at
+worker-spawn time — one extraction per node, shared by every worker with
+the same runtime_env — and injects cwd/PYTHONPATH into the child process,
+so the worker itself needs no setup code.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+KV_NAMESPACE = "runtime_env"
+EXCLUDE_DIRS = {"__pycache__", ".git", ".hg", ".venv", "node_modules"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024  # reference caps working_dir uploads
+
+
+def package_path(path: str, prefix: str = "") -> Tuple[str, bytes]:
+    """Zip a directory (or single .py file) deterministically.
+
+    Returns (uri, zip_bytes); the uri is content-addressed
+    (``pkg_<sha1>.zip``) so identical trees dedupe in the KV store.
+    ``prefix`` nests all entries under one top-level directory — used for
+    py_modules, where the extracted tree must BE the module directory.
+    """
+    base = os.path.abspath(os.path.expanduser(path))
+    entries: List[Tuple[str, str]] = []
+    if os.path.isfile(base):
+        if not base.endswith(".py"):
+            raise ValueError(f"py_module file must be a .py file: {path}")
+        entries.append((os.path.basename(base), base))
+    elif os.path.isdir(base):
+        for root, dirs, files in os.walk(base):
+            dirs[:] = sorted(d for d in dirs if d not in EXCLUDE_DIRS)
+            for f in sorted(files):
+                if f.endswith(".pyc"):
+                    continue
+                p = os.path.join(root, f)
+                entries.append((os.path.relpath(p, base), p))
+    else:
+        raise ValueError(f"runtime_env path does not exist: {path}")
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for rel, p in entries:
+            arcname = os.path.join(prefix, rel) if prefix else rel
+            # fixed timestamp so the hash depends only on contents
+            info = zipfile.ZipInfo(arcname, date_time=(2020, 1, 1, 0, 0, 0))
+            info.external_attr = 0o755 << 16
+            info.compress_type = zipfile.ZIP_DEFLATED
+            with open(p, "rb") as fh:
+                data = fh.read()
+            total += len(data)
+            if total > MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"runtime_env package {path!r} exceeds "
+                    f"{MAX_PACKAGE_BYTES // 2**20} MiB"
+                )
+            z.writestr(info, data)
+    blob = buf.getvalue()
+    uri = f"pkg_{hashlib.sha1(blob).hexdigest()}.zip"
+    return uri, blob
+
+
+# driver-side resolution cache: (abspath, latest mtime) -> uri
+_resolve_cache: Dict[Tuple[str, float], str] = {}
+
+
+def _tree_mtime(path: str) -> float:
+    """Newest mtime in the tree — cheap invalidation for the resolve cache.
+    Directory mtimes are included: deleting a file bumps only its parent
+    directory's mtime, which a files-only scan would miss."""
+    base = os.path.abspath(os.path.expanduser(path))
+    newest = os.path.getmtime(base)
+    if os.path.isdir(base):
+        for root, dirs, files in os.walk(base):
+            dirs[:] = [d for d in dirs if d not in EXCLUDE_DIRS]
+            for name in (*dirs, *files):
+                try:
+                    newest = max(
+                        newest, os.path.getmtime(os.path.join(root, name))
+                    )
+                except OSError:
+                    pass
+    return newest
+
+
+def _upload(gcs_call: Callable, path: str, prefix: str = "") -> str:
+    cache_key = (os.path.abspath(os.path.expanduser(path)), _tree_mtime(path))
+    uri = _resolve_cache.get(cache_key)
+    if uri is not None:
+        return uri
+    uri, blob = package_path(path, prefix=prefix)
+    # presence probe via key listing — kv_get would download the whole blob
+    if not gcs_call("kv_keys", (KV_NAMESPACE, uri)):
+        gcs_call("kv_put", (KV_NAMESPACE, uri, blob, True))
+        logger.info(
+            "uploaded runtime_env package %s (%d KiB) from %s",
+            uri, len(blob) // 1024, path,
+        )
+    _resolve_cache[cache_key] = uri
+    return uri
+
+
+# short-TTL memo of fully-resolved envs: .remote() in a hot loop must not
+# pay a filesystem walk (the mtime cache key) per submission
+_env_memo: Dict[str, Tuple[float, Dict[str, Any]]] = {}
+_ENV_MEMO_TTL_S = 5.0
+
+
+def resolve_runtime_env(
+    runtime_env: Optional[Dict[str, Any]], gcs_call: Callable
+) -> Optional[Dict[str, Any]]:
+    """Driver-side: package + upload local paths, returning a normalized
+    runtime_env whose working_dir/py_modules are KV uris. Already-normalized
+    envs (uris) pass through, so re-submission is cheap."""
+    if not runtime_env:
+        return runtime_env
+    import time
+
+    memo_key = repr(sorted((k, repr(v)) for k, v in runtime_env.items()))
+    hit = _env_memo.get(memo_key)
+    now = time.time()
+    if hit is not None and now - hit[0] < _ENV_MEMO_TTL_S:
+        return hit[1]
+    out: Dict[str, Any] = {}
+    if runtime_env.get("env_vars"):
+        out["env_vars"] = dict(runtime_env["env_vars"])
+    wd = runtime_env.get("working_dir")
+    if wd:
+        out["working_dir"] = wd if _is_uri(wd) else _upload(gcs_call, wd)
+    mods = runtime_env.get("py_modules")
+    if mods:
+        uris = []
+        for m in mods:
+            if _is_uri(m):
+                uris.append(m)
+            else:
+                name = os.path.basename(os.path.abspath(
+                    os.path.expanduser(m)))
+                if name.endswith(".py"):
+                    uris.append(_upload(gcs_call, m))  # file at zip root
+                else:
+                    uris.append(_upload(gcs_call, m, prefix=name))
+        out["py_modules"] = uris
+    _env_memo[memo_key] = (now, out)
+    return out
+
+
+def _is_uri(s: str) -> bool:
+    return isinstance(s, str) and s.startswith("pkg_") and s.endswith(".zip")
+
+
+def runtime_env_key(runtime_env: Optional[Dict[str, Any]]) -> tuple:
+    """Canonical hashable key for worker pooling (the reference keys its
+    worker pool by runtime_env hash)."""
+    if not runtime_env:
+        return ()
+    key: List[tuple] = []
+    ev = runtime_env.get("env_vars") or {}
+    if ev:
+        key.append(("env", tuple(sorted(ev.items()))))
+    if runtime_env.get("working_dir"):
+        key.append(("wd", runtime_env["working_dir"]))
+    if runtime_env.get("py_modules"):
+        key.append(("py", tuple(runtime_env["py_modules"])))
+    return tuple(key)
+
+
+def ensure_extracted(session_dir: str, uri: str, gcs_call: Callable) -> str:
+    """Node-side: download (once) + extract (once) a package; returns the
+    extraction root. Concurrent callers race benignly: extraction goes to a
+    unique temp dir then os.replace()s into place."""
+    cache_root = os.path.join(session_dir, "runtime_env")
+    dest = os.path.join(cache_root, uri[: -len(".zip")])
+    if os.path.isdir(dest):
+        return dest
+    blob = gcs_call("kv_get", (KV_NAMESPACE, uri))
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {uri} not found in GCS KV")
+    tmp = f"{dest}.tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        z.extractall(tmp)
+    try:
+        os.replace(tmp, dest)
+    except OSError:
+        # lost the race to another extractor; ours is redundant
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest

@@ -102,6 +102,9 @@ class TaskExecutor:
     def _deserialize_args(self, spec: Dict[str, Any]) -> Tuple[list, dict]:
         import pickle
 
+        # a pushed task can beat late_register's plasma attach by one hop
+        if not self.core.runtime_ready.wait(timeout=30):
+            raise RuntimeError("worker runtime not ready (plasma unattached)")
         # location hints let core.get pull cross-node deps into local plasma
         self.core.register_locations(spec.get("locations") or {})
         desc_args, desc_kwargs = pickle.loads(spec["args"])
@@ -120,9 +123,12 @@ class TaskExecutor:
         return args, kwargs
 
     def _package_results(self, task_id, num_returns: int, value: Any, is_exception: bool):
-        """Returns (results, ref_locations): per-return (oid, kind, data)
-        triples plus location hints for any ObjectRefs nested in the values,
-        so a cross-node caller can pull them (ownership-based directory)."""
+        """Returns (results, ref_locations, is_exception): per-return
+        (oid, kind, data) triples plus location hints for any ObjectRefs
+        nested in the values, so a cross-node caller can pull them
+        (ownership-based directory). The returned is_exception may be True
+        even when the input flag was False: a dynamic-return generator can
+        raise mid-iteration, after the task function itself returned."""
         if num_returns == "dynamic":
             if is_exception:
                 return self._package_results(task_id, 1, value, True)
@@ -162,7 +168,7 @@ class TaskExecutor:
             else:
                 self.core.plasma.put_serialized(oid, sobj)
                 out.append((oid, "plasma", None))
-        return out, ref_locations
+        return out, ref_locations, is_exception
 
     def _package_dynamic_results(self, task_id, value):
         """num_returns="dynamic": store each yielded item as its own return
@@ -170,43 +176,43 @@ class TaskExecutor:
         ObjectRefGenerator over them as the task's single static return.
         The caller learns the item locations through the reply's
         ref_locations, exactly like any other ObjectRef nested in a return
-        value (ownership-based directory)."""
+        value (ownership-based directory). Items stream to plasma one at a
+        time — the worker never holds more than one yielded value."""
         from ray_tpu._private.ids import ObjectRefGenerator
 
         node = tuple(self.core.raylet.address)
         refs: List[ObjectID] = []
+        item_locations: Dict[bytes, Tuple[str, int]] = {}
         try:
-            items = list(value)  # drives the generator; user code may raise
-        except Exception as e:  # noqa: BLE001
+            for j, item in enumerate(value):  # drives the generator
+                oid = ObjectID.for_task_return(task_id, j + 2)
+                # same nested-ref promotion as the static-return path: refs
+                # inside a yielded value must reach plasma + ship locations
+                sobj, nested = serialization.serialize_and_collect_refs(item)
+                if nested:
+                    try:
+                        self.core._resolve_deps([], nested)
+                    except Exception:
+                        logger.exception("failed to promote refs in dynamic item")
+                    item_locations.update(self.core._dep_locations([], nested))
+                self.core.plasma.put_serialized(oid, sobj)
+                refs.append(oid)
+        except Exception as e:  # noqa: BLE001 — user generator code raised
             return self._package_results(
                 task_id, 1,
                 TaskError(e, "dynamic-return generator", traceback.format_exc()),
                 True,
             )
-        item_locations: Dict[bytes, Tuple[str, int]] = {}
-        for j, item in enumerate(items):
-            oid = ObjectID.for_task_return(task_id, j + 2)
-            # same nested-ref promotion as the static-return path: refs
-            # inside a yielded value must reach plasma + ship locations
-            sobj, nested = serialization.serialize_and_collect_refs(item)
-            if nested:
-                try:
-                    self.core._resolve_deps([], nested)
-                except Exception:
-                    logger.exception("failed to promote refs in dynamic item")
-                item_locations.update(self.core._dep_locations([], nested))
-            self.core.plasma.put_serialized(oid, sobj)
-            refs.append(oid)
-        out, ref_locations = self._package_results(
+        out, ref_locations, _ = self._package_results(
             task_id, 1, ObjectRefGenerator(refs), False
         )
         ref_locations.update(item_locations)
         for oid in refs:
             ref_locations.setdefault(oid.binary(), node)
-        return out, ref_locations
+        return out, ref_locations, False
 
-    def _reply(self, results_and_locs, is_exc: bool) -> Dict[str, Any]:
-        results, ref_locations = results_and_locs
+    def _reply(self, packed) -> Dict[str, Any]:
+        results, ref_locations, is_exc = packed
         return {
             "status": "ok" if not is_exc else "error",
             "results": results,
@@ -317,7 +323,7 @@ class TaskExecutor:
                 fn, args, kwargs, task_id, spec["name"], trace=spec.get("trace")
             )
         return self._reply(
-            self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc
+            self._package_results(task_id, spec["num_returns"], value, is_exc)
         )
 
     def _execute_actor_task(self, spec) -> Dict[str, Any]:
@@ -332,7 +338,7 @@ class TaskExecutor:
         if spec["method"] == "__ray_terminate__":
             self.rpc_kill_self(None, None)
             return self._reply(
-                self._package_results(task_id, spec["num_returns"], None, False), False
+                self._package_results(task_id, spec["num_returns"], None, False)
             )
         # control-plane methods bypass the concurrency cap so health/metrics
         # probes can't starve behind saturated user calls (the reference's
@@ -362,7 +368,7 @@ class TaskExecutor:
                     trace=spec.get("trace"),
                 )
         return self._reply(
-            self._package_results(task_id, spec["num_returns"], value, is_exc), is_exc
+            self._package_results(task_id, spec["num_returns"], value, is_exc)
         )
 
     def rpc_create_actor(self, conn: ServerConn, payload) -> bool:

@@ -79,10 +79,10 @@ def _check_options(options: Dict[str, Any]):
         raise ValueError(f"unknown options: {sorted(unknown)}")
     env = options.get("runtime_env")
     if env is not None:
-        supported = {"env_vars"}
+        supported = {"env_vars", "working_dir", "py_modules"}
         extra = set(env) - supported
         if extra:
-            # pip/conda/working_dir need a per-node env agent (not built);
+            # pip/conda need a per-node package installer (not built);
             # fail loudly rather than silently ignore
             raise ValueError(
                 f"runtime_env fields {sorted(extra)} not supported "
@@ -94,6 +94,29 @@ def _check_options(options: Dict[str, Any]):
             for k, v in env_vars.items()
         ):
             raise ValueError("runtime_env env_vars must be str->str")
+        wd = env.get("working_dir")
+        if wd is not None and not isinstance(wd, str):
+            raise ValueError("runtime_env working_dir must be a path string")
+        mods = env.get("py_modules")
+        if mods is not None and (
+            isinstance(mods, str)  # a bare string iterates as characters
+            or not all(isinstance(m, str) for m in mods)
+        ):
+            raise ValueError(
+                "runtime_env py_modules must be a list of path strings"
+            )
+
+
+def _resolved_runtime_env(options: Dict[str, Any]):
+    """Package + upload any local working_dir/py_modules paths (cached by
+    content mtime) so the spec carries KV uris, not driver-local paths."""
+    env = options.get("runtime_env")
+    if not env:
+        return env
+    from ray_tpu._private.runtime_env_packaging import resolve_runtime_env
+
+    core = worker_mod.get_global_worker().core
+    return resolve_runtime_env(env, core.gcs.call)
 
 
 class RemoteFunction:
@@ -122,7 +145,7 @@ class RemoteFunction:
             name=self._options.get("name") or self._fn.__name__,
             scheduling_node=node_id,
             scheduling_soft=soft,
-            runtime_env=self._options.get("runtime_env"),
+            runtime_env=_resolved_runtime_env(self._options),
         )
         # "dynamic" has one static return: the ObjectRefGenerator
         return refs[0] if num_returns == 1 or num_returns == "dynamic" else refs
@@ -215,7 +238,7 @@ class ActorClass:
             "resources_spec": _resources_from_options(self._options, default_cpu=1.0),
             "scheduling_node": node_id,
             "scheduling_soft": soft,
-            "runtime_env": self._options.get("runtime_env"),
+            "runtime_env": _resolved_runtime_env(self._options),
         }
         actor_id = core.create_actor(self._cls, args, kwargs, options)
         return ActorHandle(

@@ -244,3 +244,55 @@ def test_memory_monitor_kills_busy_worker():
         assert ray_tpu.get(ok.remote(), timeout=60) == 1
     finally:
         ray_tpu.shutdown()
+
+
+def test_runtime_env_working_dir(ray_start_regular, tmp_path):
+    """working_dir: a local dir is zipped to GCS KV; workers start with it
+    as cwd and on sys.path (reference: _private/runtime_env/working_dir.py)."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "my_helper_mod.py").write_text("MAGIC = 'wd-magic-123'\n")
+    (proj / "data.txt").write_text("payload-42")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def use_working_dir():
+        import my_helper_mod  # importable only from the working_dir
+
+        with open("data.txt") as f:  # cwd is inside the extracted dir
+            return my_helper_mod.MAGIC, f.read()
+
+    magic, data = ray_tpu.get(use_working_dir.remote(), timeout=60)
+    assert magic == "wd-magic-123"
+    assert data == "payload-42"
+
+
+def test_runtime_env_py_modules(ray_start_regular, tmp_path):
+    """py_modules: each module dir ships whole and lands on sys.path."""
+    mod = tmp_path / "shipped_pkg"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("from shipped_pkg.core import VALUE\n")
+    (mod / "core.py").write_text("VALUE = 777\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use_module():
+        import shipped_pkg
+
+        return shipped_pkg.VALUE
+
+    assert ray_tpu.get(use_module.remote(), timeout=60) == 777
+
+
+def test_runtime_env_working_dir_actor(ray_start_regular, tmp_path):
+    proj = tmp_path / "actorproj"
+    proj.mkdir()
+    (proj / "actor_dep.py").write_text("NAME = 'dep-in-actor'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    class Uses:
+        def read(self):
+            import actor_dep
+
+            return actor_dep.NAME
+
+    a = Uses.remote()
+    assert ray_tpu.get(a.read.remote(), timeout=60) == "dep-in-actor"
