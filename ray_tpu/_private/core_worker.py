@@ -1119,14 +1119,27 @@ class CoreWorker:
             # pin the creating spec so they reconstruct on node loss too.
             # The pins release with the generator's top-level ref
             # (_on_ref_deleted) instead of leaking for the process lifetime.
+            # Fire-and-forget guard: if the caller already dropped the
+            # top-level ref, pinning now would never be released.
             tid_bin = task_id.binary()
             top_bin = ObjectID.for_task_return(task_id, 1).binary()
-            with self._pending_lock:
-                children = self._dynamic_children.setdefault(top_bin, [])
-                for oid_bin in reply.get("ref_locations") or {}:
-                    if oid_bin.startswith(tid_bin):
-                        self._lineage[oid_bin] = spec
-                        children.append(oid_bin)
+            with self._local_refs_lock:
+                top_held = self._local_refs.get(top_bin, 0) > 0
+            if top_held:
+                with self._pending_lock:
+                    children = self._dynamic_children.setdefault(top_bin, [])
+                    for oid_bin in reply.get("ref_locations") or {}:
+                        if oid_bin.startswith(tid_bin):
+                            self._lineage[oid_bin] = spec
+                            children.append(oid_bin)
+                # close the drop-during-pin race: if the top ref died while
+                # we pinned, its finalizer saw an empty children list
+                with self._local_refs_lock:
+                    still_held = self._local_refs.get(top_bin, 0) > 0
+                if not still_held:
+                    with self._pending_lock:
+                        for child in self._dynamic_children.pop(top_bin, ()):
+                            self._lineage.pop(child, None)
         with self._pending_lock:
             self._pending.pop(task_id, None)
         self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"], spec.get("trace"))

@@ -198,6 +198,13 @@ class TaskExecutor:
                 self.core.plasma.put_serialized(oid, sobj)
                 refs.append(oid)
         except Exception as e:  # noqa: BLE001 — user generator code raised
+            # items stored before the failure would be orphans (no owner
+            # ref will ever exist for them): free them now
+            for oid in refs:
+                try:
+                    self.core.plasma.delete(oid)
+                except Exception:
+                    pass
             return self._package_results(
                 task_id, 1,
                 TaskError(e, "dynamic-return generator", traceback.format_exc()),

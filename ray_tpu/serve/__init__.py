@@ -13,6 +13,7 @@ from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.proxy import HTTPProxy
@@ -25,10 +26,14 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "HTTPProxy",
+    "apply",
     "batch",
+    "build",
     "delete",
     "deployment",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
     "start_http_proxy",
@@ -108,40 +113,71 @@ def _get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
 
 
-def run(target, *, name: Optional[str] = None, wait_for_replicas: bool = True,
-        timeout: float = 60.0) -> DeploymentHandle:
-    """Deploy an Application (or bare Deployment) and return its handle."""
-    if isinstance(target, Deployment):
-        target = target.bind()
-    if not isinstance(target, Application):
-        raise TypeError(f"serve.run expects an Application/Deployment, got {target!r}")
-    dep = target.deployment
-    dep_name = name or dep.name
-    controller = _get_or_create_controller()
+def _deploy_tree(app: Application, controller, timeout: float,
+                 deployed: Dict[int, Any],
+                 name_override: Optional[str] = None) -> DeploymentHandle:
+    """Deploy an Application and, first, every Application bound into its
+    init args — model composition (reference: serve deployment graphs,
+    serve/deployment_graph.py): a deployment receives live
+    DeploymentHandles where its constructor was bound child apps.
+
+    ``deployed`` maps id(app) -> (app, handle); storing the app keeps it
+    alive so a freed temporary's id can't be reused by a sibling."""
+    if id(app) in deployed:
+        return deployed[id(app)][1]
+
+    def _sub(v):
+        if isinstance(v, Application):
+            return _deploy_tree(v, controller, timeout, deployed)
+        if isinstance(v, Deployment):
+            return _deploy_tree(v.bind(), controller, timeout, deployed)
+        return v
+
+    init_args = tuple(_sub(a) for a in app.init_args)
+    init_kwargs = {k: _sub(v) for k, v in app.init_kwargs.items()}
+    dep = app.deployment
+    dep_name = name_override or dep.name
     spec = {
         "func_or_class": dep.func_or_class,
-        "init_args": target.init_args,
-        "init_kwargs": target.init_kwargs,
+        "init_args": init_args,
+        "init_kwargs": init_kwargs,
         **dep.config,
     }
     ray_tpu.get(controller.deploy.remote(dep_name, spec), timeout=timeout)
     handle = DeploymentHandle(dep_name)
+    deployed[id(app)] = (app, handle)
+    return handle
+
+
+def run(target, *, name: Optional[str] = None, wait_for_replicas: bool = True,
+        timeout: float = 60.0) -> DeploymentHandle:
+    """Deploy an Application (or bare Deployment) and return its handle.
+    Applications bound as init args deploy first (composition)."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application/Deployment, got {target!r}")
+    controller = _get_or_create_controller()
+    deployed: Dict[int, Any] = {}
+    handle = _deploy_tree(target, controller, timeout, deployed, name)
     if wait_for_replicas:
         import time as _time
 
         deadline = _time.monotonic() + timeout
-        while True:
-            table = ray_tpu.get(
-                controller.get_routing_table.remote(dep_name), timeout=30
-            )
-            if table and table["replicas"]:
-                break
-            if _time.monotonic() >= deadline:
-                raise TimeoutError(
-                    f"deployment {dep_name!r} has no replicas after {timeout}s "
-                    f"(insufficient cluster resources?)"
+        for _app, h in deployed.values():
+            while True:
+                table = ray_tpu.get(
+                    controller.get_routing_table.remote(h.deployment_name),
+                    timeout=30,
                 )
-            _time.sleep(0.05)
+                if table and table["replicas"]:
+                    break
+                if _time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"deployment {h.deployment_name!r} has no replicas "
+                        f"after {timeout}s (insufficient cluster resources?)"
+                    )
+                _time.sleep(0.05)
     return handle
 
 
@@ -176,3 +212,106 @@ def shutdown(timeout: float = 30.0):
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> HTTPProxy:
     """Start an in-driver HTTP ingress (POST /<deployment> with JSON)."""
     return HTTPProxy(host, port)
+
+
+# -- declarative config (reference: serve/schema.py ServeDeploySchema +
+#    `serve build`/`serve deploy`) ------------------------------------------
+
+
+def build(target, name: Optional[str] = None) -> Dict[str, Any]:
+    """Render an Application DAG into a JSON-able deploy config.
+
+    Each deployment's callable must be importable (``module:qualname``);
+    bound child applications appear as ``{"$handle": <name>}`` placeholders
+    in init args. The result round-trips through :func:`apply`."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    deployments: list = []
+    # id(app) -> (app, name): the app reference pins the object so a freed
+    # temporary's id can't alias a sibling
+    seen: Dict[int, Any] = {}
+
+    def _walk(app: Application, name_override=None) -> str:
+        if id(app) in seen:
+            return seen[id(app)][1]
+        dep = app.deployment
+        dep_name = name_override or dep.name
+        seen[id(app)] = (app, dep_name)
+        fc = dep.func_or_class
+        module = getattr(fc, "__module__", None)
+        qualname = getattr(fc, "__qualname__", None)
+        if not module or not qualname or "<locals>" in qualname:
+            raise ValueError(
+                f"deployment {dep_name!r} callable is not importable "
+                f"({module}:{qualname}); define it at module top level"
+            )
+
+        def _enc(v):
+            if isinstance(v, Application):
+                return {"$handle": _walk(v)}
+            if isinstance(v, Deployment):
+                return {"$handle": _walk(v.bind())}
+            return v
+
+        deployments.append({
+            "name": dep_name,
+            "import_path": f"{module}:{qualname}",
+            "init_args": [_enc(a) for a in app.init_args],
+            "init_kwargs": {k: _enc(v) for k, v in app.init_kwargs.items()},
+            "num_replicas": dep.config.get("num_replicas", 1),
+            "user_config": dep.config.get("user_config"),
+            "autoscaling_config": dep.config.get("autoscaling"),
+            "resources": dep.config.get("resources"),
+        })
+        return dep_name
+
+    ingress = _walk(target, name)
+    return {"ingress": ingress, "deployments": deployments}
+
+
+def apply(config: Dict[str, Any], *, timeout: float = 60.0) -> DeploymentHandle:
+    """Deploy from a config produced by :func:`build` (or hand-written)."""
+    import importlib
+
+    controller = _get_or_create_controller()
+    handles: Dict[str, DeploymentHandle] = {}
+
+    def _dec(v):
+        if isinstance(v, dict) and set(v) == {"$handle"}:
+            return DeploymentHandle(v["$handle"])
+        return v
+
+    # children first: deployments referenced via $handle must exist by the
+    # time their parent's constructor runs
+    by_name = {d["name"]: d for d in config["deployments"]}
+    resolved: set = set()
+
+    def _deploy(name: str):
+        if name in resolved:
+            return
+        d = by_name[name]
+        for v in (*d.get("init_args", ()), *d.get("init_kwargs", {}).values()):
+            if isinstance(v, dict) and set(v) == {"$handle"}:
+                _deploy(v["$handle"])
+        module, qualname = d["import_path"].split(":")
+        target = importlib.import_module(module)
+        for part in qualname.split("."):
+            target = getattr(target, part)
+        if isinstance(target, Deployment):
+            target = target.func_or_class
+        spec = {
+            "func_or_class": target,
+            "init_args": tuple(_dec(a) for a in d.get("init_args", ())),
+            "init_kwargs": {k: _dec(v) for k, v in d.get("init_kwargs", {}).items()},
+            "num_replicas": d.get("num_replicas", 1),
+            "user_config": d.get("user_config"),
+            "autoscaling": d.get("autoscaling_config"),
+            "resources": d.get("resources"),
+        }
+        ray_tpu.get(controller.deploy.remote(name, spec), timeout=timeout)
+        handles[name] = DeploymentHandle(name)
+        resolved.add(name)
+
+    for d in config["deployments"]:
+        _deploy(d["name"])
+    return handles[config["ingress"]]

@@ -77,18 +77,35 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str):
+    def __init__(self, deployment_name: str,
+                 multiplexed_model_id: Optional[str] = None):
         self.deployment_name = deployment_name
+        self.multiplexed_model_id = multiplexed_model_id
         self._lock = threading.Lock()
         self._replicas = []
         self._version = -1
         # keyed by replica actor id, not list position: reconciliation can
         # reorder/replace the table under in-flight responses
         self._inflight: Dict[Any, int] = {}
+        # model id -> replica actor id that last served it (cache-aware
+        # sticky routing for @serve.multiplexed deployments; reference:
+        # serve/_private/router.py model-multiplex replica ranking)
+        self._model_affinity: Dict[str, Any] = {}
         # slots released by DeploymentResponse (possibly from __del__);
         # drained under the lock before every pick
         self._released: "deque" = deque()
         self._last_refresh = 0.0
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """A handle variant whose requests carry a multiplexed model id."""
+        h = DeploymentHandle(self.deployment_name, multiplexed_model_id)
+        # share routing state so the po2 counts and affinity stay global
+        h._lock = self._lock
+        h._inflight = self._inflight
+        h._model_affinity = self._model_affinity
+        h._released = self._released
+        return h
 
     def _drain_released_locked(self):
         while True:
@@ -120,10 +137,15 @@ class DeploymentHandle:
             self._version = table["version"]
             keys = {r._actor_id for r in self._replicas}
             self._inflight = {k: v for k, v in self._inflight.items() if k in keys}
+            for model, key in list(self._model_affinity.items()):
+                if key not in keys:
+                    del self._model_affinity[model]
             self._last_refresh = now
 
     def _pick(self):
-        """Power-of-two choices on locally tracked in-flight counts."""
+        """Power-of-two choices on locally tracked in-flight counts; a
+        multiplexed model id routes stickily to the replica that last
+        served it (its weights are already resident)."""
         with self._lock:
             self._drain_released_locked()
             n = len(self._replicas)
@@ -131,11 +153,25 @@ class DeploymentHandle:
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no replicas"
                 )
+            model_id = self.multiplexed_model_id
+            if model_id:
+                key = self._model_affinity.get(model_id)
+                if key is not None:
+                    for r in self._replicas:
+                        if r._actor_id == key:
+                            return r
             if n == 1:
-                return self._replicas[0]
-            a, b = random.sample(self._replicas, 2)
-            ka, kb = a._actor_id, b._actor_id
-            return a if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0) else b
+                choice = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                ka, kb = a._actor_id, b._actor_id
+                choice = (
+                    a if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0)
+                    else b
+                )
+            if model_id:
+                self._model_affinity[model_id] = choice._actor_id
+            return choice
 
     def _send(self, method, args, kwargs, attempt: int = 0) -> DeploymentResponse:
         self._refresh()
@@ -143,7 +179,12 @@ class DeploymentHandle:
         key = replica._actor_id
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
-        ref = replica.handle_request.remote(method, args, kwargs)
+        if self.multiplexed_model_id:
+            ref = replica.handle_request.remote(
+                method, args, kwargs, self.multiplexed_model_id
+            )
+        else:
+            ref = replica.handle_request.remote(method, args, kwargs)
         return DeploymentResponse(ref, self, key, (method, args, kwargs), attempt)
 
     # -- public -----------------------------------------------------------

@@ -1,0 +1,121 @@
+"""Model multiplexing: many models per deployment, LRU-cached per replica.
+
+Reference: serve/multiplex.py (_ModelMultiplexWrapper) + serve/api.py
+``@serve.multiplexed`` / ``serve.get_multiplexed_model_id``. A deployment
+whose loader is decorated with ``@serve.multiplexed`` serves any number of
+model ids with at most ``max_num_models_per_replica`` resident per
+replica; requests carry a model id (``handle.options(multiplexed_model_id=
+...)``) and the handle routes a given model id stickily to the replica
+that last served it, approximating the reference's cache-aware routing
+without a control-plane round trip.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import inspect
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default=""
+)
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id of the request being handled."""
+    return _current_model_id.get()
+
+
+class _MultiplexWrapper:
+    """Per-instance LRU of loaded models keyed by model id."""
+
+    def __init__(self, loader: Callable, owner: Any, max_models: int):
+        self._loader = loader
+        self._owner = owner
+        self._max = max_models
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        # model id -> Event while a load is in flight: concurrent first
+        # requests must not each load the same weights (transient 2x HBM)
+        self._loading: dict = {}
+
+    def load(self, model_id: str):
+        while True:
+            with self._lock:
+                if model_id in self._models:
+                    self._models.move_to_end(model_id)
+                    return self._models[model_id]
+                pending = self._loading.get(model_id)
+                if pending is None:
+                    self._loading[model_id] = threading.Event()
+                    break
+            pending.wait(timeout=300)  # another request is loading it
+        try:
+            # load outside the lock: loading can be slow and concurrent
+            # requests for resident models must not queue behind it
+            model = (
+                self._loader(self._owner, model_id)
+                if self._owner is not None
+                else self._loader(model_id)
+            )
+            if inspect.iscoroutine(model):
+                import asyncio
+
+                model = asyncio.run(model)
+            with self._lock:
+                self._models[model_id] = model
+                self._models.move_to_end(model_id)
+                while len(self._models) > self._max:
+                    evicted_id, evicted = self._models.popitem(last=False)
+                    del evicted  # drop the only ref; __del__ may free HBM
+            return model
+        finally:
+            with self._lock:
+                self._loading.pop(model_id).set()
+
+    def loaded_ids(self):
+        with self._lock:
+            return list(self._models)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate a deployment's model-loader method: ``get_model(model_id)``.
+
+    The decorated callable becomes an LRU-cached loader; call it with the
+    id from :func:`get_multiplexed_model_id`."""
+
+    def deco(loader: Callable):
+        is_method = "." in getattr(loader, "__qualname__", "")
+
+        if is_method:
+            # the wrapper lives ON the instance (not in a decorator-scope
+            # dict): it dies with the instance, so replaced replicas free
+            # their cached models instead of leaking them
+            attr = f"_serve_mux_{loader.__name__}"
+
+            def bound(self, model_id: str):
+                w = self.__dict__.get(attr)
+                if w is None:
+                    w = self.__dict__[attr] = _MultiplexWrapper(
+                        loader, self, max_num_models_per_replica
+                    )
+                return w.load(model_id)
+
+            bound.__wrapped__ = loader
+            bound._serve_multiplexed = True
+            return bound
+
+        wrapper = _MultiplexWrapper(loader, None, max_num_models_per_replica)
+
+        def unbound(model_id: str):
+            return wrapper.load(model_id)
+
+        unbound.__wrapped__ = loader
+        unbound._serve_multiplexed = True
+        unbound._wrapper = wrapper
+        return unbound
+
+    return deco if func is None else deco(func)

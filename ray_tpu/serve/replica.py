@@ -47,14 +47,19 @@ class Replica:
             fn(user_config)
         return True
 
-    def handle_request(self, method: Optional[str], args, kwargs):
+    def handle_request(self, method: Optional[str], args, kwargs,
+                       model_id: Optional[str] = None):
+        from ray_tpu.serve.multiplex import _current_model_id
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _current_model_id.set(model_id or "")
         try:
             target = self._callable if method is None else getattr(self._callable, method)
             return target(*args, **kwargs)
         finally:
+            _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
