@@ -161,6 +161,22 @@ def main():
         )
     )
     ray_tpu.shutdown()
+    # archive as a round artifact (reference archives its microbenchmark
+    # results under release/release_logs/<version>/microbenchmark.json)
+    import os
+
+    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r03.json")
+    payload = {
+        "results": {k: round(v, 2) for k, v in results.items()},
+        "vs_baseline": {
+            k: round(results[k] / REFERENCE[k], 4) for k in keys
+        },
+        "geomean_vs_reference": round(geo, 4),
+    }
+    with open(os.path.join(os.path.dirname(__file__), artifact), "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return payload
 
 
 if __name__ == "__main__":

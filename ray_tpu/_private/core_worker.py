@@ -183,10 +183,14 @@ class CoreWorker:
         self._actor_pending: Dict[ActorID, List] = {}
         self._actor_inflight: Dict[ActorID, int] = {}
         self._actor_next_send: Dict[ActorID, int] = {}
-        # wire-order gate: submitter threads race to push pipelined calls;
-        # each call waits its turn so the actor's connection sees seq order
-        self._actor_wire_next: Dict[ActorID, int] = {}
-        self._actor_wire_cv = threading.Condition()
+        # per-actor outbox drained by at most one submitter thread at a
+        # time: sends hit the actor's connection in seq order without any
+        # cross-thread gate (the round-2 wire-order gate could starve the
+        # submitter pool when racing pumps inverted queue order — all
+        # threads blocked waiting for a seq whose send action had no free
+        # thread, wedging pipelined calls for worker_lease_timeout_s*4)
+        self._actor_outbox: Dict[ActorID, Any] = {}
+        self._actor_draining: Dict[ActorID, bool] = {}
         self._actor_lock = threading.Lock()
         # pending normal tasks owned by this worker
         self._pending: Dict[TaskID, Dict[str, Any]] = {}
@@ -208,7 +212,6 @@ class CoreWorker:
         # a leased worker runs queued same-shape tasks back to back instead
         # of a lease round-trip per task)
         self._idle_leases: Dict[Tuple, List] = {}
-        self._env_by_sig: Dict[Tuple, Dict[str, Any]] = {}
         # dynamic-returns: top-level return oid -> item oids whose lineage
         # pins live only as long as the generator ref does
         self._dynamic_children: Dict[bytes, List[bytes]] = {}
@@ -772,9 +775,6 @@ class CoreWorker:
 
         env = spec.get("runtime_env") or {}
         env_sig = runtime_env_key(env)
-        if env_sig:
-            # the sig must round-trip back to the full env for lease requests
-            self._env_by_sig[env_sig] = env
         return (tuple(sorted((spec.get("resources") or {}).items())), env_sig)
 
     def _maybe_push_from_cache(self, sig: Tuple):
@@ -808,14 +808,18 @@ class CoreWorker:
         thread), then hand it to a waiting spec."""
         res_sig, env_sig = sig
         resources = dict(res_sig)
-        runtime_env = self._env_by_sig.get(env_sig) if env_sig else None
         lease_raylet = self.raylet
         hops = 0
         try:
             while not self._shutdown.is_set():
                 with self._lease_lock:
-                    if not self._lease_waiting.get(sig):
+                    waiting = self._lease_waiting.get(sig)
+                    if not waiting:
                         return  # queue drained (cached leases served it)
+                    # every spec with this sig carries an equivalent env;
+                    # reading it here (not from a side map) can't race with
+                    # any cache eviction
+                    runtime_env = waiting[0].get("runtime_env") or None
                 try:
                     # short raylet-side wait: a request whose demand has
                     # since drained must not pin a submitter thread (nor
@@ -945,8 +949,8 @@ class CoreWorker:
             if spec is None:
                 return
             try:
-                if spec.get("__action__") == "send_actor":
-                    self._send_actor_task(spec["spec"])
+                if spec.get("__action__") == "drain_actor":
+                    self._drain_actor(spec["actor_id"])
                 elif spec.get("__action__") == "lease":
                     self._acquire_lease(spec["sig"])
                 elif spec.get("actor_id") is not None and spec.get("method") is not None:
@@ -1262,29 +1266,52 @@ class CoreWorker:
         self._pump_actor(actor_id)
 
     def _pump_actor(self, actor_id: ActorID):
-        """Dispatch every in-order queued call up to the in-flight window
-        (pipelining: the reference keeps many calls in flight per handle and
-        the worker-side queue orders execution —
+        """Move every in-order queued call up to the in-flight window into
+        the actor's outbox and ensure one drainer is running (pipelining:
+        the reference keeps many calls in flight per handle and the
+        worker-side queue orders execution —
         direct_actor_task_submitter.cc). May run on a submitter thread or
-        the rpc callback executor."""
+        the rpc callback executor; the outbox append happens under the
+        actor lock so outbox order always equals seq order."""
+        import collections
         import heapq
 
-        to_send = []
+        start_drain = False
         with self._actor_lock:
             heap = self._actor_pending.get(actor_id) or []
             nxt = self._actor_next_send.get(actor_id, 0)
             inflight = self._actor_inflight.get(actor_id, 0)
             cap = GlobalConfig.actor_max_inflight
+            outbox = self._actor_outbox.setdefault(actor_id, collections.deque())
             while heap and heap[0][0] == nxt and inflight < cap:
                 _, _, spec = heapq.heappop(heap)
-                to_send.append(spec)
+                outbox.append(spec)
                 nxt += 1
                 inflight += 1
             self._actor_next_send[actor_id] = nxt
             self._actor_inflight[actor_id] = inflight
-        for spec in to_send:
+            if outbox and not self._actor_draining.get(actor_id):
+                self._actor_draining[actor_id] = True
+                start_drain = True
+        if start_drain:
             # hop to a submitter thread: address resolution can block
-            self._submit_queue.put({"__action__": "send_actor", "spec": spec})
+            self._submit_queue.put({"__action__": "drain_actor", "actor_id": actor_id})
+
+    def _drain_actor(self, actor_id: ActorID):
+        """Send the actor's outbox in order. Exactly one drainer runs per
+        actor at a time (the _actor_draining flag), so pushes hit the
+        actor's connection in seq order with no cross-thread coordination;
+        only this actor's pipeline stalls if resolution blocks."""
+        while not self._shutdown.is_set():
+            with self._actor_lock:
+                outbox = self._actor_outbox.get(actor_id)
+                if not outbox:
+                    self._actor_draining[actor_id] = False
+                    return
+                spec = outbox.popleft()
+            self._send_actor_task(spec)
+        with self._actor_lock:
+            self._actor_draining[actor_id] = False
 
     def _actor_task_done(self, spec: Dict[str, Any]):
         if not spec.get("ordered", True):
@@ -1296,26 +1323,14 @@ class CoreWorker:
             )
         self._pump_actor(actor_id)
 
-    def _advance_wire(self, actor_id: ActorID, spec: Dict[str, Any]):
-        # Ordered calls advance the gate past their own seq; unordered
-        # calls carry seq_no=-1 (out-of-band, no gate interaction)
-        with self._actor_wire_cv:
-            nxt = self._actor_wire_next.get(actor_id, 0)
-            if spec["seq_no"] >= nxt:
-                self._actor_wire_next[actor_id] = spec["seq_no"] + 1
-            self._actor_wire_cv.notify_all()
-
     def _send_actor_task(self, spec: Dict[str, Any]):
-        """Resolve the actor address (blocking, submitter thread) and push
-        asynchronously; completion runs on the callback executor. Ordered
-        calls pass a wire-order gate before the push so the actor's
-        connection carries them in sequence order even though several
-        submitter threads race. Any unexpected failure must still advance
-        the gate and the in-flight window, or the actor wedges."""
+        """Resolve the actor address (blocking, on the actor's single
+        drainer for ordered calls) and push asynchronously; completion runs
+        on the callback executor. Any unexpected failure must still release
+        the in-flight window, or the actor wedges."""
         try:
             self._send_actor_task_inner(spec)
         except Exception as e:  # noqa: BLE001
-            self._advance_wire(spec["actor_id"], spec)
             self._fail_task(spec, e)
             self._actor_task_done(spec)
 
@@ -1323,31 +1338,16 @@ class CoreWorker:
         self._resolve_deps(spec["deps"], spec["nested"])
         spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
         actor_id = spec["actor_id"]
-        if spec.get("ordered", True):
-            deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
-            with self._actor_wire_cv:
-                # wait only while the gate is BEHIND us: a timed-out
-                # predecessor fails open and jumps the gate past several
-                # seqs at once, in which case we proceed immediately
-                while (
-                    self._actor_wire_next.get(actor_id, 0) < spec["seq_no"]
-                    and not self._shutdown.is_set()
-                ):
-                    if time.monotonic() > deadline:
-                        break  # a predecessor stalled: fail open, not deadlock
-                    self._actor_wire_cv.wait(0.5)
         attempts = 0
         while not self._shutdown.is_set():
             attempts += 1
             try:
                 addr = self._resolve_actor(actor_id)
             except ActorDiedError as e:
-                self._advance_wire(actor_id, spec)
                 self._fail_task(spec, e)
                 self._actor_task_done(spec)
                 return
             except GetTimeoutError as e:
-                self._advance_wire(actor_id, spec)
                 self._fail_task(spec, e)
                 self._actor_task_done(spec)
                 return
@@ -1358,7 +1358,6 @@ class CoreWorker:
                 with self._actor_lock:
                     self._actor_info.pop(actor_id, None)
                 if attempts > 50:
-                    self._advance_wire(actor_id, spec)
                     self._fail_task(
                         spec, ActorDiedError(f"actor {actor_id.hex()[:8]} unreachable")
                     )
@@ -1389,7 +1388,6 @@ class CoreWorker:
                 self._actor_task_done(spec)
 
             client.call_async("push_task", spec, on_done)
-            self._advance_wire(actor_id, spec)
             return
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):

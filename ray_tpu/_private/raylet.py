@@ -76,8 +76,6 @@ class Raylet:
         self.labels["store_capacity"] = str(self.store.capacity)
         self.labels.setdefault("node_name", node_name)
         self._workers: Dict[WorkerID, WorkerHandle] = {}
-        # runtime_env key -> resolved env spec, for spawning pooled workers
-        self._env_specs: Dict[tuple, Dict[str, Any]] = {}
         self._res_cv = threading.Condition()
         self._peers: Dict[Tuple[str, int], RpcClient] = {}
         self._peers_lock = threading.Lock()
@@ -297,22 +295,20 @@ class Raylet:
             )
             renv = payload.get("runtime_env") or {}
             env_hash = runtime_env_key(renv)
-            if env_hash:
-                self._env_specs[env_hash] = renv
             spill_checked = False
             demand_key = id(payload)
             self._demand[demand_key] = dict(resources)
             try:
                 return self._lease_loop_locked(
                     resources, actor_id, deadline, allow_spill, need_tpu,
-                    spill_checked, env_hash,
+                    spill_checked, env_hash, renv,
                 )
             finally:
                 self._demand.pop(demand_key, None)
 
     def _lease_loop_locked(
         self, resources, actor_id, deadline, allow_spill, need_tpu,
-        spill_checked, env_hash=(),
+        spill_checked, env_hash=(), runtime_env=None,
     ):
         """The parked-request wait loop; runs with _res_cv held (the caller
         registered this request in self._demand for heartbeat reporting)."""
@@ -351,7 +347,7 @@ class Raylet:
                     try:
                         self._spawn_worker(
                             tpu=need_tpu,
-                            runtime_env=self._env_specs.get(env_hash),
+                            runtime_env=runtime_env,
                         )
                     finally:
                         self._res_cv.acquire()

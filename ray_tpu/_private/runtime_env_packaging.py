@@ -114,7 +114,9 @@ def _upload(gcs_call: Callable, path: str, prefix: str = "") -> str:
 
 
 # short-TTL memo of fully-resolved envs: .remote() in a hot loop must not
-# pay a filesystem walk (the mtime cache key) per submission
+# pay a filesystem walk (the mtime cache key) per submission. Tradeoff:
+# edits to a working_dir/py_modules tree within the TTL of a prior
+# submission reuse the stale package uri until the memo expires.
 _env_memo: Dict[str, Tuple[float, Dict[str, Any]]] = {}
 _ENV_MEMO_TTL_S = 5.0
 
@@ -200,4 +202,8 @@ def ensure_extracted(session_dir: str, uri: str, gcs_call: Callable) -> str:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
+        if not os.path.isdir(dest):
+            # not a race after all (EACCES/EXDEV/...): surface it here
+            # instead of a confusing import failure at worker spawn
+            raise
     return dest

@@ -136,7 +136,10 @@ class DeploymentHandle:
             self._replicas = table["replicas"]
             self._version = table["version"]
             keys = {r._actor_id for r in self._replicas}
-            self._inflight = {k: v for k, v in self._inflight.items() if k in keys}
+            # prune in place: options() variants share this dict by
+            # reference, so rebinding would desync their routing counts
+            for k in [k for k in self._inflight if k not in keys]:
+                del self._inflight[k]
             for model, key in list(self._model_affinity.items()):
                 if key not in keys:
                     del self._model_affinity[model]

@@ -182,6 +182,7 @@ class HyperBandScheduler(TrialScheduler):
             "live": set(),        # trials not yet halved away
             "paused": set(),      # live trials parked at the milestone
             "scores": {},         # trial_id -> score at current milestone
+            "halved": False,      # closed to late arrivals once halving starts
         }
         self._brackets.append(b)
         return b
@@ -189,7 +190,10 @@ class HyperBandScheduler(TrialScheduler):
     def on_trial_add(self, trial_id: str, config: Dict[str, Any]):
         size = self.bracket_size
         b = self._brackets[-1] if self._brackets else None
-        if b is None or (size and len(b["live"]) >= size):
+        # a bracket that has begun halving is closed to late arrivals: its
+        # milestone has already multiplied, so a new trial would get an
+        # eta-times-larger initial budget than its bracket peers
+        if b is None or b["halved"] or (size and len(b["live"]) >= size):
             b = self._new_bracket()
         b["live"].add(trial_id)
         self._trial_bracket[trial_id] = b
@@ -227,6 +231,7 @@ class HyperBandScheduler(TrialScheduler):
         keep = max(1, int(len(ranked) / self.eta))
         promoted, dropped = ranked[:keep], ranked[keep:]
         b["milestone"] = min(self.max_t, int(b["milestone"] * self.eta))
+        b["halved"] = True
         b["live"] = set(promoted)
         b["paused"] = set()
         b["scores"] = {}
