@@ -1,0 +1,119 @@
+"""Device-object-plane benchmark: broadcast a ~1B-param state dict.
+
+Measures plasma put + repeated zero-copy get of a sharded jax param tree
+(the weights→rollout-workers / checkpoint-broadcast path) against the
+round-2 baseline of host pickle + device_put. Runs on the virtual 8-device
+CPU mesh so it is hardware-independent; run it with:
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python bench_device_plane.py [n_params_million]
+
+bench_core.py invokes it as a subprocess and merges the JSON lines into
+the round artifact. Reference analogue: the object_store scalability
+benchmark (release/benchmarks/README.md, 1 GiB broadcast)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import ray_tpu
+
+    n_million = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    n_params = n_million * 1024 * 1024
+    n_leaves = 8
+    per_leaf = n_params // n_leaves
+    dim = 2048
+    rows = per_leaf // dim
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(len(devs)), ("fsdp",))
+    sh = NamedSharding(mesh, P("fsdp"))
+    tree = {
+        f"layer{i}/w": jax.device_put(
+            jnp.ones((rows, dim), dtype=jnp.bfloat16), sh
+        )
+        for i in range(n_leaves)
+    }
+    nbytes = sum(v.nbytes for v in tree.values())
+    gib = nbytes / (1 << 30)
+
+    ray_tpu.init(num_cpus=2, log_level="ERROR",
+                 object_store_memory=int(nbytes * 2.5))
+    out = {}
+
+    # warmup: overlaps the store's background prefault and faults the
+    # client mapping once, like the reference's warm-pool microbenchmarks
+    warm = ray_tpu.put(tree)
+    ray_tpu.get(warm, timeout=120)
+    del warm
+    time.sleep(0.5)
+
+    t0 = time.perf_counter()
+    ref = ray_tpu.put(tree)
+    t_put = time.perf_counter() - t0
+    out["weights_put_gbps"] = gib / t_put
+
+    gets = 3
+    t0 = time.perf_counter()
+    for _ in range(gets):
+        got = ray_tpu.get(ref, timeout=120)
+    t_get = (time.perf_counter() - t0) / gets
+    assert str(got[f"layer0/w"].sharding.spec) == str(sh.spec)
+    out["weights_get_gbps"] = gib / t_get
+    del got
+
+    # round-2 baseline: host pickle + device_put (what the collective layer
+    # used to do for every device value)
+    import cloudpickle
+
+    host_tree = {k: np.asarray(v) for k, v in tree.items()}
+    t0 = time.perf_counter()
+    blob = cloudpickle.dumps(host_tree, protocol=5)
+    t_dumps = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(gets):
+        loaded = cloudpickle.loads(blob)
+        restored = {k: jax.device_put(v, sh) for k, v in loaded.items()}
+    t_loads = (time.perf_counter() - t0) / gets
+    del restored, loaded, blob, host_tree
+    out["pickle_put_gbps"] = gib / t_dumps
+    out["pickle_get_gbps"] = gib / t_loads
+    out["weights_vs_pickle_speedup"] = round(
+        (t_dumps + t_loads) / (t_put + t_get), 2
+    )
+
+    for name in ("weights_put_gbps", "weights_get_gbps"):
+        print(
+            json.dumps(
+                {
+                    "metric": name,
+                    "value": round(out[name], 2),
+                    "unit": "GiB/s",
+                    "vs_baseline": None,
+                    "tree_gib": round(gib, 2),
+                    "speedup_vs_pickle": out["weights_vs_pickle_speedup"],
+                }
+            ),
+            flush=True,
+        )
+    ray_tpu.shutdown()
+    return out
+
+
+if __name__ == "__main__":
+    main()

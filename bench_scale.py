@@ -1,0 +1,128 @@
+"""Scalability envelope suite, scaled to a single box.
+
+Port of the reference's release/benchmarks scalability envelope
+(release/benchmarks/README.md: many_actors 10k @ 738/s on 64 nodes,
+many_tasks 10k running, many_pgs 1k, 1M queued) scaled to this machine:
+actors/tasks/PGs run against a multi-raylet in-process cluster and the
+rates + thread counts are archived to SCALE_r03.json for the round
+artifact (reference archives under release/release_logs/<ver>/benchmarks/).
+
+Run: python bench_scale.py [--actors N] [--tasks N] [--pgs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int, default=200)
+    ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--pgs", type=int, default=200)
+    ap.add_argument("--queued", type=int, default=20_000)
+    ap.add_argument("--artifact", default="SCALE_r03.json")
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    out = {}
+    cluster = Cluster()
+    head = cluster.add_node(num_cpus=4)
+    for _ in range(3):
+        cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address, log_level="ERROR")
+
+    threads_before = threading.active_count()
+
+    # --- many_tasks: submission + completion throughput --------------------
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    # warm the worker pools
+    ray_tpu.get([noop.remote() for _ in range(32)], timeout=120)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(args.tasks)]
+    t_submit = time.perf_counter() - t0
+    ray_tpu.get(refs, timeout=600)
+    t_total = time.perf_counter() - t0
+    out["many_tasks"] = {
+        "n": args.tasks,
+        "submit_per_s": round(args.tasks / t_submit, 1),
+        "complete_per_s": round(args.tasks / t_total, 1),
+    }
+    del refs
+    print(json.dumps({"metric": "many_tasks_per_s", "value": out["many_tasks"]["complete_per_s"]}), flush=True)
+
+    # --- queued tasks on one node: backlog survives ------------------------
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    t0 = time.perf_counter()
+    backlog = [tiny.remote(i) for i in range(args.queued)]
+    ray_tpu.get(backlog, timeout=900)
+    out["queued_tasks"] = {
+        "n": args.queued,
+        "drain_s": round(time.perf_counter() - t0, 1),
+    }
+    del backlog
+    print(json.dumps({"metric": "queued_tasks_drain_s", "value": out["queued_tasks"]["drain_s"]}), flush=True)
+
+    # --- many_actors: creation rate + liveness -----------------------------
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self):
+            return os.getpid()
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(args.actors)]
+    pings = ray_tpu.get([a.ping.remote() for a in actors], timeout=1200)
+    t_actors = time.perf_counter() - t0
+    assert len(set(pings)) == args.actors  # one worker process per actor
+    out["many_actors"] = {
+        "n": args.actors,
+        "create_and_ping_per_s": round(args.actors / t_actors, 1),
+    }
+    print(json.dumps({"metric": "many_actors_per_s", "value": out["many_actors"]["create_and_ping_per_s"]}), flush=True)
+    for a in actors:
+        ray_tpu.kill(a)
+    del actors
+
+    # --- many_pgs: create + remove cycle ----------------------------------
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    t0 = time.perf_counter()
+    pgs = []
+    for _ in range(args.pgs):
+        pg = placement_group([{"CPU": 0.01}])
+        pg.wait(timeout_seconds=30)
+        pgs.append(pg)
+    for pg in pgs:
+        remove_placement_group(pg)
+    t_pgs = time.perf_counter() - t0
+    out["many_pgs"] = {"n": args.pgs, "create_remove_per_s": round(args.pgs / t_pgs, 1)}
+    print(json.dumps({"metric": "many_pgs_per_s", "value": out["many_pgs"]["create_remove_per_s"]}), flush=True)
+
+    # --- thread budget: the driver must not leak a thread per op -----------
+    time.sleep(2.0)
+    threads_after = threading.active_count()
+    out["threads"] = {"before": threads_before, "after": threads_after}
+    print(json.dumps({"metric": "driver_threads_delta", "value": threads_after - threads_before}), flush=True)
+
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), args.artifact), "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

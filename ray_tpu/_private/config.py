@@ -34,6 +34,9 @@ class _Config:
         "object_store_memory_bytes": 2 * 1024**3,
         "object_store_inline_max_bytes": 100 * 1024,  # small results returned inline
         "object_store_native": True,  # use the C++ shm allocator when built
+        # fallocate the shm arena up front so big puts don't pay
+        # allocate+zero page faults on first touch
+        "object_store_prealloc": True,
         "object_spilling_enabled": True,
         "object_spilling_dir": "",
         "object_store_full_retry_s": 10.0,

@@ -95,6 +95,9 @@ class _RefCollectingPickler(cloudpickle.Pickler):
         if isinstance(obj, ObjectID):
             self.refs.append(obj)
             return (ObjectID, (obj.binary(),))
+        r = serialization._maybe_reduce_device(obj)
+        if r is not None:
+            return r
         # cloudpickle implements function/class-by-value in its own
         # reducer_override — returning NotImplemented here would silently
         # fall back to by-reference pickling and break closures
@@ -674,9 +677,46 @@ class CoreWorker:
                 deps.append(v)
             else:
                 desc_kwargs[k] = ("val", v)
+        if self.plasma is not None:
+            # large value args ride the object plane, not the control RPC
+            # (reference: put_arg_in_object_store for args >100KB,
+            # _private/ray_option_utils.py) — for jax/numpy values this is
+            # also what keeps the device plane zero-copy end to end
+            for i, (kind, v) in enumerate(desc_args):
+                if kind == "val" and self._est_large(v):
+                    oid = self.put(v)
+                    desc_args[i] = ("ref", oid)
+                    deps.append(oid)
+            for k, (kind, v) in list(desc_kwargs.items()):
+                if kind == "val" and self._est_large(v):
+                    oid = self.put(v)
+                    desc_kwargs[k] = ("ref", oid)
+                    deps.append(oid)
         payload, nested = _serialize_with_refs((desc_args, desc_kwargs))
         nested = [r for r in nested if r not in deps]
         return payload, deps, nested
+
+    @staticmethod
+    def _est_large(v: Any) -> bool:
+        """Cheap size probe for the arg-promotion path: covers ndarray-like
+        leaves and shallow containers of them without serializing."""
+        limit = GlobalConfig.object_store_inline_max_bytes
+        nbytes = getattr(v, "nbytes", None)
+        if isinstance(nbytes, int):
+            return nbytes > limit
+        if isinstance(v, (list, tuple)):
+            items = v
+        elif isinstance(v, dict):
+            items = v.values()
+        else:
+            return sys.getsizeof(v) > limit
+        total = 0
+        for item in items:
+            n = getattr(item, "nbytes", None)
+            total += n if isinstance(n, int) else sys.getsizeof(item)
+            if total > limit:
+                return True
+        return False
 
     def _resolve_deps(self, deps: List[ObjectID], nested: List[ObjectID]):
         """Owner-side dependency resolution: make every dep readable by the

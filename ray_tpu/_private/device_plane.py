@@ -1,0 +1,220 @@
+"""Device object plane: zero-copy plasma ⇄ ``jax.Array``.
+
+The round-2 build staged every device value through host pickle
+(``np.asarray`` → cloudpickle → copy), losing the sharding and paying an
+extra copy on each side. This module serializes a ``jax.Array`` as its raw
+addressable shard buffers (out-of-band, 64-byte aligned in the plasma wire
+format — serialization.py) plus a compact sharding descriptor, and
+reconstructs by ``jax.device_put``-ing each shard directly from the
+shared-memory view: one device→host DMA on write, one host→device DMA on
+read, no intermediate pickle copies.
+
+Reference analogue: zero-copy numpy views onto plasma
+(python/ray/_private/serialization.py:207); the reference has no device
+object plane at all (GPU tensors stage through torch pickling), so this is
+a TPU-first extension (SURVEY.md §7 hard part (a)).
+
+Nothing here imports jax at module import time: drivers and CPU-only
+workers must not touch the TPU runtime unless user code already did.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+from typing import Any, List, Optional, Sequence, Tuple
+
+
+def jax_loaded() -> bool:
+    return "jax" in sys.modules
+
+
+def is_jax_array(obj: Any) -> bool:
+    """True iff obj is a jax.Array AND jax is already imported."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return isinstance(obj, jax.Array)
+    except Exception:  # pragma: no cover - defensive
+        return False
+
+
+def _sharding_descriptor(arr) -> Optional[dict]:
+    """A topology-independent description of the array's sharding: enough
+    to rebuild an equivalent NamedSharding on the receiving process's own
+    devices (device ids are deliberately NOT captured — the receiver may
+    be a different host of the slice)."""
+    import jax
+    from jax.sharding import NamedSharding, SingleDeviceSharding
+
+    s = arr.sharding
+    if isinstance(s, SingleDeviceSharding):
+        return {"kind": "single"}
+    if isinstance(s, NamedSharding):
+        mesh = s.mesh
+        return {
+            "kind": "named",
+            "axis_names": tuple(mesh.axis_names),
+            "mesh_shape": tuple(mesh.devices.shape),
+            "pspec": tuple(
+                tuple(p) if isinstance(p, (list, tuple)) else p
+                for p in s.spec
+            ),
+        }
+    # PositionalSharding / GSPMDSharding / ...: fall back to single-device
+    return {"kind": "single"}
+
+
+def reduce_jax_array(arr) -> Tuple[Any, tuple]:
+    """__reduce__-style entry used by the serializer's reducer_override.
+
+    Returns (rebuild_fn, args) where the shard data rides as
+    pickle.PickleBuffer objects so the protocol-5 buffer_callback lays the
+    raw bytes out-of-band in shm."""
+    import numpy as np
+
+    if not arr.is_fully_addressable:
+        # cross-host arrays can't be captured from one process; the gang
+        # trainer moves those via in-program collectives instead
+        raise ValueError(
+            "cannot serialize a non-fully-addressable jax.Array; "
+            "gather it or save per-host shards"
+        )
+    shards = sorted(
+        arr.addressable_shards, key=lambda sh: sh.device.id
+    )
+    shard_meta: List[dict] = []
+    buffers: List[pickle.PickleBuffer] = []
+    for sh in shards:
+        host = np.asarray(sh.data)  # one device->host DMA
+        if not host.flags["C_CONTIGUOUS"]:
+            host = np.ascontiguousarray(host)
+        # raw-bytes view: the buffer protocol rejects extension dtypes
+        # (bfloat16/fp8); shape+dtype live in the metadata instead
+        buffers.append(pickle.PickleBuffer(host.reshape(-1).view(np.uint8)))
+        shard_meta.append(
+            {
+                "shape": host.shape,
+                # index: tuple of slices into the global array
+                "index": tuple(
+                    (sl.start, sl.stop, sl.step) for sl in sh.index
+                ),
+            }
+        )
+    meta = {
+        "shape": tuple(arr.shape),
+        "dtype": str(arr.dtype),
+        "sharding": _sharding_descriptor(arr),
+        "shards": shard_meta,
+    }
+    return rebuild_jax_array, (meta, buffers)
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16/fp8 dtypes live here
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _rebuild_sharding(desc: dict, ndim: int):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    import numpy as np
+
+    if desc["kind"] == "named":
+        n = 1
+        for s in desc["mesh_shape"]:
+            n *= s
+        devs = jax.devices()
+        if len(devs) >= n:
+            mesh = Mesh(
+                np.array(devs[:n]).reshape(desc["mesh_shape"]),
+                desc["axis_names"],
+            )
+            pspec = PartitionSpec(
+                *(
+                    tuple(p) if isinstance(p, (list, tuple)) else p
+                    for p in desc["pspec"]
+                )
+            )
+            return NamedSharding(mesh, pspec)
+    return None  # single-device or topology mismatch: default device
+
+
+def _norm_index(idx, shape) -> tuple:
+    """Concrete ((start, stop), ...) for an index of slices (None-free)."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        out.append((start, stop))
+    return tuple(out)
+
+
+def rebuild_jax_array(meta: dict, buffers: Sequence[Any]):
+    """Reconstruct on the receiving process's devices. Buffers are
+    memoryviews into the shm object (zero-copy); device_put DMAs straight
+    from them. Shards are matched to devices by their *index* into the
+    global array (devices_indices_map), never by position — the sender's
+    device order need not exist here."""
+    import jax
+    import numpy as np
+
+    dtype = _np_dtype(meta["dtype"])
+    views = [
+        np.frombuffer(b, dtype=dtype).reshape(sm["shape"])
+        for b, sm in zip(buffers, meta["shards"])
+    ]
+    shape = tuple(meta["shape"])
+    sharding = _rebuild_sharding(meta["sharding"], len(shape))
+    if sharding is not None:
+        try:
+            # block index -> devices that need that block (replication makes
+            # this one-to-many)
+            want: dict = {}
+            for d, idx in sharding.devices_indices_map(shape).items():
+                want.setdefault(_norm_index(idx, shape), []).append(d)
+            by_key = {}
+            for v, sm in zip(views, meta["shards"]):
+                key = _norm_index(
+                    tuple(slice(*t) for t in sm["index"]), shape
+                )
+                by_key[key] = v
+            if set(want) == set(by_key):
+                arrays = [
+                    jax.device_put(by_key[key], d)
+                    for key, devs in want.items()
+                    for d in devs
+                ]
+                return jax.make_array_from_single_device_arrays(
+                    shape, sharding, arrays
+                )
+            return jax.device_put(_assemble(meta, views), sharding)
+        except Exception:
+            pass  # topology changed under us: fall through to default
+    return jax.device_put(_assemble(meta, views))
+
+
+def _assemble(meta: dict, views) -> Any:
+    """Glue shards back into one host array (fallback when the receiver
+    can't reproduce the sharding)."""
+    import numpy as np
+
+    if len(views) == 1 and views[0].shape == tuple(meta["shape"]):
+        return views[0]
+    out = np.empty(meta["shape"], dtype=views[0].dtype)
+    seen = set()
+    for v, sm in zip(views, meta["shards"]):
+        idx = tuple(slice(*tup) for tup in sm["index"])
+        if idx in seen:
+            continue  # replicated shard
+        seen.add(idx)
+        out[idx] = v
+    return out

@@ -70,7 +70,28 @@ class SerializedObject:
         return bytes(out[:n])
 
 
+def _maybe_reduce_device(obj):
+    """Device plane hook: jax.Arrays serialize as raw shard buffers +
+    sharding metadata (device_plane.py) so device_put can DMA straight
+    from shm on the other side. No-op unless jax is already imported."""
+    from ray_tpu._private import device_plane
+
+    if device_plane.is_jax_array(obj):
+        return device_plane.reduce_jax_array(obj)
+    return None
+
+
+class _Pickler(cloudpickle.Pickler):
+    def reducer_override(self, obj):
+        r = _maybe_reduce_device(obj)
+        if r is not None:
+            return r
+        return super().reducer_override(obj)
+
+
 def serialize(obj: Any, *, is_exception: bool = False) -> SerializedObject:
+    import io as _io
+
     buffers: List[memoryview] = []
 
     def callback(pb: pickle.PickleBuffer):
@@ -80,8 +101,9 @@ def serialize(obj: Any, *, is_exception: bool = False) -> SerializedObject:
         buffers.append(view)
         return False
 
-    meta = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
-    return SerializedObject(meta, buffers, FLAG_EXCEPTION if is_exception else 0)
+    f = _io.BytesIO()
+    _Pickler(f, protocol=5, buffer_callback=callback).dump(obj)
+    return SerializedObject(f.getvalue(), buffers, FLAG_EXCEPTION if is_exception else 0)
 
 
 def serialize_and_collect_refs(obj: Any, *, is_exception: bool = False):
@@ -97,12 +119,12 @@ def serialize_and_collect_refs(obj: Any, *, is_exception: bool = False):
     buffers: List[memoryview] = []
     refs = []
 
-    class _P(_cp.Pickler):
+    class _P(_Pickler):  # _Pickler adds the device-plane dispatch
         def reducer_override(self, o):
             if isinstance(o, ObjectID):
                 refs.append(o)
                 return (type(o), (o.binary(),))
-            return NotImplemented
+            return super().reducer_override(o)
 
     def callback(pb: pickle.PickleBuffer):
         view = pb.raw()

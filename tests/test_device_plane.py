@@ -1,0 +1,145 @@
+"""Device object plane: zero-copy plasma ⇄ jax.Array round trips.
+
+Reference analogue: zero-copy numpy onto plasma
+(python/ray/_private/serialization.py:207); the jax.Array sharding-aware
+extension is TPU-first (SURVEY.md §7 hard part (a))."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu._private import serialization
+
+
+def _roundtrip(obj):
+    so = serialization.serialize(obj)
+    return serialization.deserialize_from(memoryview(so.to_bytes())), so
+
+
+def test_single_device_roundtrip():
+    x = jnp.arange(1024, dtype=jnp.float32).reshape(32, 32)
+    y, so = _roundtrip(x)
+    assert isinstance(y, jax.Array)
+    # data rides out-of-band (one shard buffer), not in the pickle stream
+    assert len(so.buffers) == 1
+    assert len(so.meta) < 1024
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sharded_roundtrip_preserves_sharding():
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+    sh = NamedSharding(mesh, P("dp", "tp"))
+    x = jax.device_put(
+        jnp.arange(64 * 64, dtype=jnp.float32).reshape(64, 64), sh
+    )
+    y, so = _roundtrip(x)
+    assert len(so.buffers) == 8  # one per device shard
+    assert str(y.sharding.spec) == str(sh.spec)
+    assert len(y.sharding.mesh.devices.flat) == 8
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_bfloat16_and_replicated():
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    x = jax.device_put(
+        jnp.arange(256, dtype=jnp.bfloat16).reshape(16, 16),
+        NamedSharding(mesh, P()),
+    )
+    y, _ = _roundtrip(x)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(x, dtype=np.float32), np.asarray(y, dtype=np.float32)
+    )
+
+
+def test_state_dict_tree():
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("fsdp",))
+    sh = NamedSharding(mesh, P("fsdp"))
+    tree = {
+        "w": jax.device_put(jnp.ones((64, 8), dtype=jnp.bfloat16), sh),
+        "b": jnp.zeros(8),
+        "step": 7,
+    }
+    out, so = _roundtrip(tree)
+    assert out["step"] == 7
+    assert isinstance(out["w"], jax.Array)
+    assert str(out["w"].sharding.spec) == str(sh.spec)
+    # the large leaf's bytes must not be duplicated into the meta stream
+    assert len(so.meta) < 4096
+
+
+def test_meta_is_compact_for_large_arrays():
+    x = jnp.zeros((1024, 1024), dtype=jnp.float32)  # 4 MB
+    so = serialization.serialize(x)
+    assert sum(b.nbytes for b in so.buffers) >= 4 * 1024 * 1024
+    assert len(so.meta) < 1024  # zero-copy: stream holds only metadata
+
+
+def test_put_get_through_runtime(ray_start_regular):
+    import ray_tpu
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+    sh = NamedSharding(mesh, P("dp", None))
+    x = jax.device_put(
+        jnp.arange(128 * 64, dtype=jnp.float32).reshape(128, 64), sh
+    )
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref, timeout=30)
+    assert isinstance(y, jax.Array)
+    assert str(y.sharding.spec) == str(sh.spec)
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_worker_consumes_device_array(ray_start_regular):
+    """A worker process (own CPU jax runtime) gets the array from plasma
+    and computes on it — the cross-process broadcast path."""
+    import ray_tpu
+
+    x = jnp.arange(4096, dtype=jnp.float32)
+    ref = ray_tpu.put(x)
+
+    @ray_tpu.remote
+    def consume(arr):
+        import jax.numpy as jnp2
+
+        return float(jnp2.sum(arr))
+
+    total = ray_tpu.get(consume.remote(ref), timeout=60)
+    assert total == float(np.arange(4096, dtype=np.float32).sum())
+
+
+def test_large_arg_promoted_to_plasma(ray_start_regular):
+    """A large value arg must ride the object plane, not the control RPC
+    (reference: put_arg_in_object_store for >100KB args)."""
+    import ray_tpu
+    from ray_tpu._private.worker import get_global_worker
+
+    x = jnp.ones((1024, 1024), dtype=jnp.float32)  # 4 MB
+
+    @ray_tpu.remote
+    def consume(arr):
+        import jax.numpy as jnp2
+
+        return float(jnp2.sum(arr))
+
+    core = get_global_worker().core
+    spec_payloads = []
+    orig = core._serialize_args
+
+    def spy(args, kwargs):
+        payload, deps, nested = orig(args, kwargs)
+        spec_payloads.append((len(payload), len(deps)))
+        return payload, deps, nested
+
+    core._serialize_args = spy
+    try:
+        total = ray_tpu.get(consume.remote(x), timeout=60)
+    finally:
+        core._serialize_args = orig
+    assert total == float(1024 * 1024)
+    payload_len, n_deps = spec_payloads[0]
+    assert payload_len < 100 * 1024  # the 4MB rode plasma, not the RPC
+    assert n_deps == 1
