@@ -111,9 +111,15 @@ def main():
     print(json.dumps({"metric": "many_pgs_per_s", "value": out["many_pgs"]["create_remove_per_s"]}), flush=True)
 
     # --- thread budget: the driver must not leak a thread per op -----------
-    time.sleep(2.0)
+    time.sleep(8.0)  # let dynamic dispatch pools retire past their idle_s
     threads_after = threading.active_count()
     out["threads"] = {"before": threads_before, "after": threads_after}
+    from collections import Counter
+
+    names = Counter(
+        t.name.rstrip("0123456789-") for t in threading.enumerate()
+    )
+    out["threads"]["by_prefix"] = dict(names.most_common(12))
     print(json.dumps({"metric": "driver_threads_delta", "value": threads_after - threads_before}), flush=True)
 
     ray_tpu.shutdown()

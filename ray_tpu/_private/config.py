@@ -42,6 +42,9 @@ class _Config:
         "object_store_full_retry_s": 10.0,
         # --- scheduling ---
         "worker_lease_timeout_s": 30.0,
+        # concurrent worker startups per raylet: overlaps interpreter boot
+        # (reference: worker_pool.h maximum_startup_concurrency)
+        "worker_spawn_parallelism": 4,
         "worker_pool_prestart": 0,
         "worker_idle_timeout_s": 60.0,
         "max_workers_per_node": 64,
@@ -66,6 +69,9 @@ class _Config:
         "gcs_persistence_path": "",
         # --- rpc ---
         "rpc_connect_timeout_s": 10.0,
+        # dead-peer detection for sends is byte-based, not time-based: a
+        # connection whose unflushed send buffer exceeds
+        # 2 * rpc_max_frame_bytes is torn down (rpc._SendState._buffer)
         "rpc_max_frame_bytes": 512 * 1024**2,
         # dispatch pool size per RpcServer: large enough that long-poll
         # handlers (store gets, lease waits) cannot starve control traffic

@@ -205,6 +205,13 @@ class CoreWorker:
         self._locations: Dict[bytes, Tuple[str, int]] = {}
         self._locations_lock = threading.Lock()
         self._pulls_inflight: set = set()
+        from concurrent.futures import ThreadPoolExecutor
+
+        # 16 slots: enough that a few dead-peer pulls (each blocking up to
+        # the transfer timeout) can't starve pulls of healthy objects
+        self._pull_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="obj-pull"
+        )
         # lineage (reference: core_worker/object_recovery_manager.h:41 +
         # task_manager.h:203 ResubmitTask): plasma return oid -> the spec of
         # the task that created it, kept while local refs exist so the owner
@@ -352,7 +359,11 @@ class CoreWorker:
 
     def _start_pulls(self, object_ids: Sequence[ObjectID], timeout: Optional[float]):
         """Kick off background pulls for known-remote objects; the blocking
-        plasma get (which waits on the local seal) provides completion."""
+        plasma get (which waits on the local seal) provides completion.
+        Pulls run on a small bounded pool — a thread per pulled object
+        would mean thousands of threads at the reference's envelope scale
+        (release/benchmarks/README.md); the raylet-side transfer is the
+        actual bandwidth limiter, so a few concurrent pulls saturate it."""
         own = tuple(self.raylet.address)
         for oid in object_ids:
             loc = self._location_of(oid)
@@ -361,9 +372,7 @@ class CoreWorker:
             with self._locations_lock:
                 if oid.binary() in self._pulls_inflight:
                     continue
-            threading.Thread(
-                target=self._pull_if_remote, args=(oid, timeout), daemon=True
-            ).start()
+            self._pull_pool.submit(self._pull_if_remote, oid, timeout)
 
     def _register_ref(self, ref: ObjectID):
         import weakref
@@ -1520,6 +1529,7 @@ class CoreWorker:
         self._sweep_idle_leases(max_age=0.0)  # return every cached lease
         for _ in self._submitters:
             self._submit_queue.put(None)
+        self._pull_pool.shutdown(wait=False)
         with self._worker_clients_lock:
             for c in self._worker_clients.values():
                 c.close()

@@ -86,7 +86,18 @@ def reduce_jax_array(arr) -> Tuple[Any, tuple]:
     )
     shard_meta: List[dict] = []
     buffers: List[pickle.PickleBuffer] = []
+    seen_indices: set = set()
     for sh in shards:
+        # replicated shards carry identical blocks: serialize each distinct
+        # block once (the rebuilder fans blocks back out to every device
+        # wanting that index) — otherwise a dp-replicated tree costs
+        # replication-factor x N bytes of plasma
+        index_key = tuple(
+            (sl.start, sl.stop, sl.step) for sl in sh.index
+        )
+        if index_key in seen_indices:
+            continue
+        seen_indices.add(index_key)
         host = np.asarray(sh.data)  # one device->host DMA
         if not host.flags["C_CONTIGUOUS"]:
             host = np.ascontiguousarray(host)

@@ -28,40 +28,23 @@ class ObjectStoreFullError(Exception):
     pass
 
 
-def _start_prefault_thread(m: mmap.mmap, capacity: int, name: str = "store"):
-    """Populate the mapping's page tables in the background, so the first
-    large put/get doesn't pay ~0.3 GiB/s worth of faults inline. Reads are
-    safe against concurrent writers (they never change arena contents), and
-    after posix_fallocate every fault is a cheap minor fault. One pass,
-    then the thread exits. Gated on object_store_prealloc: with the flag
-    off the arena stays lazily allocated (shmem read faults would commit
-    the pages)."""
+_MADV_POPULATE_WRITE = 23  # linux 5.14+; not yet in the mmap module
+
+
+def _populate_range(m: mmap.mmap, offset: int, size: int):
+    """Kernel-side PTE population for [offset, offset+size): one syscall,
+    then writes into the range run at memcpy speed instead of taking a
+    minor fault per 4K page. Called just-in-time for large puts so idle
+    mappings (short-lived workers) never pay a full-arena pass."""
     if not GlobalConfig.object_store_prealloc:
-        return None
-
-    _MADV_POPULATE_WRITE = 23  # linux 5.14+; not yet in the mmap module
-
-    def loop():
-        try:
-            # kernel-side PTE population: one syscall, no GIL churn, and
-            # writes hit full memcpy speed immediately afterwards
-            m.madvise(_MADV_POPULATE_WRITE)
-            return
-        except (ValueError, OSError, AttributeError):
-            pass
-        # fallback: read 1 MiB slices (C-speed copies) to take the minor
-        # faults here instead of inside the first big put
-        step = 1 << 20
-        view = memoryview(m)
-        try:
-            for off in range(0, capacity, step):
-                bytes(view[off : off + step])
-        except (ValueError, IndexError, OSError):
-            pass  # map closed mid-pass: nothing to clean up
-
-    t = threading.Thread(target=loop, name=f"prefault-{name}", daemon=True)
-    t.start()
-    return t
+        return
+    page = mmap.PAGESIZE
+    start = (offset // page) * page
+    length = offset + size - start
+    try:
+        m.madvise(_MADV_POPULATE_WRITE, start, length)
+    except (ValueError, OSError, AttributeError):
+        pass  # older kernel: first-touch minor faults still apply
 
 
 class ObjectLostError(Exception):
@@ -236,7 +219,6 @@ class PlasmaStore:
                 pass
         self._map = mmap.mmap(self._fd, self.capacity)
         self._view = memoryview(self._map)
-        _start_prefault_thread(self._map, self.capacity, name)
         self._arena = _make_arena(self.capacity)
         self._entries: Dict[ObjectID, _Entry] = {}
         self._cv = threading.Condition()
@@ -533,7 +515,6 @@ class PlasmaClient:
         finally:
             os.close(fd)
         self._view = memoryview(self._map)
-        _start_prefault_thread(self._map, capacity, "client")
 
     def put_serialized(self, object_id: ObjectID, sobj: serialization.SerializedObject):
         size = sobj.total_size()
@@ -555,6 +536,8 @@ class PlasmaClient:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.1)
+        if size > 8 * 1024 * 1024:
+            _populate_range(self._map, offset, size)
         sobj.write_to(self._view[offset : offset + size])
         self._rpc("store_seal", object_id)
 

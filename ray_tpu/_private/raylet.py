@@ -76,6 +76,10 @@ class Raylet:
         self.labels["store_capacity"] = str(self.store.capacity)
         self.labels.setdefault("node_name", node_name)
         self._workers: Dict[WorkerID, WorkerHandle] = {}
+        # spawns reserved but not yet in _workers, keyed by (tpu, env_hash):
+        # the lease loop's parallelism gate counts these, so N racing
+        # requests can't all pass the gate while the first Popen is in flight
+        self._spawns_inflight: Dict[tuple, int] = {}
         self._res_cv = threading.Condition()
         self._peers: Dict[Tuple[str, int], RpcClient] = {}
         self._peers_lock = threading.Lock()
@@ -312,6 +316,7 @@ class Raylet:
     ):
         """The parked-request wait loop; runs with _res_cv held (the caller
         registered this request in self._demand for heartbeat reporting)."""
+        my_spawned = False  # this request's one in-flight spawn credit
         while not self._stopped.is_set():
             effective = self._expand_pg_request_locked(resources)
             have_resources = effective is not None and all(
@@ -338,11 +343,22 @@ class Raylet:
                     if not h.registered.is_set()
                     and h.tpu == need_tpu
                     and h.env_hash == env_hash
-                )
+                ) + self._spawns_inflight.get((need_tpu, env_hash), 0)
+                # each parked request holds one spawn credit, so concurrent
+                # requests overlap worker startups (up to the cap) instead
+                # of serializing on a single spawn-per-registration cycle;
+                # the spawning==0 fallback re-arms a request whose spawned
+                # worker was taken by a competing lease
                 if (
-                    spawning == 0
+                    (not my_spawned or spawning == 0)
+                    and spawning < GlobalConfig.worker_spawn_parallelism
                     and len(self._workers) < GlobalConfig.max_workers_per_node
                 ):
+                    my_spawned = True
+                    key = (need_tpu, env_hash)
+                    self._spawns_inflight[key] = (
+                        self._spawns_inflight.get(key, 0) + 1
+                    )
                     self._res_cv.release()
                     try:
                         self._spawn_worker(
@@ -351,6 +367,11 @@ class Raylet:
                         )
                     finally:
                         self._res_cv.acquire()
+                        left = self._spawns_inflight.get(key, 1) - 1
+                        if left > 0:
+                            self._spawns_inflight[key] = left
+                        else:
+                            self._spawns_inflight.pop(key, None)
             if not have_resources and allow_spill and not spill_checked:
                 # locally saturated: redirect to a node with free capacity
                 spill_checked = True
@@ -737,6 +758,8 @@ class Raylet:
                     self.store.get_locations([object_id], timeout=60.0, pin=False)
                     is not None
                 )
+            if size > 8 * 1024 * 1024:
+                object_store._populate_range(self.store._map, offset, size)
             view = self.store.view(offset, size)
             pos = 0
             try:

@@ -2,9 +2,15 @@
 
 The control plane of the runtime (GCS services, raylet leases, direct
 worker-to-worker task push) runs on this layer. Frames are length-prefixed
-pickled tuples ``(kind, msg_id, method, payload)``. The server runs a thread
-per connection; the client multiplexes request/response by ``msg_id`` and
-routes unsolicited frames (pubsub pushes) to a notification callback.
+pickled tuples ``(kind, msg_id, method, payload)``. All sockets — server
+connections and clients alike — are demultiplexed by ONE process-wide
+selector thread (the poller) with per-connection incremental frame
+parsing: connection count costs file descriptors, not threads, which is
+what lets a driver hold direct connections to thousands of actors (the
+reference's envelope is 40k actors, release/benchmarks/README.md). The
+client multiplexes request/response by ``msg_id`` and routes unsolicited
+frames (pubsub pushes) to a notification callback, in per-connection
+arrival order.
 
 This fills the role of the reference's gRPC wrappers (reference:
 src/ray/rpc/grpc_server.h, client_call.h) with a dependency-free transport;
@@ -17,10 +23,12 @@ from __future__ import annotations
 
 import itertools
 import pickle
+import selectors
 import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private.config import GlobalConfig
@@ -32,6 +40,8 @@ RESPONSE = 1
 ERROR = 2
 NOTIFY = 3
 
+_RECV_CHUNK = 1 << 18
+
 
 class RpcError(Exception):
     pass
@@ -41,43 +51,372 @@ class ConnectionLost(RpcError):
     pass
 
 
-def _send_frame(sock: socket.socket, obj: Any, lock: threading.Lock):
-    data = pickle.dumps(obj, protocol=5)
-    with lock:
-        sock.sendall(_HEADER.pack(len(data)) + data)
+class _SendState:
+    """Per-connection outbound state: a lock for frame atomicity plus a
+    buffer for bytes the kernel wouldn't take. When the buffer is non-empty
+    the poller watches the socket for writability and flushes — senders
+    NEVER block on a slow peer (a blocked send on the poller thread would
+    stall every connection in the process). A peer that stops draining
+    trips the buffer cap and the connection is declared lost."""
+
+    __slots__ = ("lock", "buf", "stream", "sock")
+
+    def __init__(self, sock: socket.socket, stream: Any):
+        self.lock = threading.Lock()
+        self.buf = bytearray()
+        self.stream = stream  # poller callbacks (on_writable/on_closed)
+        self.sock = sock
+
+    def send_frame(self, obj: Any):
+        data = pickle.dumps(obj, protocol=5)
+        payload = _HEADER.pack(len(data)) + data
+        with self.lock:
+            if self.buf:
+                self._buffer(payload)
+                return
+            view = memoryview(payload)
+            while view:
+                try:
+                    n = self.sock.send(view)
+                    view = view[n:]
+                except (BlockingIOError, InterruptedError):
+                    self._buffer(bytes(view))
+                    return
+                except OSError as e:
+                    raise ConnectionLost(str(e)) from e
+
+    def _buffer(self, tail: bytes):
+        # called under self.lock
+        if len(self.buf) + len(tail) > GlobalConfig.rpc_max_frame_bytes * 2:
+            # a partial frame may already be on the wire: the stream is
+            # unrecoverable, so tear the connection down rather than let
+            # later frames corrupt the peer's parser mid-stream
+            err = ConnectionLost("peer not draining (send buffer overflow)")
+            self.buf.clear()
+            _Poller.get().unregister(self.sock)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            try:
+                self.stream.on_closed(err)
+            except Exception:
+                pass
+            raise err
+        self.buf += tail
+        _Poller.get().watch_write(self.sock, self.stream)
+
+    def on_writable(self) -> bool:
+        """Flush buffered bytes; returns True when fully drained."""
+        with self.lock:
+            while self.buf:
+                try:
+                    n = self.sock.send(self.buf)
+                    del self.buf[:n]
+                except (BlockingIOError, InterruptedError):
+                    return False
+                except OSError as e:
+                    raise ConnectionLost(str(e)) from e
+            return True
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionLost("socket closed")
-        buf.extend(chunk)
-    return bytes(buf)
+# ---------------------------------------------------------------------------
+# the process-wide poller
+# ---------------------------------------------------------------------------
 
 
-def _recv_frame(sock: socket.socket) -> Any:
-    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
-    if length > GlobalConfig.rpc_max_frame_bytes:
-        raise RpcError(f"frame too large: {length}")
-    return pickle.loads(_recv_exact(sock, length))
+class _Poller:
+    """One selector thread demultiplexing every RPC socket in the process.
+
+    Registered objects implement ``on_readable()`` (called on the poller
+    thread; must not block — inline work only) and ``on_closed(exc)``
+    (called once when the stream dies). This is the stand-in for the
+    reference's shared gRPC completion-queue threads (grpc_server.h)."""
+
+    _instance: Optional["_Poller"] = None
+    _ilock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "_Poller":
+        with cls._ilock:
+            if cls._instance is None or not cls._instance._thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._sel = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        self._ops: list = []
+        r, w = socket.socketpair()
+        r.setblocking(False)
+        self._waker_r, self._waker_w = r, w
+        self._sel.register(r, selectors.EVENT_READ, None)
+        self._thread = threading.Thread(
+            target=self._loop, name="rpc-poller", daemon=True
+        )
+        self._thread.start()
+
+    def register(self, sock: socket.socket, stream: Any):
+        with self._lock:
+            self._ops.append(("add", sock, stream))
+        self._wake()
+
+    def unregister(self, sock: socket.socket):
+        with self._lock:
+            self._ops.append(("del", sock, None))
+        self._wake()
+
+    def watch_write(self, sock: socket.socket, stream: Any):
+        """Ask the poller to flush the stream's send buffer when the socket
+        turns writable (called by _SendState when the kernel buffer fills)."""
+        with self._lock:
+            self._ops.append(("write", sock, stream))
+        self._wake()
+
+    def _wake(self):
+        try:
+            self._waker_w.send(b"\0")
+        except OSError:
+            pass
+
+    def _loop(self):
+        while True:
+            try:
+                events = self._sel.select(timeout=1.0)
+            except OSError:
+                time.sleep(0.01)
+                continue
+            with self._lock:
+                ops, self._ops = self._ops, []
+            for op, sock, stream in ops:
+                try:
+                    if op == "add":
+                        self._sel.register(sock, selectors.EVENT_READ, stream)
+                    elif op == "write":
+                        self._sel.modify(
+                            sock,
+                            selectors.EVENT_READ | selectors.EVENT_WRITE,
+                            stream,
+                        )
+                    else:
+                        self._sel.unregister(sock)
+                except (KeyError, ValueError, OSError):
+                    pass
+            for key, mask in events:
+                stream = key.data
+                if stream is None:  # waker
+                    try:
+                        self._waker_r.recv(65536)
+                    except OSError:
+                        pass
+                    continue
+                try:
+                    if mask & selectors.EVENT_WRITE:
+                        if stream.sender.on_writable():
+                            try:
+                                self._sel.modify(
+                                    key.fileobj, selectors.EVENT_READ, stream
+                                )
+                            except (KeyError, ValueError, OSError):
+                                pass
+                    if mask & selectors.EVENT_READ:
+                        stream.on_readable()
+                except Exception as e:  # noqa: BLE001 - stream is dead
+                    try:
+                        self._sel.unregister(key.fileobj)
+                    except (KeyError, ValueError, OSError):
+                        pass
+                    exc = (
+                        e
+                        if isinstance(e, ConnectionLost)
+                        else ConnectionLost(f"{type(e).__name__}: {e}")
+                    )
+                    try:
+                        stream.on_closed(exc)
+                    except Exception:
+                        pass
+
+
+class _FrameBuffer:
+    """Incremental length-prefixed frame parser shared by both stream types."""
+
+    __slots__ = ("_rbuf",)
+
+    def __init__(self):
+        self._rbuf = bytearray()
+
+    def feed(self, sock: socket.socket, on_frame: Callable[[Any], None]):
+        """Read available bytes and dispatch every complete frame. The read
+        budget bounds work per callback: one fast data-plane connection
+        (8 MiB transfer chunks) must not monopolize the poller thread while
+        heartbeats and lease replies on other sockets go unread — the
+        level-triggered selector re-fires for the remainder."""
+        budget = 8 * _RECV_CHUNK
+        while budget > 0:
+            try:
+                chunk = sock.recv(_RECV_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as e:
+                raise ConnectionLost(str(e)) from e
+            if not chunk:
+                raise ConnectionLost("socket closed")
+            budget -= len(chunk)
+            self._rbuf += chunk
+            while True:
+                buf = self._rbuf
+                if len(buf) < _HEADER.size:
+                    break
+                (length,) = _HEADER.unpack_from(buf, 0)
+                if length > GlobalConfig.rpc_max_frame_bytes:
+                    raise RpcError(f"frame too large: {length}")
+                end = _HEADER.size + length
+                if len(buf) < end:
+                    break
+                frame = pickle.loads(memoryview(buf)[_HEADER.size : end])
+                del buf[:end]
+                on_frame(frame)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _DynamicPool:
+    """Bounded dispatch pool whose threads retire after idling.
+
+    Long-poll style handlers (worker leases, wait_for_actor, blocking
+    store gets) park a thread for their whole wait, so bursts push the
+    pool to a high-water mark; ThreadPoolExecutor never shrinks back,
+    which reads as a thread leak at envelope scale. Worker 0 is permanent
+    (guarantees liveness for items that race a retiring worker); the rest
+    exit after ``idle_s`` without work."""
+
+    def __init__(self, max_workers: int, name: str, idle_s: float = 5.0):
+        import queue as _q
+
+        self._max = max_workers
+        self._name = name
+        self._idle_s = idle_s
+        self._q: "_q.Queue" = _q.Queue()
+        self._lock = threading.Lock()
+        self._threads = 0
+        self._idle = 0
+        self._shut = False
+        self._seq = itertools.count()
+
+    def submit(self, fn, *args):
+        with self._lock:
+            if self._shut:
+                raise RuntimeError("pool is shut down")
+        self._q.put((fn, args))
+        with self._lock:
+            # spawn whenever queued work could outrun the idle workers —
+            # racing submits may both count the same idle thread, so
+            # modest overspawn is accepted (extras retire after idle_s)
+            spawn = (
+                self._threads < self._max and self._q.qsize() >= max(1, self._idle)
+            )
+            if spawn:
+                self._threads += 1
+                permanent = self._threads == 1
+        if spawn:
+            threading.Thread(
+                target=self._worker,
+                args=(permanent,),
+                name=f"{self._name}-{next(self._seq)}",
+                daemon=True,
+            ).start()
+
+    def _worker(self, permanent: bool):
+        import queue as _q
+
+        while True:
+            with self._lock:
+                self._idle += 1
+            try:
+                item = self._q.get(timeout=None if permanent else self._idle_s)
+            except _q.Empty:
+                with self._lock:
+                    if not self._q.empty():
+                        self._idle -= 1
+                        continue  # an item raced our retirement: serve it
+                    self._idle -= 1
+                    self._threads -= 1
+                return
+            with self._lock:
+                self._idle -= 1
+            if item is None:
+                with self._lock:
+                    self._threads -= 1
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "rpc handler failed on %s", self._name
+                )
+
+    def shutdown(self, wait: bool = False):
+        with self._lock:
+            self._shut = True
+            n = self._threads
+        for _ in range(n):
+            self._q.put(None)
 
 
 class ServerConn:
     """Server-side view of one client connection; supports push (NOTIFY)."""
 
-    def __init__(self, sock: socket.socket, addr):
+    def __init__(self, sock: socket.socket, addr, server: "RpcServer"):
         self.sock = sock
         self.addr = addr
-        self.send_lock = threading.Lock()
         self.closed = threading.Event()
         self.meta: Dict[str, Any] = {}  # handler-attached state (e.g. worker id)
+        self._server = server
+        self._frames = _FrameBuffer()
+        self.sender = _SendState(sock, self)
+
+    # -- poller interface ----------------------------------------------
+
+    def on_readable(self):
+        self._frames.feed(self.sock, self._on_frame)
+
+    def _on_frame(self, frame):
+        kind, msg_id, method, payload = frame
+        if kind != REQUEST:
+            return
+        srv = self._server
+        if method in srv._inline:
+            # order-sensitive handlers run right here on the poller thread
+            # (non-blocking by contract; a Deferred reply is sent by its
+            # resolving thread) — arrival order is execution order
+            srv._dispatch_inline(self, msg_id, method, payload)
+        else:
+            srv._pool.submit(srv._dispatch, self, msg_id, method, payload)
+
+    def on_closed(self, exc: Exception):
+        srv = self._server
+        with srv._conns_lock:
+            srv._conns.pop(id(self), None)
+        first = not self.closed.is_set()
+        self.closed.set()
+        if first and srv.on_disconnect is not None:
+            # disconnect handlers may block (lease cleanup, actor death
+            # reporting): keep them off the poller thread
+            try:
+                srv._pool.submit(srv._run_disconnect, self)
+            except RuntimeError:
+                pass  # pool shut down: server is stopping anyway
 
     def notify(self, method: str, payload: Any):
         try:
-            _send_frame(self.sock, (NOTIFY, 0, method, payload), self.send_lock)
-        except OSError:
+            self.sender.send_frame((NOTIFY, 0, method, payload))
+        except (ConnectionLost, OSError):
             self.closed.set()
 
     def close(self):
@@ -126,29 +465,25 @@ class Deferred:
 
 
 class RpcServer:
-    """RPC server with a shared dispatch thread pool.
+    """RPC server: connections are read by the shared poller; handlers run
+    on a bounded dispatch pool.
 
     Handlers: ``fn(conn: ServerConn, payload) -> reply``. Raising inside a
     handler sends an ERROR frame carrying the exception.
 
-    Handlers registered with ``inline=True`` run on the connection's read
-    loop itself — they must be non-blocking and are used where arrival
-    order matters (ordered actor queues, reference:
+    Handlers registered with ``inline=True`` run on the poller thread
+    itself — they must be non-blocking and are used where arrival order
+    matters (ordered actor queues, reference:
     core_worker/transport/actor_scheduling_queue.cc). An inline handler
-    may return a ``Deferred`` whose resolution is awaited on a pool thread.
-
-    The pool reuses threads: a thread per request both thrashed the
-    1-core host and crashed pyarrow's mimalloc in mi_thread_init.
+    may return a ``Deferred`` whose resolution is sent by the resolver.
     """
 
     def __init__(self, name: str = "rpc", host: str = "127.0.0.1", port: int = 0):
-        from concurrent.futures import ThreadPoolExecutor
-
         self.name = name
         self._handlers: Dict[str, Callable[[ServerConn, Any], Any]] = {}
         self._inline: set = set()
-        self._pool = ThreadPoolExecutor(
-            max_workers=GlobalConfig.rpc_dispatch_threads, thread_name_prefix=f"{name}-h"
+        self._pool = _DynamicPool(
+            GlobalConfig.rpc_dispatch_threads, f"{name}-h"
         )
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -175,7 +510,7 @@ class RpcServer:
 
     def register_all(self, obj: Any, prefix: str = ""):
         """Register every ``rpc_<name>`` method of obj as handler ``<name>``;
-        methods listed in obj.RPC_INLINE run on the connection read loop."""
+        methods listed in obj.RPC_INLINE run on the poller thread."""
         inline_set = set(getattr(obj, "RPC_INLINE", ()))
         for attr in dir(obj):
             if attr.startswith("rpc_"):
@@ -189,50 +524,25 @@ class RpcServer:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = ServerConn(sock, addr)
+            sock.setblocking(False)
+            conn = ServerConn(sock, addr, self)
             with self._conns_lock:
                 self._conns[id(conn)] = conn
-            threading.Thread(
-                target=self._serve_conn, args=(conn,), name=f"{self.name}-conn", daemon=True
-            ).start()
+            _Poller.get().register(sock, conn)
 
-    def _serve_conn(self, conn: ServerConn):
-        # Each request runs in its own thread so blocking handlers (long-poll
-        # store gets, worker leases) never head-of-line-block a connection.
-        # Ordering guarantees (e.g. actor task seq-no ordering) are enforced
-        # by the handlers themselves, as in the reference's scheduling queues.
+    def _run_disconnect(self, conn: ServerConn):
         try:
-            while not self._stopped.is_set():
-                kind, msg_id, method, payload = _recv_frame(conn.sock)
-                if kind != REQUEST:
-                    continue
-                if method in self._inline:
-                    self._dispatch_inline(conn, msg_id, method, payload)
-                else:
-                    self._pool.submit(self._dispatch, conn, msg_id, method, payload)
-        except (ConnectionLost, OSError):
+            self.on_disconnect(conn)
+        except Exception:
             pass
-        except RuntimeError:
-            pass  # pool shut down during server stop
-        finally:
-            with self._conns_lock:
-                self._conns.pop(id(conn), None)
-            conn.closed.set()
-            if self.on_disconnect is not None:
-                try:
-                    self.on_disconnect(conn)
-                except Exception:
-                    pass
 
     def _dispatch_inline(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
-        """Run an order-sensitive handler on the read loop; a Deferred reply
-        is awaited on a pool thread so the loop keeps draining frames."""
         handler = self._handlers[method]
         try:
             reply = handler(conn, payload)
         except Exception as e:  # noqa: BLE001
             try:
-                _send_frame(conn.sock, (ERROR, msg_id, method, e), conn.send_lock)
+                conn.sender.send_frame((ERROR, msg_id, method, e))
             except (ConnectionLost, OSError):
                 conn.closed.set()
             return
@@ -240,7 +550,7 @@ class RpcServer:
             reply.on_resolve(self._deferred_sender(conn, msg_id, method))
         else:
             try:
-                _send_frame(conn.sock, (RESPONSE, msg_id, method, reply), conn.send_lock)
+                conn.sender.send_frame((RESPONSE, msg_id, method, reply))
             except (ConnectionLost, OSError):
                 conn.closed.set()
 
@@ -248,7 +558,7 @@ class RpcServer:
         def _send(d: Deferred):
             try:
                 kind = ERROR if d.is_error else RESPONSE
-                _send_frame(conn.sock, (kind, msg_id, method, d.value), conn.send_lock)
+                conn.sender.send_frame((kind, msg_id, method, d.value))
             except (ConnectionLost, OSError):
                 conn.closed.set()
 
@@ -263,17 +573,17 @@ class RpcServer:
             if isinstance(reply, Deferred):
                 reply.on_resolve(self._deferred_sender(conn, msg_id, method))
                 return
-            _send_frame(conn.sock, (RESPONSE, msg_id, method, reply), conn.send_lock)
+            conn.sender.send_frame((RESPONSE, msg_id, method, reply))
         except (ConnectionLost, OSError):
             conn.closed.set()
         except Exception as e:  # noqa: BLE001 - forwarded to caller
             try:
-                _send_frame(conn.sock, (ERROR, msg_id, method, e), conn.send_lock)
+                conn.sender.send_frame((ERROR, msg_id, method, e))
             except (ConnectionLost, OSError):
                 conn.closed.set()
             except Exception:
-                _send_frame(
-                    conn.sock, (ERROR, msg_id, method, RpcError(repr(e))), conn.send_lock
+                conn.sender.send_frame(
+                    (ERROR, msg_id, method, RpcError(repr(e)))
                 )
 
     def stop(self):
@@ -285,15 +595,178 @@ class RpcServer:
         with self._conns_lock:
             conns = list(self._conns.values())
         for c in conns:
+            _Poller.get().unregister(c.sock)
             c.close()
         self._pool.shutdown(wait=False)
 
 
-class _CallbackExecutor:
-    """Small shared pool that runs RPC completion callbacks off the reader
-    threads, so a slow callback can't stall response demultiplexing."""
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
 
-    def __init__(self, num_threads: int = 2):
+
+class RpcClient:
+    """Blocking RPC client with response multiplexing and notify routing.
+    Reads happen on the shared poller; sync callers park on an event,
+    async completions and notifies run on the callback executor (notifies
+    in per-connection arrival order)."""
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        on_notify: Optional[Callable[[str, Any], None]] = None,
+        connect_timeout: Optional[float] = None,
+    ):
+        timeout = connect_timeout or GlobalConfig.rpc_connect_timeout_s
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection(address, timeout=timeout)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"cannot connect to {address}: {e}") from e
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.setblocking(False)
+        self.address = address
+        self.sender = _SendState(self._sock, self)
+        self._pending: Dict[int, Any] = {}
+        self._pending_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._on_notify = on_notify
+        self._closed = threading.Event()
+        self._frames = _FrameBuffer()
+        self._notify_q: deque = deque()
+        self._notify_draining = False
+        _Poller.get().register(self._sock, self)
+
+    # -- poller interface ----------------------------------------------
+
+    def on_readable(self):
+        self._frames.feed(self._sock, self._on_frame)
+
+    def _on_frame(self, frame):
+        kind, msg_id, method, payload = frame
+        if kind == NOTIFY:
+            if self._on_notify is not None:
+                self._enqueue_notify(method, payload)
+            return
+        with self._pending_lock:
+            slot = self._pending.pop(msg_id, None)
+        if slot is None:
+            return
+        if "callback" in slot:
+            _get_callback_executor().submit(slot["callback"], kind, payload)
+        else:
+            slot["result"] = (kind, payload)
+            slot["event"].set()
+
+    def on_closed(self, exc: Exception):
+        self._closed.set()
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        err = exc if isinstance(exc, ConnectionLost) else ConnectionLost(str(exc))
+        for slot in pending.values():
+            if "callback" in slot:
+                _get_callback_executor().submit(slot["callback"], ERROR, err)
+            else:
+                slot["result"] = (ERROR, err)
+                slot["event"].set()
+
+    # notifies drain on the callback executor, one at a time per client,
+    # preserving arrival order (pubsub consumers rely on state-transition
+    # order) while keeping user callbacks off the poller thread
+    def _enqueue_notify(self, method: str, payload: Any):
+        with self._pending_lock:
+            self._notify_q.append((method, payload))
+            if self._notify_draining:
+                return
+            self._notify_draining = True
+        _get_callback_executor().submit(self._drain_notifies)
+
+    def _drain_notifies(self):
+        # bounded burst, then requeue: a client with a sustained notify
+        # stream must not pin a shared executor thread indefinitely and
+        # starve other clients' completions
+        for _ in range(64):
+            with self._pending_lock:
+                if not self._notify_q:
+                    self._notify_draining = False
+                    return
+                method, payload = self._notify_q.popleft()
+            try:
+                self._on_notify(method, payload)
+            except Exception:
+                pass
+        _get_callback_executor().submit(self._drain_notifies)
+
+    # -- public API ----------------------------------------------------
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        if self._closed.is_set():
+            raise ConnectionLost(f"connection to {self.address} closed")
+        msg_id = next(self._ids)
+        slot = {"event": threading.Event(), "result": None}
+        with self._pending_lock:
+            self._pending[msg_id] = slot
+        try:
+            self.sender.send_frame((REQUEST, msg_id, method, payload))
+        except (ConnectionLost, OSError) as e:
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise ConnectionLost(str(e)) from e
+        if not slot["event"].wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            raise TimeoutError(f"rpc {method} to {self.address} timed out after {timeout}s")
+        with self._pending_lock:
+            self._pending.pop(msg_id, None)
+        kind, payload = slot["result"]
+        if kind == ERROR:
+            raise payload
+        return payload
+
+    def call_async(self, method: str, payload: Any, callback: Callable[[int, Any], None]):
+        """Fire a request; ``callback(kind, payload)`` runs on the shared
+        callback executor when the response (or connection error) arrives."""
+        if self._closed.is_set():
+            _get_callback_executor().submit(
+                callback, ERROR, ConnectionLost(f"connection to {self.address} closed")
+            )
+            return
+        msg_id = next(self._ids)
+        with self._pending_lock:
+            self._pending[msg_id] = {"callback": callback}
+        try:
+            self.sender.send_frame((REQUEST, msg_id, method, payload))
+        except (ConnectionLost, OSError) as e:
+            with self._pending_lock:
+                self._pending.pop(msg_id, None)
+            _get_callback_executor().submit(callback, ERROR, ConnectionLost(str(e)))
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+    def close(self):
+        _Poller.get().unregister(self._sock)
+        was_closed = self._closed.is_set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if not was_closed:
+            self.on_closed(ConnectionLost(f"connection to {self.address} closed"))
+
+
+class _CallbackExecutor:
+    """Small shared pool that runs RPC completion callbacks off the poller
+    thread, so a slow callback can't stall frame demultiplexing."""
+
+    def __init__(self, num_threads: int = 4):
         import queue as _q
 
         self._q: "_q.Queue" = _q.Queue()
@@ -326,128 +799,3 @@ def _get_callback_executor() -> _CallbackExecutor:
         if _callback_executor is None:
             _callback_executor = _CallbackExecutor()
         return _callback_executor
-
-
-class RpcClient:
-    """Blocking RPC client with response multiplexing and notify routing."""
-
-    def __init__(
-        self,
-        address: Tuple[str, int],
-        on_notify: Optional[Callable[[str, Any], None]] = None,
-        connect_timeout: Optional[float] = None,
-    ):
-        timeout = connect_timeout or GlobalConfig.rpc_connect_timeout_s
-        deadline = time.monotonic() + timeout
-        last_err: Optional[Exception] = None
-        while True:
-            try:
-                self._sock = socket.create_connection(address, timeout=timeout)
-                break
-            except OSError as e:
-                last_err = e
-                if time.monotonic() > deadline:
-                    raise ConnectionLost(f"cannot connect to {address}: {e}") from e
-                time.sleep(0.05)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)
-        self.address = address
-        self._send_lock = threading.Lock()
-        self._pending: Dict[int, Any] = {}
-        self._pending_lock = threading.Lock()
-        self._ids = itertools.count(1)
-        self._on_notify = on_notify
-        self._closed = threading.Event()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
-        self._reader.start()
-
-    def _read_loop(self):
-        try:
-            while True:
-                kind, msg_id, method, payload = _recv_frame(self._sock)
-                if kind == NOTIFY:
-                    if self._on_notify is not None:
-                        try:
-                            self._on_notify(method, payload)
-                        except Exception:
-                            pass
-                    continue
-                with self._pending_lock:
-                    slot = self._pending.pop(msg_id, None)
-                if slot is None:
-                    continue
-                if "callback" in slot:
-                    _get_callback_executor().submit(slot["callback"], kind, payload)
-                else:
-                    slot["result"] = (kind, payload)
-                    slot["event"].set()
-        except (ConnectionLost, OSError, EOFError):
-            pass
-        finally:
-            self._closed.set()
-            with self._pending_lock:
-                pending, self._pending = self._pending, {}
-            err = ConnectionLost(f"connection to {self.address} lost")
-            for slot in pending.values():
-                if "callback" in slot:
-                    _get_callback_executor().submit(slot["callback"], ERROR, err)
-                else:
-                    slot["result"] = (ERROR, err)
-                    slot["event"].set()
-
-    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
-        if self._closed.is_set():
-            raise ConnectionLost(f"connection to {self.address} closed")
-        msg_id = next(self._ids)
-        slot = {"event": threading.Event(), "result": None}
-        with self._pending_lock:
-            self._pending[msg_id] = slot
-        try:
-            _send_frame(self._sock, (REQUEST, msg_id, method, payload), self._send_lock)
-        except OSError as e:
-            with self._pending_lock:
-                self._pending.pop(msg_id, None)
-            raise ConnectionLost(str(e)) from e
-        if not slot["event"].wait(timeout):
-            with self._pending_lock:
-                self._pending.pop(msg_id, None)
-            raise TimeoutError(f"rpc {method} to {self.address} timed out after {timeout}s")
-        with self._pending_lock:
-            self._pending.pop(msg_id, None)
-        kind, payload = slot["result"]
-        if kind == ERROR:
-            raise payload
-        return payload
-
-    def call_async(self, method: str, payload: Any, callback: Callable[[int, Any], None]):
-        """Fire a request; ``callback(kind, payload)`` runs on the shared
-        callback executor when the response (or connection error) arrives."""
-        if self._closed.is_set():
-            _get_callback_executor().submit(
-                callback, ERROR, ConnectionLost(f"connection to {self.address} closed")
-            )
-            return
-        msg_id = next(self._ids)
-        with self._pending_lock:
-            self._pending[msg_id] = {"callback": callback}
-        try:
-            _send_frame(self._sock, (REQUEST, msg_id, method, payload), self._send_lock)
-        except OSError as e:
-            with self._pending_lock:
-                self._pending.pop(msg_id, None)
-            _get_callback_executor().submit(callback, ERROR, ConnectionLost(str(e)))
-
-    @property
-    def closed(self) -> bool:
-        return self._closed.is_set()
-
-    def close(self):
-        self._closed.set()
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass

@@ -130,20 +130,59 @@ def _rotary(x: jax.Array, positions: jax.Array, rotary_dim: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+class _DenseND(nn.Module):
+    """DenseGeneral equivalent that initializes the kernel at its FULL
+    shape. flax's DenseGeneral initializes a flattened 2-D kernel and
+    reshapes afterwards, which breaks logical partitioning metadata inside
+    manual-mesh regions (the rank-2 flat kernel gets constrained with the
+    rank-N spec during scope.param's eval_shape revalidation) — the
+    pipeline stages run exactly there. Same param names/shapes/math as
+    DenseGeneral contracting the trailing input dims."""
+
+    features: Tuple[int, ...]
+    logical_axes: Tuple[str, ...]
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        n_in = len(self.logical_axes) - len(self.features)
+        in_shape = x.shape[-n_in:]
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), self.logical_axes
+            ),
+            in_shape + tuple(self.features),
+            self.param_dtype,
+        )
+        y = jax.lax.dot_general(
+            x.astype(self.dtype),
+            kernel.astype(self.dtype),
+            ((tuple(range(x.ndim - n_in, x.ndim)), tuple(range(n_in))), ((), ())),
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), self.logical_axes[n_in:]
+                ),
+                tuple(self.features),
+                self.param_dtype,
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 def _dense(features: Tuple[int, ...], logical_axes: Tuple[str, ...], cfg: GPTConfig,
            name: str, use_bias: bool = True):
-    return nn.DenseGeneral(
-        features=features,
-        axis=-1 if len(logical_axes) - len(features) == 1 else (-2, -1),
+    return _DenseND(
+        features=tuple(features) if isinstance(features, tuple) else (features,),
+        logical_axes=logical_axes,
         use_bias=use_bias,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
-        kernel_init=nn.with_logical_partitioning(
-            nn.initializers.normal(stddev=0.02), logical_axes
-        ),
-        bias_init=nn.with_logical_partitioning(
-            nn.initializers.zeros_init(), logical_axes[len(logical_axes) - len(features):]
-        ),
         name=name,
     )
 

@@ -100,9 +100,16 @@ def make_mesh(
         per_slice = tuple(
             s // d for s, d in zip(shape, dcn_shape)
         )
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice, dcn_shape, devices=devices, allow_split_physical_axes=True
-        )
+        if hasattr(devices[0], "slice_index"):
+            # real multi-slice topology: configuration errors must surface
+            # (a silent reshape would put tp/fsdp collectives on DCN)
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn_shape, devices=devices, allow_split_physical_axes=True
+            )
+        else:
+            # virtual CPU fixtures have no slice_index attribute: emulate
+            # the slice split with a plain reshape (outermost dp = DCN)
+            dev_array = np.asarray(devices).reshape(shape)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(

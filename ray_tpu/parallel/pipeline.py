@@ -83,12 +83,12 @@ def pipeline_apply(
     if remat:
         layer_apply = jax.checkpoint(layer_apply)
 
-    data_axes: Tuple[str, ...] = tuple(
-        a for a in ("dp", "fsdp") if mesh.shape.get(a, 1) > 1
-    )
-    mb_spec = jax.tree.map(
-        lambda _: P(None, data_axes) if data_axes else P(), x_mb
-    )
+    # Partial-manual shard_map: only ``pp`` is a manual axis (the ppermute
+    # ring), every other mesh axis stays GSPMD-auto, so the tensor/fsdp/
+    # sequence shardings carried by the layer's own constraint annotations
+    # compose with the pipeline instead of being erased — specs therefore
+    # mention only the pp placement of each operand.
+    mb_spec = jax.tree.map(lambda _: P(), x_mb)  # replicated over pp
     param_spec = jax.tree.map(lambda _: P("pp"), stage_params)
 
     def per_stage(params, x):
@@ -136,7 +136,14 @@ def pipeline_apply(
             )
             return (y, out_buf), None
 
-        init = (zero, jax.tree.map(jnp.zeros_like, x))
+        # the carry becomes pp-varying inside the loop (each stage computes
+        # its own activations); mark the zero init accordingly for vma
+        def _varying(t):
+            return jax.tree.map(
+                lambda v: lax.pcast(v, ("pp",), to="varying"), t
+            )
+
+        init = (_varying(zero), _varying(jax.tree.map(jnp.zeros_like, x)))
         (_, out_buf), _ = lax.scan(tick, init, jnp.arange(M + S - 1))
         # result lives on the last stage only; replicate it over pp
         return jax.tree.map(
@@ -148,7 +155,9 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(param_spec, mb_spec),
         out_specs=mb_spec,
-        check_vma=False,
+        axis_names=frozenset({"pp"}),
+        # vma checking must stay ON: with it off, partial-manual mode
+        # requires every mesh axis in out_specs (defeating auto sharding)
     )(stage_params, x_mb)
 
 
@@ -159,31 +168,41 @@ def make_pp_train_step(
     *,
     num_microbatches: int = 4,
     donate: bool = True,
+    rules=None,
+    state_shardings_tree: Any = None,
 ) -> Callable:
     """Pipelined GPT train step: embed → pipelined blocks → blockwise loss.
 
     The embedding/final-norm/lm-head run outside the shard_map (replicated
     over pp, sharded over dp/fsdp/tp via the usual logical rules); only the
-    homogeneous transformer stack is pipelined. Requires
-    ``cfg.scan_layers=True`` (stacked [num_layers, ...] block params) and
-    ``num_layers % pp == 0``.
+    homogeneous transformer stack is pipelined. pp composes with fsdp/tp:
+    the shard_map is manual over ``pp`` alone, so the Block's logical-axis
+    constraints (heads/mlp → tp, embed → fsdp) shard each stage's compute
+    under GSPMD exactly as in the non-pipelined step. Pass
+    ``state_shardings_tree`` from ``init_sharded_state(..., rules=
+    shd.pp_rules())`` so params/opt-state are pp×fsdp×tp sharded at rest.
+    Requires ``cfg.scan_layers=True`` (stacked [num_layers, ...] block
+    params) and ``num_layers % pp == 0``.
     """
+    import flax.linen as nn
     import optax
 
     from ray_tpu.models.gpt import Block, blockwise_next_token_loss
     from ray_tpu.models.training import TrainState
+    from ray_tpu.parallel import sharding as shd
 
     if not cfg.scan_layers:
         raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
     S = int(mesh.shape.get("pp", 1))
     block = Block(cfg)
+    active_rules = list(rules if rules is not None else shd.pp_rules())
 
     def layer_apply(layer_params, xp):
         x, positions = xp
         y = block.apply({"params": layer_params}, x, positions)
         return (y, positions)
 
-    def loss_fn(params, tokens):
+    def _loss_fn(params, tokens):
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
         )
@@ -214,6 +233,13 @@ def make_pp_train_step(
             y, head["kernel"], head["bias"], tokens
         )
 
+    def loss_fn(params, tokens):
+        # install the logical rule table so Block's with_logical_constraint
+        # calls shard stage-internal matmuls over tp/fsdp (silent no-ops
+        # without rules — then pp would run unsharded stages)
+        with nn.logical_axis_rules(active_rules):
+            return _loss_fn(params, tokens)
+
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
@@ -224,4 +250,12 @@ def make_pp_train_step(
             metrics,
         )
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    kwargs = {}
+    if state_shardings_tree is not None:
+        data_sharding = shd.batch_sharding(mesh, ndim=2, rules=active_rules)
+        kwargs["in_shardings"] = (state_shardings_tree, data_sharding)
+        kwargs["out_shardings"] = (
+            state_shardings_tree,
+            NamedSharding(mesh, P()),
+        )
+    return jax.jit(step, donate_argnums=(0,) if donate else (), **kwargs)

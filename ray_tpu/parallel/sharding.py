@@ -45,6 +45,16 @@ def rules_dict(rules: Optional[Rules] = None) -> Dict[str, Any]:
     return dict(rules if rules is not None else DEFAULT_RULES)
 
 
+def pp_rules(rules: Optional[Rules] = None) -> Rules:
+    """Rule table for pipeline-parallel training: the scanned layer axis
+    maps onto ``pp`` so each stage's device row holds only its own layers'
+    parameters (and optimizer moments), composing with fsdp/tp from the
+    base rules."""
+    table = rules_dict(rules)
+    table["layers"] = "pp"
+    return tuple(table.items())
+
+
 def logical_to_spec(
     logical_axes: Sequence[Optional[str]],
     rules: Optional[Rules] = None,

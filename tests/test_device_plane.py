@@ -143,3 +143,22 @@ def test_large_arg_promoted_to_plasma(ray_start_regular):
     payload_len, n_deps = spec_payloads[0]
     assert payload_len < 100 * 1024  # the 4MB rode plasma, not the RPC
     assert n_deps == 1
+
+
+def test_replicated_shards_deduplicated():
+    """A replicated array serializes one copy of each distinct block, not
+    one per device (a dp-replicated 2 GiB tree must not cost 8x plasma)."""
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+    x = jax.device_put(
+        jnp.ones((64, 64), dtype=jnp.float32), NamedSharding(mesh, P())
+    )
+    so = serialization.serialize(x)
+    assert len(so.buffers) == 1
+    assert sum(b.nbytes for b in so.buffers) == 64 * 64 * 4
+    y = serialization.deserialize_from(memoryview(so.to_bytes()))
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # partially replicated: dp shards rows, replication across nothing else
+    sh = NamedSharding(mesh, P("dp"))
+    xs = jax.device_put(jnp.arange(64 * 8, dtype=jnp.float32).reshape(64, 8), sh)
+    so = serialization.serialize(xs)
+    assert len(so.buffers) == 8  # all blocks distinct

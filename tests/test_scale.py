@@ -1,0 +1,101 @@
+"""Scalability envelope smoke tests (pytest-sized).
+
+The full envelope runs in bench_scale.py and is archived as SCALE_r03.json;
+these shrunken versions guard the two properties the envelope depends on:
+bounded thread usage (no thread-per-op anywhere on the task/actor/pull
+paths) and survival of a deep submission backlog. Reference:
+release/benchmarks/README.md (many_actors / many_tasks / many_pgs),
+release/release_logs/2.4.0/benchmarks/."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def scale_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+    ray_tpu.init(address=cluster.address, log_level="ERROR")
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+
+def test_task_burst_thread_stability(scale_cluster):
+    """2k tasks must not grow the driver's thread count: submission,
+    pulls, and dispatch all run on bounded pools."""
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(16)], timeout=120)  # warm pool
+    before = threading.active_count()
+    ray_tpu.get([noop.remote() for _ in range(2000)], timeout=300)
+    after = threading.active_count()
+    # dynamic dispatch pools may be at a (bounded) high-water mark; the
+    # budget asserts no per-task growth (2000 tasks << 40 threads)
+    assert after - before < 40, (before, after)
+
+
+def test_actor_burst_and_teardown(scale_cluster):
+    """A burst of actors all lands, pings, and tears down; thread count
+    settles back under a fixed budget afterwards (per-actor connections
+    cost fds, not threads — rpc poller)."""
+
+    @ray_tpu.remote(num_cpus=0.01)
+    class A:
+        def ping(self):
+            return 1
+
+    before = threading.active_count()
+    actors = [A.remote() for _ in range(24)]
+    assert ray_tpu.get(
+        [a.ping.remote() for a in actors], timeout=300
+    ) == [1] * 24
+    for a in actors:
+        ray_tpu.kill(a)
+    del actors
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if threading.active_count() - before < 30:
+            break
+        time.sleep(1.0)
+    after = threading.active_count()
+    assert after - before < 30, (before, after)
+
+
+def test_deep_backlog_drains(scale_cluster):
+    """A queue of 5k tasks against 8 CPUs drains without wedging or
+    starving (reference single-node envelope: 1M queued tasks)."""
+
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    refs = [tiny.remote(i) for i in range(5000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out[0] == 0 and out[-1] == 4999 and len(out) == 5000
+
+
+def test_pg_churn(scale_cluster):
+    """Placement groups create+remove in a tight loop without leaking
+    bundles or threads."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    before = threading.active_count()
+    for _ in range(60):
+        pg = placement_group([{"CPU": 0.01}])
+        assert pg.wait(timeout_seconds=30)
+        remove_placement_group(pg)
+    assert threading.active_count() - before < 20
