@@ -28,6 +28,20 @@ def main():
     ap.add_argument("--artifact", default="SCALE_r03.json")
     args = ap.parse_args()
 
+    from ray_tpu._private.config import GlobalConfig
+
+    # the envelope needs one worker process per actor: lift the per-node
+    # cap to cover the target (the reference's many_actors runs ~156
+    # workers/node on its 64-node cluster). Goes through the registry so
+    # the cluster config (and any out-of-process node) sees it too.
+    GlobalConfig.initialize(
+        {
+            "max_workers_per_node": max(
+                GlobalConfig.max_workers_per_node, args.actors // 4 + 40
+            )
+        }
+    )
+
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 

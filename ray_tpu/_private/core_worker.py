@@ -137,8 +137,18 @@ class CoreWorker:
         self._task_ctx = threading.local()
 
         self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
-        self.gcs.call("subscribe", "actors")  # actor address/state updates
-        self.gcs.call("subscribe", "nodes")  # node death -> drop stale addrs
+        if mode == "driver":
+            # proactive actor-cache updates are a driver-side optimization;
+            # at N workers the wholesale subscription turns every actor
+            # event into N pubsub frames (quadratic at envelope scale).
+            # Workers resolve actors on demand (wait_for_actor) and
+            # invalidate their caches on ConnectionLost.
+            self.gcs.call("subscribe", "actors")  # actor address/state
+        # node events are rare (node count, not op count) and every worker
+        # needs them: the pull failure path leaves stale locations in place
+        # and relies on node-removed to mark objects lost for lineage
+        # recovery (_on_gcs_notify "nodes")
+        self.gcs.call("subscribe", "nodes")
         self.captured_logs: "deque" = deque(maxlen=1000)
         if mode == "driver" and GlobalConfig.log_to_driver:
             # worker stdout/stderr streamed back via the log monitors
@@ -244,6 +254,13 @@ class CoreWorker:
         import collections as _collections
 
         self._gc_pending: "_collections.deque" = _collections.deque()
+        # finalizer->gc-thread wakeup rides a pipe: os.write is a plain
+        # syscall, usable from a weakref finalizer with zero lock risk
+        # (an Event would deadlock if GC ran a finalizer on the gc thread
+        # inside Event.wait, which holds the Event's condition lock)
+        self._gc_r, self._gc_w = os.pipe()
+        os.set_blocking(self._gc_r, False)
+        os.set_blocking(self._gc_w, False)
         self._gc_thread = threading.Thread(
             target=self._ref_gc_loop, name="ref-gc", daemon=True
         )
@@ -389,15 +406,30 @@ class CoreWorker:
         or making an RPC here can deadlock the whole process (observed: GC
         fired inside ThreadPoolExecutor.submit on the rpc server pool, and
         the plasma-delete RPC it then issued could never be dispatched).
-        deque.append is atomic; the ref-gc thread does the real work."""
+        deque.append is atomic; the pipe write is a raw syscall (EAGAIN
+        when full is fine — the gc thread is already awake then); the
+        ref-gc thread does the real work."""
         self._gc_pending.append(binary)
+        try:
+            os.write(self._gc_w, b"x")
+        except (BlockingIOError, OSError):
+            pass
 
     def _ref_gc_loop(self):
+        # event-driven, not polled: hundreds of idle workers each waking
+        # 20x/s to check an empty deque measurably loads a small host
+        import select as _select
+
         while not self._shutdown.is_set():
             try:
                 binary = self._gc_pending.popleft()
             except IndexError:
-                time.sleep(0.05)
+                try:
+                    ready, _, _ = _select.select([self._gc_r], [], [], 5.0)
+                    if ready:
+                        os.read(self._gc_r, 4096)  # drain wakeup bytes
+                except OSError:
+                    pass
                 continue
             try:
                 self._process_ref_deleted(binary)
@@ -992,7 +1024,7 @@ class CoreWorker:
     def _submit_loop(self):
         while not self._shutdown.is_set():
             try:
-                spec = self._submit_queue.get(timeout=0.5)
+                spec = self._submit_queue.get(timeout=5.0)
             except queue.Empty:
                 continue
             if spec is None:
@@ -1280,7 +1312,10 @@ class CoreWorker:
             # from the ordered stream, or _pump_actor waits forever for a
             # seq that will never enter its heap
             seq = -1
-        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(num_returns)]
+        # "dynamic" has one static return: the ObjectRefGenerator (same
+        # contract as normal tasks — reference: _raylet.pyx generators)
+        n_static = 1 if num_returns == "dynamic" else num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i + 1) for i in range(n_static)]
         spec = {
             "task_id": task_id,
             "job_id": self.job_id,

@@ -28,6 +28,14 @@ def main():
     from ray_tpu._private.task_executor import TaskExecutor
     import ray_tpu._private.worker as worker_mod
 
+    from ray_tpu._private import rpc as rpc_mod
+
+    token = rpc_mod.load_or_create_token(
+        os.environ.get("RAYTPU_SESSION_DIR", "/tmp")
+    ) or os.environ.get("RAYTPU_AUTH_TOKEN")
+    if token:
+        rpc_mod.configure_auth(token)
+
     worker_id = WorkerID.from_hex(os.environ["RAYTPU_WORKER_ID"])
     raylet_addr = (os.environ["RAYTPU_RAYLET_HOST"], int(os.environ["RAYTPU_RAYLET_PORT"]))
     gcs_addr = (os.environ["RAYTPU_GCS_HOST"], int(os.environ["RAYTPU_GCS_PORT"]))
@@ -56,8 +64,13 @@ def main():
     # expose the runtime to user code running in tasks
     worker_mod.global_worker = worker_mod.Worker(core, session_dir, is_driver=False)
 
-    # park the main thread; the raylet kills us via SIGTERM
-    threading.Event().wait()
+    # park until the raylet connection drops: a worker must never outlive
+    # its raylet (reference: core_worker.h:1317 ExitIfParentRayletDies) —
+    # a SIGKILL'd driver/raylet would otherwise strand hundreds of idle
+    # workers. Normal shutdown also arrives as SIGTERM from the raylet.
+    core.raylet._closed.wait()
+    logging.getLogger(__name__).info("raylet connection lost; exiting")
+    os._exit(0)
 
 
 if __name__ == "__main__":

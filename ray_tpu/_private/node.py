@@ -60,6 +60,25 @@ class Node:
             )
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
         self.session_dir = session_dir
+        # session auth: the head mints the shared-secret token; joining
+        # nodes bring one (process-global, env, or pre-seeded session file)
+        # and persist it into their own session dir so the workers they
+        # spawn inherit it (rpc.py AUTH frames)
+        from ray_tpu._private import rpc as rpc_mod
+
+        if head:
+            rpc_mod.configure_auth(
+                rpc_mod.load_or_create_token(session_dir, create=True)
+            )
+        else:
+            token = (
+                rpc_mod.session_token()
+                or os.environ.get("RAYTPU_AUTH_TOKEN")
+                or rpc_mod.load_or_create_token(session_dir)
+            )
+            if token:
+                rpc_mod.configure_auth(token)
+                rpc_mod.persist_token(session_dir, token)
         self.gcs: Optional[GcsServer] = None
         if head:
             assert gcs_address is None

@@ -63,12 +63,46 @@ class MemoryStore:
         self._objects: Dict[ObjectID, bytes] = {}
         self._cv = threading.Condition()
         self._version = 0  # bumped on every put: lets wait() block on change
+        # oid -> callbacks fired (on the putting thread; must be quick) the
+        # moment a value lands — the async serve ingress awaits completions
+        # this way instead of parking a thread per in-flight request
+        self._waiters: Dict[ObjectID, List] = {}
 
     def put(self, object_id: ObjectID, data: bytes):
         with self._cv:
             self._objects[object_id] = data
             self._version += 1
             self._cv.notify_all()
+            callbacks = self._waiters.pop(object_id, None)
+        if callbacks:
+            for cb in callbacks:
+                try:
+                    cb()
+                except Exception:
+                    pass
+
+    def add_waiter(self, object_id: ObjectID, callback) -> None:
+        """Invoke ``callback()`` once a value for object_id lands (or
+        immediately if it already has). The callback runs on the putting
+        thread: schedule real work elsewhere (e.g. call_soon_threadsafe)."""
+        with self._cv:
+            if object_id not in self._objects:
+                self._waiters.setdefault(object_id, []).append(callback)
+                return
+        callback()
+
+    def remove_waiter(self, object_id: ObjectID, callback) -> None:
+        """Drop a registered waiter (e.g. the awaiting side timed out)."""
+        with self._cv:
+            cbs = self._waiters.get(object_id)
+            if not cbs:
+                return
+            try:
+                cbs.remove(callback)
+            except ValueError:
+                pass
+            if not cbs:
+                del self._waiters[object_id]
 
     @property
     def version(self) -> int:

@@ -153,6 +153,10 @@ class Raylet:
             env_paths.append(
                 ensure_extracted(self.session_dir, uri, self.gcs.call)
             )
+        from ray_tpu._private import rpc as rpc_mod
+
+        if rpc_mod.session_token():
+            env["RAYTPU_AUTH_TOKEN"] = rpc_mod.session_token()
         env["RAYTPU_WORKER_ID"] = worker_id.hex()
         env["RAYTPU_RAYLET_HOST"] = self.server.host
         env["RAYTPU_RAYLET_PORT"] = str(self.server.port)
@@ -172,7 +176,13 @@ class Raylet:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (*env_paths, pkg_root, env.get("PYTHONPATH", "")) if p
         )
-        log_path = os.path.join(self.session_dir, "logs", f"worker-{worker_id.hex()[:12]}.log")
+        # per-node log dir: each raylet's log monitor tails only ITS OWN
+        # workers (a shared dir made every monitor scan every worker's log —
+        # O(nodes x workers) file churn and duplicate publishes)
+        log_path = os.path.join(
+            self.session_dir, "logs", self.node_id.hex()[:12],
+            f"worker-{worker_id.hex()[:12]}.log",
+        )
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         logfile = open(log_path, "ab")
         try:
@@ -884,7 +894,7 @@ class Raylet:
     # -- log monitor ---------------------------------------------------
 
     def _log_monitor_loop(self):
-        log_dir = os.path.join(self.session_dir, "logs")
+        log_dir = os.path.join(self.session_dir, "logs", self.node_id.hex()[:12])
         while not self._stopped.wait(0.5):
             try:
                 names = [

@@ -90,6 +90,14 @@ def init(
             host, port = address.split(":")
             gcs_address = (host, int(port))
             node = None
+            from ray_tpu._private import rpc as rpc_mod
+
+            if rpc_mod.session_token() is None:
+                token = os.environ.get(
+                    "RAYTPU_AUTH_TOKEN"
+                ) or rpc_mod.discover_local_token()
+                if token:
+                    rpc_mod.configure_auth(token)
             # connect to an existing cluster: ask GCS for a local raylet
             from ray_tpu._private.rpc import RpcClient
 

@@ -164,11 +164,6 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def options(self, num_returns: int = 1) -> "ActorMethod":
-        if num_returns == "dynamic":
-            raise ValueError(
-                'num_returns="dynamic" is only supported for tasks, '
-                "not actor methods"
-            )
         return ActorMethod(self._handle, self._method_name, num_returns)
 
     def remote(self, *args, **kwargs):
@@ -181,7 +176,11 @@ class ActorMethod:
             num_returns=self._num_returns,
             ordered=self._handle._max_concurrency == 1,
         )
-        return refs[0] if self._num_returns == 1 else refs
+        # "dynamic" has one static return: the ref resolving to the
+        # ObjectRefGenerator of per-item refs
+        if self._num_returns == 1 or self._num_returns == "dynamic":
+            return refs[0]
+        return refs
 
 
 class ActorHandle:

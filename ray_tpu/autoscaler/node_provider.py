@@ -79,9 +79,17 @@ class LocalSubprocessNodeProvider(NodeProvider):
             ]
             if self.extra_resources:
                 cmd += ["--resources", json.dumps(self.extra_resources)]
+            env = dict(os.environ)
+            from ray_tpu._private import rpc as rpc_mod
+
+            if rpc_mod.session_token():
+                # the spawned node joins a token-gated session: hand it the
+                # credential (the reference passes the redis password the
+                # same way, autoscaler/_private/commands)
+                env["RAYTPU_AUTH_TOKEN"] = rpc_mod.session_token()
             proc = subprocess.Popen(
                 cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
-                start_new_session=True,
+                start_new_session=True, env=env,
             )
             with self._lock:
                 self._procs[nid] = proc
@@ -151,10 +159,14 @@ class TPUSliceNodeProvider(NodeProvider):
                     self._slices[slice_id] = []
             else:
                 procs = []
+                from ray_tpu._private import rpc as rpc_mod
+
                 for host in range(self.hosts_per_slice):
                     env = dict(os.environ)
                     env["RAYTPU_TPU_SLICE_ID"] = slice_id
                     env["RAYTPU_TPU_TOPOLOGY"] = f"v5e-{self.chips_per_host}"
+                    if rpc_mod.session_token():
+                        env["RAYTPU_AUTH_TOKEN"] = rpc_mod.session_token()
                     procs.append(
                         subprocess.Popen(
                             [

@@ -298,23 +298,34 @@ class Dataset:
     ) -> "Dataset":
         """Apply ``fn(batch) -> batch`` to every batch (reference:
         data/dataset.py map_batches; actor pools per
-        actor_pool_map_operator.py)."""
+        actor_pool_map_operator.py).
+
+        Task-based map chains return a lazy plan (streaming executor with
+        fusion + backpressure is the DEFAULT, matching the reference's
+        streaming execution — data/_internal/execution/streaming_executor
+        .py:48); actor-pool and custom-resource maps run eagerly (the pool
+        is a materialization point)."""
         t0 = time.perf_counter()
         if isinstance(compute, ActorPoolStrategy):
             pairs = self._run_actor_pool(
                 fn, compute, batch_size, batch_format, fn_kwargs, fn_constructor, "batches"
             )
-        else:
-            task = _map_block_task
-            if num_cpus is not None:
-                task = task.options(num_cpus=num_cpus)
+            return self._derived(pairs, "map_batches", t0)
+        if num_cpus is not None:
+            task = _map_block_task.options(num_cpus=num_cpus)
             pairs = [
                 task.options(num_returns=2).remote(
                     fn, ref, batch_size, batch_format, fn_kwargs, "batches"
                 )
                 for ref in self._block_refs
             ]
-        return self._derived(pairs, "map_batches", t0)
+            return self._derived(pairs, "map_batches", t0)
+        return self.lazy().map_batches(
+            fn,
+            batch_size=batch_size,
+            batch_format=batch_format,
+            fn_kwargs=fn_kwargs,
+        )
 
     def _run_actor_pool(
         self, fn, strategy, batch_size, batch_format, fn_kwargs, fn_constructor, mode
@@ -368,15 +379,11 @@ class Dataset:
     def flat_map(self, fn: Callable, **kw) -> "Dataset":
         return self._row_op(fn, "flat_map", **kw)
 
-    def _row_op(self, fn, op, **kw) -> "Dataset":
-        t0 = time.perf_counter()
-        pairs = [
-            _map_block_task.options(num_returns=2).remote(
-                fn, ref, None, "numpy", {"_op": op}, "rows"
-            )
-            for ref in self._block_refs
-        ]
-        return self._derived(pairs, op, t0)
+    def _row_op(self, fn, op, **kw):
+        # row transforms join the streaming plan too (fused with adjacent
+        # maps, bounded in-flight blocks)
+        lazy = self.lazy()
+        return getattr(lazy, op)(fn)
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def _add(batch, **_):

@@ -99,6 +99,25 @@ class LazyDataset:
         self._source_refs = list(source_refs)
         self._ops: List[MapOp] = list(ops or [])
         self._max_in_flight = max_in_flight_blocks
+        self._materialized: Optional[Dataset] = None
+
+    # Dataset internals other Dataset methods touch on their *arguments*
+    # (e.g. union reads other._block_refs) — delegate these too
+    _DELEGATED_INTERNALS = ("_block_refs", "_meta_refs", "_stats")
+
+    def __getattr__(self, name: str):
+        """Any Dataset operation the plan doesn't stream (split, groupby,
+        write_*, to_pandas, ...) materializes once and delegates — map
+        chains stay streaming-by-default without shrinking the API."""
+        if name.startswith("_") and name not in LazyDataset._DELEGATED_INTERNALS:
+            raise AttributeError(name)
+        target = self._ensure_materialized()
+        return getattr(target, name)
+
+    def _ensure_materialized(self) -> Dataset:
+        if self._materialized is None:
+            self._materialized = self.materialize()
+        return self._materialized
 
     # -- plan building -----------------------------------------------------
 
@@ -124,6 +143,28 @@ class LazyDataset:
         return self._with_op(
             MapOp(fn, "rows", fn_kwargs={"_op": "flat_map"}, name="flat_map")
         )
+
+    def add_column(self, name: str, fn) -> "LazyDataset":
+        def _add(batch, **_):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(_add)
+
+    def drop_columns(self, cols) -> "LazyDataset":
+        cols = list(cols)
+        return self.map_batches(
+            lambda b, **_: {k: v for k, v in b.items() if k not in cols}
+        )
+
+    def select_columns(self, cols) -> "LazyDataset":
+        cols = list(cols)
+        return self.map_batches(
+            lambda b, **_: {k: v for k, v in b.items() if k in cols}
+        )
+
+    def lazy(self, **_kw) -> "LazyDataset":
+        return self
 
     # -- barriers (all-to-all): materialize, delegate, stay lazy after ----
 
@@ -173,10 +214,18 @@ class LazyDataset:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
+                     drop_last: bool = False, **kw) -> Iterator[Any]:
         """Streamed consumption: each block's fused chain completes just
         before its batches are yielded; memory stays bounded by the
-        in-flight window."""
+        in-flight window. Options the stream can't honor (local shuffle,
+        prefetch depth) delegate to the materialized Dataset."""
+        if any(kw.get(k) for k in ("local_shuffle_buffer_size",
+                                   "local_shuffle_seed")):
+            yield from self._ensure_materialized().iter_batches(
+                batch_size=batch_size, batch_format=batch_format,
+                drop_last=drop_last, **kw,
+            )
+            return
         carry: Optional[B.Block] = None
         for blk_ref, _ in self._stream():
             blk = ray_tpu.get(blk_ref)

@@ -10,15 +10,34 @@ learner actors via the host collective layer.
 
 from ray_tpu.rl.algorithm import PPO, PPOConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNLearner, DQNRolloutWorker, QNetwork
-from ray_tpu.rl.env import CartPole, VectorEnv, make_env
+from ray_tpu.rl.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rl.impala import Impala, ImpalaConfig, ImpalaLearner, vtrace
 from ray_tpu.rl.learner import LearnerGroup, PPOLearner, PPOLossConfig
+from ray_tpu.rl.multi_agent import (
+    IndependentCartPoles,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    make_multi_agent_env,
+)
+from ray_tpu.rl import offline
+from ray_tpu.rl.sac import SAC, SACConfig, SACRolloutWorker
 from ray_tpu.rl.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rl.rl_module import DiscretePolicyModule, RLModule
 from ray_tpu.rl.rollout_worker import RolloutWorker
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "IndependentCartPoles",
+    "MultiAgentEnv",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "Pendulum",
+    "SAC",
+    "SACConfig",
+    "SACRolloutWorker",
+    "make_multi_agent_env",
+    "offline",
     "CartPole",
     "DQN",
     "DQNConfig",

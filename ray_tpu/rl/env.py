@@ -77,7 +77,67 @@ class CartPole:
         )
 
 
-ENV_REGISTRY = {"CartPole-v1": CartPole}
+class Pendulum:
+    """Torque-control pendulum swing-up (standard published dynamics).
+
+    Observation: [cos(theta), sin(theta), theta_dot]; action: continuous
+    torque in [-2, 2]; reward: -(theta^2 + 0.1*theta_dot^2 + 0.001*u^2).
+    The classic continuous-control smoke problem (the reference's SAC
+    learning tests use Pendulum-v1 — rllib/algorithms/sac/tests)."""
+
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    observation_size = 3
+    action_size = 1
+    action_low = -2.0
+    action_high = 2.0
+    continuous = True
+
+    def __init__(self, max_steps: int = 200, seed: Optional[int] = None):
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._theta = 0.0
+        self._theta_dot = 0.0
+        self._t = 0
+
+    def _obs(self) -> np.ndarray:
+        return np.array(
+            [np.cos(self._theta), np.sin(self._theta), self._theta_dot],
+            np.float32,
+        )
+
+    def reset(self, seed: Optional[int] = None) -> Tuple[np.ndarray, Dict]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._theta_dot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.MAX_TORQUE, self.MAX_TORQUE))
+        th, thdot = self._theta, self._theta_dot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        thdot = thdot + (
+            3 * self.G / (2 * self.L) * np.sin(th)
+            + 3.0 / (self.M * self.L**2) * u
+        ) * self.DT
+        thdot = float(np.clip(thdot, -self.MAX_SPEED, self.MAX_SPEED))
+        th = th + thdot * self.DT
+        self._theta, self._theta_dot = th, thdot
+        self._t += 1
+        truncated = self._t >= self.max_steps
+        return self._obs(), -float(cost), False, truncated, {}
+
+
+ENV_REGISTRY = {"CartPole-v1": CartPole, "Pendulum-v1": Pendulum}
 
 
 def make_env(name_or_cls, **kwargs):

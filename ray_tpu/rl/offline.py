@@ -1,0 +1,95 @@
+"""Offline experience I/O: SampleBatches ⇄ Datasets ⇄ parquet.
+
+Reference surface: rllib/offline/ (JsonWriter/JsonReader, the
+input_/output_ config keys, and offline training via
+DatasetReader). This build rides ray_tpu.data instead of JSON files:
+experience becomes a columnar Dataset (zero-copy numpy blocks in plasma),
+persists as parquet, and feeds off-policy learners back through the
+replay-buffer path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+def _flatten(batch: SampleBatch) -> dict:
+    """Columnar view: multi-dim columns (obs, continuous actions) flatten
+    to fixed-width rows with a shape marker column for exact round-trip."""
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        if v.ndim == 1:
+            out[k] = v
+        else:
+            flat = v.reshape(len(v), -1)
+            for i in range(flat.shape[1]):
+                out[f"{k}__{i}"] = flat[:, i]
+            out[f"{k}__shape"] = np.full(
+                len(v), ",".join(map(str, v.shape[1:])), dtype=object
+            )
+    return out
+
+
+def _unflatten(columns: dict) -> SampleBatch:
+    out: dict = {}
+    shapes = {
+        k[: -len("__shape")]: v[0]
+        for k, v in columns.items()
+        if k.endswith("__shape")
+    }
+    grouped: dict = {}
+    for k, v in columns.items():
+        if k.endswith("__shape"):
+            continue
+        if "__" in k:
+            base, idx = k.rsplit("__", 1)
+            grouped.setdefault(base, {})[int(idx)] = np.asarray(v)
+        else:
+            out[k] = np.asarray(v)
+    for base, cols in grouped.items():
+        width = len(cols)
+        mat = np.stack([cols[i] for i in range(width)], axis=1)
+        shape = tuple(int(s) for s in str(shapes[base]).split(","))
+        out[base] = mat.reshape((len(mat),) + shape)
+    return SampleBatch(out)
+
+
+def to_dataset(batches: List[SampleBatch], *, parallelism: int = 1):
+    """Experience → a ray_tpu Dataset of columnar blocks."""
+    import ray_tpu.data as rt_data
+
+    merged = SampleBatch.concat(batches)
+    return rt_data.from_numpy(_flatten(merged), parallelism=parallelism)
+
+
+def write_sample_batches(batches: List[SampleBatch], path: str) -> List[str]:
+    """Persist experience as parquet (the offline dataset format)."""
+    return to_dataset(batches).write_parquet(path)
+
+
+def read_sample_batches(path: str, *, batch_size: int = 4096) -> Iterator[SampleBatch]:
+    """Stream SampleBatches back from an offline parquet dataset."""
+    import ray_tpu.data as rt_data
+
+    ds = rt_data.read_parquet(path)
+    for cols in ds.iter_batches(batch_size=batch_size, batch_format="numpy"):
+        yield _unflatten(cols)
+
+
+def load_replay_buffer(path: str, capacity: Optional[int] = None):
+    """Fill a ReplayBuffer from an offline dataset — the bridge into DQN /
+    SAC-style off-policy training from logged experience (reference:
+    rllib/offline/dataset_reader.py feeding replay)."""
+    from ray_tpu.rl.replay_buffers import ReplayBuffer
+
+    batches = list(read_sample_batches(path))
+    total = sum(len(b) for b in batches)
+    buf = ReplayBuffer(capacity or max(1, total))
+    for b in batches:
+        buf.add(b)
+    return buf

@@ -1,0 +1,312 @@
+"""SAC: maximum-entropy off-policy actor-critic for continuous control.
+
+Reference surface: rllib/algorithms/sac/ (sac.py config + training_step,
+sac_torch_policy.py twin-Q and squashed-gaussian policy, auto-tuned
+temperature). TPU-first translation: the whole update — actor, twin
+critics, temperature, polyak target sync — is ONE jitted function over
+replay minibatches; rollout actors sample tanh-gaussian actions on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.env import EpisodeReturnTracker, VectorEnv, make_env
+from ray_tpu.rl.replay_buffers import ReplayBuffer
+from ray_tpu.rl.sample_batch import SampleBatch
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class GaussianPolicy(nn.Module):
+    """Squashed-gaussian actor: outputs mean/log_std; actions are
+    tanh(sample) scaled to the env's bounds."""
+
+    action_size: int
+    hidden: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"torso_{i}")(x))
+        mean = nn.Dense(self.action_size, name="mean")(x)
+        log_std = nn.Dense(self.action_size, name="log_std")(x)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+class TwinQ(nn.Module):
+    """Two independent Q(s, a) heads (clipped double-Q)."""
+
+    hidden: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, act: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = jnp.concatenate([obs, act], axis=-1)
+        outs = []
+        for head in ("q1", "q2"):
+            h = x
+            for i, width in enumerate(self.hidden):
+                h = nn.relu(nn.Dense(width, name=f"{head}_l{i}")(h))
+            outs.append(nn.Dense(1, name=f"{head}_out")(h).squeeze(-1))
+        return outs[0], outs[1]
+
+
+def _sample_action(policy, params, obs, rng, scale):
+    mean, log_std = policy.apply({"params": params}, obs)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + jnp.exp(log_std) * eps
+    squashed = jnp.tanh(pre)
+    # log-prob with the tanh change-of-variables correction
+    logp = (
+        -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - jnp.log(1 - squashed**2 + 1e-6)
+    ).sum(-1)
+    return squashed * scale, logp
+
+
+@ray_tpu.remote
+class SACRolloutWorker:
+    """Stochastic-policy transition collection on a vectorized env."""
+
+    def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
+                 hidden: Tuple[int, ...] = (128, 128)):
+        self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
+        probe = make_env(env_name)
+        self.scale = float(probe.action_high)
+        self.policy = GaussianPolicy(probe.action_size, tuple(hidden))
+        self.params = self.policy.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, probe.observation_size), jnp.float32),
+        )["params"]
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._act = jax.jit(
+            lambda p, o, k: _sample_action(self.policy, p, o, k, self.scale)[0]
+        )
+        self._episodes = EpisodeReturnTracker(num_envs)
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int, random_actions: bool = False) -> SampleBatch:
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        n = self.envs.num_envs
+        rng = np.random.default_rng(int(self._rng[0]))
+        for _ in range(num_steps):
+            obs = self.envs.observations
+            if random_actions:
+                actions = rng.uniform(
+                    -self.scale, self.scale,
+                    (n, self.policy.action_size),
+                ).astype(np.float32)
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                actions = np.asarray(self._act(self.params, jnp.asarray(obs), sub))
+            next_obs, rewards, terms, truncs, finals = self.envs.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            # bootstrap through truncation: done only on true termination
+            next_l.append(finals)
+            done_l.append(terms)
+            self._episodes.track(rewards, terms | truncs)
+        return SampleBatch(
+            obs=np.concatenate(obs_l).astype(np.float32),
+            actions=np.concatenate(act_l).astype(np.float32),
+            rewards=np.concatenate(rew_l).astype(np.float32),
+            next_obs=np.concatenate(next_l).astype(np.float32),
+            dones=np.concatenate(done_l).astype(np.float32),
+        )
+
+    def episode_returns(self) -> List[float]:
+        return self._episodes.drain()
+
+
+@dataclasses.dataclass
+class SACConfig:
+    env: str = "Pendulum-v1"
+    num_rollout_workers: int = 1
+    num_envs_per_worker: int = 4
+    rollout_fragment_length: int = 64
+    buffer_capacity: int = 100_000
+    warmup_steps: int = 1_000
+    batch_size: int = 256
+    updates_per_iteration: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005  # polyak target rate
+    hidden: tuple = (128, 128)
+    seed: int = 0
+    # None = auto-tune temperature toward -action_size target entropy
+    fixed_alpha: float = None
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    def __init__(self, config: SACConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.scale = float(probe.action_high)
+        self.policy = GaussianPolicy(probe.action_size, tuple(config.hidden))
+        self.qnet = TwinQ(tuple(config.hidden))
+        rng = jax.random.PRNGKey(config.seed)
+        obs0 = jnp.zeros((1, probe.observation_size), jnp.float32)
+        act0 = jnp.zeros((1, probe.action_size), jnp.float32)
+        self.pi_params = self.policy.init(rng, obs0)["params"]
+        self.q_params = self.qnet.init(rng, obs0, act0)["params"]
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.log_alpha = jnp.zeros(())
+        self.target_entropy = -float(probe.action_size)
+        self.pi_opt = optax.adam(config.lr)
+        self.q_opt = optax.adam(config.lr)
+        self.a_opt = optax.adam(config.lr)
+        self.pi_opt_state = self.pi_opt.init(self.pi_params)
+        self.q_opt_state = self.q_opt.init(self.q_params)
+        self.a_opt_state = self.a_opt.init(self.log_alpha)
+        self.buffer = ReplayBuffer(config.buffer_capacity)
+        self.workers = [
+            SACRolloutWorker.remote(
+                config.env,
+                num_envs=config.num_envs_per_worker,
+                seed=config.seed + 1000 * i,
+                hidden=tuple(config.hidden),
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self._rng = jax.random.PRNGKey(config.seed + 7)
+        self._env_steps = 0
+        self._iteration = 0
+        self._update = self._build_update()
+
+    def _build_update(self):
+        policy, qnet = self.policy, self.qnet
+        gamma, tau = self.config.gamma, self.config.tau
+        scale = self.scale
+        fixed_alpha = self.config.fixed_alpha
+        target_entropy = self.target_entropy
+
+        def update(pi_p, q_p, q_t, log_alpha, pi_os, q_os, a_os, batch, rng):
+            alpha = (
+                jnp.asarray(fixed_alpha)
+                if fixed_alpha is not None
+                else jnp.exp(log_alpha)
+            )
+            r1, r2 = jax.random.split(rng)
+
+            # -- critic ----------------------------------------------------
+            next_a, next_logp = _sample_action(
+                policy, pi_p, batch["next_obs"], r1, scale
+            )
+            tq1, tq2 = qnet.apply({"params": q_t}, batch["next_obs"], next_a)
+            target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target_q = batch["rewards"] + gamma * (1.0 - batch["dones"]) * target_v
+            target_q = jax.lax.stop_gradient(target_q)
+
+            def q_loss_fn(qp):
+                q1, q2 = qnet.apply({"params": qp}, batch["obs"], batch["actions"])
+                return ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+            q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_p)
+            q_upd, q_os = self.q_opt.update(q_grads, q_os)
+            q_p = optax.apply_updates(q_p, q_upd)
+
+            # -- actor -----------------------------------------------------
+            def pi_loss_fn(pp):
+                a, logp = _sample_action(policy, pp, batch["obs"], r2, scale)
+                q1, q2 = qnet.apply({"params": q_p}, batch["obs"], a)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            (pi_loss, logp), pi_grads = jax.value_and_grad(
+                pi_loss_fn, has_aux=True
+            )(pi_p)
+            pi_upd, pi_os = self.pi_opt.update(pi_grads, pi_os)
+            pi_p = optax.apply_updates(pi_p, pi_upd)
+
+            # -- temperature ----------------------------------------------
+            def a_loss_fn(la):
+                return -(
+                    jnp.exp(la) * jax.lax.stop_gradient(logp + target_entropy)
+                ).mean()
+
+            a_loss, a_grad = jax.value_and_grad(a_loss_fn)(log_alpha)
+            a_upd, a_os = self.a_opt.update(a_grad, a_os)
+            log_alpha = optax.apply_updates(log_alpha, a_upd)
+
+            # -- polyak target sync ---------------------------------------
+            q_t = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, q_t, q_p
+            )
+            metrics = {
+                "q_loss": q_loss,
+                "pi_loss": pi_loss,
+                "alpha": alpha,
+                "entropy": -logp.mean(),
+            }
+            return pi_p, q_p, q_t, log_alpha, pi_os, q_os, a_os, metrics
+
+        return jax.jit(update)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        random_phase = self._env_steps < cfg.warmup_steps
+        batches = ray_tpu.get(
+            [
+                w.sample.remote(cfg.rollout_fragment_length, random_phase)
+                for w in self.workers
+            ],
+            timeout=300,
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += len(b)
+        metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= max(cfg.batch_size, cfg.warmup_steps):
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.batch_size)
+                self._rng, sub = jax.random.split(self._rng)
+                (
+                    self.pi_params, self.q_params, self.q_target,
+                    self.log_alpha, self.pi_opt_state, self.q_opt_state,
+                    self.a_opt_state, metrics,
+                ) = self._update(
+                    self.pi_params, self.q_params, self.q_target,
+                    self.log_alpha, self.pi_opt_state, self.q_opt_state,
+                    self.a_opt_state,
+                    {k: jnp.asarray(v) for k, v in batch.items()},
+                    sub,
+                )
+            ray_tpu.get(
+                [w.set_weights.remote(self.pi_params) for w in self.workers],
+                timeout=120,
+            )
+        self._iteration += 1
+        returns = [
+            r
+            for w in self.workers
+            for r in ray_tpu.get(w.episode_returns.remote(), timeout=60)
+        ]
+        out = {
+            "iteration": self._iteration,
+            "env_steps": self._env_steps,
+            "episode_return_mean": float(np.mean(returns)) if returns else None,
+            "time_s": round(time.perf_counter() - t0, 2),
+        }
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def stop(self):
+        for w in self.workers:
+            ray_tpu.kill(w)

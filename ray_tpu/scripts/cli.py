@@ -49,12 +49,32 @@ def _live_nodes() -> List[Dict]:
 
 
 def _head_address(explicit: Optional[str] = None) -> str:
+    _configure_auth_from_nodes()
     if explicit:
         return explicit
     for info in _live_nodes():
         if info.get("head"):
             return info["gcs_address"]
     sys.exit("no running head node found — pass --address or `ray_tpu start --head`")
+
+
+def _configure_auth_from_nodes() -> None:
+    """Pick up the session auth token from a local head's session dir (or
+    RAYTPU_AUTH_TOKEN) so CLI connections pass the AUTH gate."""
+    from ray_tpu._private import rpc as rpc_mod
+
+    if rpc_mod.session_token() is not None:
+        return
+    token = os.environ.get("RAYTPU_AUTH_TOKEN")
+    if not token:
+        for info in _live_nodes():
+            sd = info.get("session_dir")
+            if info.get("head") and sd:
+                token = rpc_mod.load_or_create_token(sd)
+                if token:
+                    break
+    if token:
+        rpc_mod.configure_auth(token)
 
 
 def cmd_start(args) -> int:
@@ -210,6 +230,43 @@ def cmd_submit(args) -> int:
     return 0 if status == JobStatus.SUCCEEDED else 1
 
 
+def cmd_serve(args) -> int:
+    """`raytpu serve deploy/status/delete` — config-file driven, like the
+    reference's `serve deploy` CLI over serve/schema.py."""
+    import json as _json
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.schema import SchemaValidationError, load_config_file
+
+    ray_tpu.init(address=_head_address(args.address), log_level="ERROR")
+    try:
+        if args.serve_command == "deploy":
+            try:
+                config = load_config_file(args.config_file)
+            except SchemaValidationError as e:
+                print(f"invalid config: {e}", file=sys.stderr)
+                return 2
+            serve.apply(config)
+            names = [d["name"] for d in config["deployments"]]
+            print(f"deployed: {', '.join(names)}")
+            return 0
+        if args.serve_command == "status":
+            print(_json.dumps(serve.status(), indent=2, default=_json_default))
+            return 0
+        if args.serve_command == "delete":
+            ok = serve.delete(args.name)
+            print(f"{'deleted' if ok else 'not found'}: {args.name}")
+            return 0 if ok else 1
+        if args.serve_command == "shutdown":
+            serve.shutdown()
+            print("serve shut down")
+            return 0
+    finally:
+        ray_tpu.shutdown()
+    return 2
+
+
 def _json_default(o):
     if hasattr(o, "hex"):
         return o.hex() if not isinstance(o, bytes) else o.hex()
@@ -259,6 +316,23 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--output", default="timeline.json")
     s.add_argument("--address")
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser("serve", help="deploy/inspect serve applications")
+    serve_sub = s.add_subparsers(dest="serve_command", required=True)
+    d = serve_sub.add_parser("deploy", help="deploy from a JSON/YAML config file")
+    d.add_argument("config_file")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve)
+    d = serve_sub.add_parser("status", help="deployment table")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve)
+    d = serve_sub.add_parser("delete", help="remove one deployment")
+    d.add_argument("name")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve)
+    d = serve_sub.add_parser("shutdown", help="tear down all deployments")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("submit", help="run an entrypoint as a tracked job")
     s.add_argument("--address")

@@ -43,6 +43,28 @@ def main() -> int:
     if args.head:
         node = Node(head=True, gcs_host=args.host, gcs_port=args.port, **kwargs)
     else:
+        from ray_tpu._private import rpc as rpc_mod
+
+        if rpc_mod.session_token() is None:
+            token = os.environ.get("RAYTPU_AUTH_TOKEN")
+            if not token:
+                # same-host join: read the head's session token file
+                try:
+                    for f in os.listdir(args.run_dir):
+                        if not (f.startswith("node-") and f.endswith(".json")):
+                            continue
+                        with open(os.path.join(args.run_dir, f)) as fh:
+                            info = json.load(fh)
+                        if info.get("head") and info.get("session_dir"):
+                            token = rpc_mod.load_or_create_token(
+                                info["session_dir"]
+                            )
+                            if token:
+                                break
+                except OSError:
+                    pass
+            if token:
+                rpc_mod.configure_auth(token)
         host, port = args.address.rsplit(":", 1)
         node = Node(head=False, gcs_address=(host, int(port)), **kwargs)
 

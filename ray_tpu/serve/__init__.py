@@ -314,4 +314,7 @@ def apply(config: Dict[str, Any], *, timeout: float = 60.0) -> DeploymentHandle:
 
     for d in config["deployments"]:
         _deploy(d["name"])
-    return handles[config["ingress"]]
+    # hand-written configs (serve CLI) may omit "ingress": default to the
+    # first deployment, matching the file's declaration order
+    ingress = config.get("ingress") or config["deployments"][0]["name"]
+    return handles[ingress]

@@ -27,10 +27,11 @@ class DeploymentResponse:
     MAX_DEATH_RETRIES = 3
 
     def __init__(self, ref, handle, replica_key, call, attempt: int = 0):
+        # call: (method, args, kwargs, stream) — everything a retry needs
         self._ref = ref
         self._handle = handle
         self._replica_key = replica_key
-        self._call = call  # (method, args, kwargs) for the death-retry
+        self._call = call
         self._attempt = attempt
         self._finished = False
 
@@ -49,7 +50,10 @@ class DeploymentResponse:
             if self._attempt >= self.MAX_DEATH_RETRIES:
                 raise  # every replica in the table may be dead: surface it
             self._handle._refresh(force=True)
-            retry = self._handle._send(*self._call, attempt=self._attempt + 1)
+            method, args, kwargs, stream = self._call
+            retry = self._handle._send(
+                method, args, kwargs, attempt=self._attempt + 1, stream=stream
+            )
             return retry.result(timeout=timeout)
         finally:
             self._finish_once()
@@ -176,24 +180,36 @@ class DeploymentHandle:
                 self._model_affinity[model_id] = choice._actor_id
             return choice
 
-    def _send(self, method, args, kwargs, attempt: int = 0) -> DeploymentResponse:
+    def _send(self, method, args, kwargs, attempt: int = 0,
+              stream: bool = False) -> DeploymentResponse:
         self._refresh()
         replica = self._pick()
         key = replica._actor_id
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
+        caller = (
+            replica.handle_request_stream.options(num_returns="dynamic")
+            if stream
+            else replica.handle_request
+        )
         if self.multiplexed_model_id:
-            ref = replica.handle_request.remote(
-                method, args, kwargs, self.multiplexed_model_id
-            )
+            ref = caller.remote(method, args, kwargs, self.multiplexed_model_id)
         else:
-            ref = replica.handle_request.remote(method, args, kwargs)
-        return DeploymentResponse(ref, self, key, (method, args, kwargs), attempt)
+            ref = caller.remote(method, args, kwargs)
+        return DeploymentResponse(
+            ref, self, key, (method, args, kwargs, stream), attempt
+        )
 
     # -- public -----------------------------------------------------------
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._send(None, args, kwargs)
+
+    def stream(self, *args, **kwargs) -> DeploymentResponse:
+        """Call a (generator) deployment with streaming results: the
+        response ref resolves to an ObjectRefGenerator whose items land
+        one by one."""
+        return self._send(None, args, kwargs, stream=True)
 
     def __getattr__(self, name: str) -> _MethodCaller:
         if name.startswith("_"):

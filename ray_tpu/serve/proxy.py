@@ -1,65 +1,15 @@
-"""Minimal HTTP ingress: JSON over POST /{deployment}.
+"""HTTP ingress entry points.
 
-Reference: serve/_private/http_proxy.py:256 (uvicorn/starlette ASGI). The
-TPU build keeps a dependency-free stdlib server: one proxy actor (or
-in-driver server) routing ``POST /<deployment>`` with a JSON body to the
-deployment handle and returning the JSON-encoded result."""
+The implementation is the asyncio event-loop proxy (async_proxy.py — one
+loop thread, futures not threads per in-flight request; reference:
+serve/_private/http_proxy.py:256's ASGI app under uvicorn). This module
+keeps the stable public names: ``HTTPProxy`` for in-process ingress and
+``HTTPProxyActor`` for the one-per-node deployment."""
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict
-
 import ray_tpu
-from ray_tpu.serve.handle import DeploymentHandle
-
-
-class _ProxyHandler(BaseHTTPRequestHandler):
-    handles: Dict[str, DeploymentHandle] = {}
-
-    def log_message(self, fmt, *args):  # quiet
-        pass
-
-    def do_POST(self):
-        name = self.path.strip("/").split("/")[0]
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"null")
-            handle = self.handles.get(name)
-            if handle is None:
-                handle = DeploymentHandle(name)
-                self.handles[name] = handle
-            result = handle.remote(payload).result(timeout=60)
-            body = json.dumps({"result": result}).encode()
-            self.send_response(200)
-        except Exception as e:  # noqa: BLE001
-            body = json.dumps({"error": f"{type(e).__name__}: {e}"}).encode()
-            self.send_response(500)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-
-class HTTPProxy:
-    """In-process HTTP server bound to (host, port); port 0 picks one."""
-
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._server = ThreadingHTTPServer((host, port), _ProxyHandler)
-        self.host, self.port = self._server.server_address[:2]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="serve-http", daemon=True
-        )
-        self._thread.start()
-
-    @property
-    def address(self) -> str:
-        return f"http://{self.host}:{self.port}"
-
-    def stop(self):
-        self._server.shutdown()
+from ray_tpu.serve.async_proxy import AsyncHTTPProxy as HTTPProxy  # noqa: F401
 
 
 @ray_tpu.remote(max_concurrency=8)

@@ -63,6 +63,33 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_stream(self, method: Optional[str], args, kwargs,
+                              model_id: Optional[str] = None):
+        """Generator variant: called with num_returns='dynamic' so each
+        yielded item becomes its own object the ingress can flush as it
+        lands (streaming responses; the reference streams via ASGI
+        generators in serve/_private/http_proxy.py)."""
+        from ray_tpu.serve.multiplex import _current_model_id
+
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        token = _current_model_id.set(model_id or "")
+        try:
+            target = self._callable if method is None else getattr(self._callable, method)
+            result = target(*args, **kwargs)
+            # only true iterators/generators stream item-by-item; plain
+            # iterables (dict/list/str results) are ONE response — a dict
+            # must not stream its keys
+            if hasattr(result, "__next__"):
+                yield from result
+            else:
+                yield result
+        finally:
+            _current_model_id.reset(token)
+            with self._lock:
+                self._ongoing -= 1
+
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:
             return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}

@@ -1,0 +1,139 @@
+"""Serve config schema: typed validation for deploy files.
+
+The reference validates its REST/config surface with pydantic models
+(serve/schema.py — ServeApplicationSchema / DeploymentSchema). This is the
+dependency-free equivalent: a declarative field table per object, strict
+about unknown fields and types, with dotted paths in every error so a bad
+config fails at submission time instead of as a confusing deploy error.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class SchemaValidationError(ValueError):
+    pass
+
+
+# field -> (type or tuple of types, required, default)
+_DEPLOYMENT_FIELDS: Dict[str, Tuple[Any, bool, Any]] = {
+    "name": (str, True, None),
+    "import_path": (str, True, None),
+    "num_replicas": (int, False, 1),
+    "init_args": ((list, tuple), False, ()),
+    "init_kwargs": (dict, False, {}),
+    "user_config": ((dict, type(None)), False, None),
+    "autoscaling_config": ((dict, type(None)), False, None),
+    "resources": ((dict, type(None)), False, None),
+    "max_concurrent_queries": (int, False, 8),
+    "route_prefix": ((str, type(None)), False, None),
+}
+
+_AUTOSCALING_FIELDS: Dict[str, Tuple[Any, bool, Any]] = {
+    "min_replicas": (int, False, 1),
+    "max_replicas": (int, False, 4),
+    "target_ongoing_requests": ((int, float), False, 2.0),
+    "upscale_delay_s": ((int, float), False, 3.0),
+    "downscale_delay_s": ((int, float), False, 10.0),
+}
+
+_APP_FIELDS: Dict[str, Tuple[Any, bool, Any]] = {
+    "name": (str, False, "default"),
+    "deployments": (list, True, None),
+    "http": ((dict, type(None)), False, None),
+    "ingress": ((str, type(None)), False, None),
+}
+
+
+def _check(obj: Any, fields: Dict[str, Tuple[Any, bool, Any]], path: str) -> Dict[str, Any]:
+    if not isinstance(obj, dict):
+        raise SchemaValidationError(f"{path}: expected a mapping, got {type(obj).__name__}")
+    unknown = sorted(set(obj) - set(fields))
+    if unknown:
+        raise SchemaValidationError(
+            f"{path}: unknown field(s) {unknown}; allowed: {sorted(fields)}"
+        )
+    out: Dict[str, Any] = {}
+    for name, (types, required, default) in fields.items():
+        if name not in obj:
+            if required:
+                raise SchemaValidationError(f"{path}.{name}: required field missing")
+            if default is not None or type(None) in (
+                types if isinstance(types, tuple) else (types,)
+            ):
+                out[name] = default
+            continue
+        val = obj[name]
+        ok_types = types if isinstance(types, tuple) else (types,)
+        if not isinstance(val, ok_types) or (
+            isinstance(val, bool) and bool not in ok_types
+        ):
+            names = "/".join(t.__name__ for t in ok_types)
+            raise SchemaValidationError(
+                f"{path}.{name}: expected {names}, got {type(val).__name__} ({val!r})"
+            )
+        out[name] = val
+    return out
+
+
+def validate_deployment(d: Any, path: str = "deployment") -> Dict[str, Any]:
+    out = _check(d, _DEPLOYMENT_FIELDS, path)
+    if out.get("num_replicas", 1) < 0:
+        raise SchemaValidationError(f"{path}.num_replicas: must be >= 0")
+    if ":" not in out["import_path"]:
+        raise SchemaValidationError(
+            f"{path}.import_path: expected 'module:attribute', got "
+            f"{out['import_path']!r}"
+        )
+    if out.get("autoscaling_config"):
+        auto = _check(
+            out["autoscaling_config"], _AUTOSCALING_FIELDS,
+            f"{path}.autoscaling_config",
+        )
+        if auto["min_replicas"] > auto["max_replicas"]:
+            raise SchemaValidationError(
+                f"{path}.autoscaling_config: min_replicas > max_replicas"
+            )
+        out["autoscaling_config"] = auto
+    return out
+
+
+def validate_config(config: Any) -> Dict[str, Any]:
+    """Validate a full serve application config (the file `raytpu serve
+    deploy` takes, and what :func:`ray_tpu.serve.build` emits)."""
+    out = _check(config, _APP_FIELDS, "app")
+    if not out["deployments"]:
+        raise SchemaValidationError("app.deployments: must not be empty")
+    seen: set = set()
+    deployments: List[Dict[str, Any]] = []
+    for i, d in enumerate(out["deployments"]):
+        v = validate_deployment(d, f"app.deployments[{i}]")
+        if v["name"] in seen:
+            raise SchemaValidationError(
+                f"app.deployments[{i}].name: duplicate deployment "
+                f"{v['name']!r}"
+            )
+        seen.add(v["name"])
+        deployments.append(v)
+    out["deployments"] = deployments
+    if out.get("ingress") and out["ingress"] not in seen:
+        raise SchemaValidationError(
+            f"app.ingress: {out['ingress']!r} is not a declared deployment"
+        )
+    return out
+
+
+def load_config_file(path: str) -> Dict[str, Any]:
+    """Read + validate a JSON or YAML config file."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        raw = yaml.safe_load(text)
+    else:
+        raw = json.loads(text)
+    return validate_config(raw)

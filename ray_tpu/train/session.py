@@ -94,8 +94,67 @@ def get_local_rank() -> int:
     return _get_session().local_rank
 
 
+class DataShard:
+    """Per-worker view of a dataset: per-epoch streaming iteration with
+    host-side prefetch and double-buffered device transfer (reference:
+    air/session.py:359 get_dataset_shard streams Ray Data splits; the
+    device path is TPU-first — batches are device_put one step ahead so
+    host→HBM transfer overlaps the previous step's compute)."""
+
+    def __init__(self, ds: Any):
+        self._ds = ds
+
+    def __getattr__(self, name: str):
+        return getattr(self._ds, name)
+
+    def iter_batches(self, **kw):
+        return self._ds.iter_batches(**kw)
+
+    def iter_epochs(self, epochs: Optional[int] = None, **kw):
+        """Yield a fresh streaming batch iterator per epoch (the blocks
+        re-stream through the executor each time; nothing is cached)."""
+        n = 0
+        while epochs is None or n < epochs:
+            yield self._ds.iter_batches(**kw)
+            n += 1
+
+    def iter_device_batches(
+        self,
+        *,
+        sharding: Any = None,
+        prefetch: int = 2,
+        **kw,
+    ):
+        """Stream batches as device arrays, keeping ``prefetch`` transfers
+        in flight: device_put is async under JAX, so batch k+1 uploads
+        while batch k computes (double buffering)."""
+        import collections
+
+        import jax
+
+        def _put(batch):
+            if sharding is not None:
+                return jax.tree.map(
+                    lambda a: jax.device_put(a, sharding), batch
+                )
+            return jax.tree.map(jax.device_put, batch)
+
+        pending: "collections.deque" = collections.deque()
+        for batch in self._ds.iter_batches(**kw):
+            pending.append(_put(batch))
+            if len(pending) > prefetch:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
+
+
 def get_dataset_shard(dataset_name: str = "train"):
-    return _get_session().dataset_shards.get(dataset_name)
+    shard = _get_session().dataset_shards.get(dataset_name)
+    if shard is None:
+        return None
+    if hasattr(shard, "iter_batches") and not isinstance(shard, DataShard):
+        return DataShard(shard)
+    return shard
 
 
 def get_experiment_name() -> str:

@@ -45,6 +45,14 @@ class ClientCore:
     mode = "client"
 
     def __init__(self, host: str, port: int):
+        import os as _os
+
+        from ray_tpu._private import rpc as _rpc_mod
+
+        if _rpc_mod.session_token() is None and _os.environ.get("RAYTPU_AUTH_TOKEN"):
+            # external raytpu:// clients authenticate with the session
+            # token handed out by the cluster operator
+            _rpc_mod.configure_auth(_os.environ["RAYTPU_AUTH_TOKEN"])
         self._rpc = RpcClient((host, port))
         self.gcs = _GcsProxy(self)
         self.session_dir = ""

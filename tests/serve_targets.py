@@ -1,0 +1,6 @@
+"""Import targets for serve config-file deploy tests (import_path points
+here, mirroring how the reference's `serve deploy` resolves modules)."""
+
+
+def echo(x):
+    return x

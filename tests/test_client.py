@@ -8,6 +8,8 @@ raylet/plasma connection; everything proxies through the server driver)
 import json
 import os
 import subprocess
+
+from ray_tpu._private import rpc as _rpc_mod
 import sys
 import textwrap
 
@@ -77,7 +79,17 @@ def test_client_mode_end_to_end(ray_start_regular):
             capture_output=True,
             text=True,
             timeout=180,
-            env={**os.environ, "PYTHONPATH": REPO},
+            env={
+                **os.environ,
+                "PYTHONPATH": REPO,
+                # external clients present the session token (the operator
+                # hands it out; here we lift it from the running session)
+                **(
+                    {"RAYTPU_AUTH_TOKEN": _rpc_mod.session_token()}
+                    if _rpc_mod.session_token()
+                    else {}
+                ),
+            },
         )
         assert proc.returncode == 0, (proc.stdout, proc.stderr)
         line = [l for l in proc.stdout.splitlines() if l.startswith("CLIENT_RESULT")][0]

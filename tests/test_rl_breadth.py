@@ -1,0 +1,180 @@
+"""RL breadth: SAC (continuous control), multi-agent training, offline
+experience I/O (reference: rllib/algorithms/sac/, rllib/env/
+multi_agent_env.py, rllib/offline/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+@pytest.fixture
+def ray_rl():
+    ray_tpu.init(num_cpus=4, log_level="ERROR")
+    yield
+    ray_tpu.shutdown()
+
+
+def test_pendulum_env_contract():
+    from ray_tpu.rl.env import make_env
+
+    env = make_env("Pendulum-v1", seed=0)
+    obs, _ = env.reset(seed=0)
+    assert obs.shape == (3,)
+    total = 0.0
+    for _ in range(10):
+        obs, r, term, trunc, _ = env.step(np.array([1.0]))
+        assert obs.shape == (3,) and r <= 0.0 and not term
+        total += r
+    assert total < 0.0
+
+
+def test_sac_update_mechanics(ray_rl):
+    """One SAC iteration past warmup: losses finite, target net moves,
+    weights broadcast to workers."""
+    from ray_tpu.rl.sac import SACConfig
+
+    algo = SACConfig(
+        env="Pendulum-v1",
+        warmup_steps=128,
+        batch_size=64,
+        updates_per_iteration=4,
+        rollout_fragment_length=32,
+        num_envs_per_worker=4,
+    ).build()
+    try:
+        m1 = algo.train()  # warmup sampling
+        m2 = algo.train()  # first real updates
+        assert np.isfinite(m2["q_loss"]) and np.isfinite(m2["pi_loss"])
+        assert m2["alpha"] > 0.0
+        assert m2["env_steps"] > m1["env_steps"]
+    finally:
+        algo.stop()
+
+
+@pytest.mark.skipif(
+    __import__("os").environ.get("RAYTPU_RUN_SLOW") != "1",
+    reason="learning run (~5 min); set RAYTPU_RUN_SLOW=1",
+)
+def test_sac_learns_pendulum(ray_rl):
+    """Learning floor: mean return improves substantially over training
+    (the reference's SAC learning tests use the same env/criterion)."""
+    from ray_tpu.rl.sac import SACConfig
+
+    algo = SACConfig(
+        env="Pendulum-v1",
+        warmup_steps=500,
+        batch_size=128,
+        updates_per_iteration=48,
+        rollout_fragment_length=64,
+        num_envs_per_worker=4,
+        seed=0,
+    ).build()
+    try:
+        early, late = [], []
+        for i in range(60):
+            m = algo.train()
+            r = m.get("episode_return_mean")
+            if r is not None:
+                (early if i < 15 else late).append(r)
+        assert late, "no episodes completed"
+        improvement = np.mean(late[-5:]) - np.mean(early)
+        assert improvement > 150, (np.mean(early), late[-5:])
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_env_contract():
+    from ray_tpu.rl.multi_agent import IndependentCartPoles
+
+    env = IndependentCartPoles(max_steps=10, seed=0)
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"agent_0", "agent_1"}
+    for _ in range(10):
+        obs, rewards, terms, truncs, _ = env.step(
+            {a: 0 for a in obs}
+        )
+        if terms["__all__"]:
+            break
+    assert terms["__all__"] or truncs["__all__"] or obs
+
+
+def test_multi_agent_ppo_trains(ray_rl):
+    """2 agents, one policy each: both policies update and the mean
+    return improves over a short run."""
+    from ray_tpu.rl.multi_agent import MultiAgentPPOConfig
+
+    algo = MultiAgentPPOConfig(
+        num_rollout_workers=2, rollout_fragment_length=128, seed=0
+    ).build()
+    try:
+        first = None
+        last = None
+        for i in range(10):
+            m = algo.train()
+            if m["episode_return_mean"] is not None:
+                last = m["episode_return_mean"]
+                if first is None:
+                    first = last
+        assert set(m["policy_losses"]) == {"policy_agent_0", "policy_agent_1"}
+        assert last is not None and first is not None
+        assert last > first  # learning signal on both independent policies
+    finally:
+        algo.stop()
+
+
+def test_offline_roundtrip_and_replay(ray_rl, tmp_path):
+    from ray_tpu.rl import offline
+
+    rng = np.random.default_rng(0)
+    batch = SampleBatch(
+        obs=rng.random((64, 4), dtype=np.float32),
+        actions=rng.integers(0, 2, 64).astype(np.int32),
+        rewards=np.ones(64, np.float32),
+        next_obs=rng.random((64, 4), dtype=np.float32),
+        dones=np.zeros(64, np.float32),
+    )
+    path = str(tmp_path / "exp")
+    offline.write_sample_batches([batch, batch], path)
+    back = SampleBatch.concat(list(offline.read_sample_batches(path)))
+    assert len(back) == 128
+    assert back["obs"].shape == (128, 4)
+    np.testing.assert_allclose(
+        np.sort(back["obs"][:, 0]),
+        np.sort(np.concatenate([batch["obs"][:, 0]] * 2)),
+        rtol=1e-6,
+    )
+    buf = offline.load_replay_buffer(path)
+    sample = buf.sample(32)
+    assert sample["obs"].shape == (32, 4)
+
+
+def test_offline_dqn_training(ray_rl, tmp_path):
+    """Train DQN purely from logged experience (no env interaction) —
+    the reference's offline input_ pipeline equivalent."""
+    from ray_tpu.rl import offline
+    from ray_tpu.rl.dqn import DQNLearner
+
+    rng = np.random.default_rng(0)
+    n = 512
+    obs = rng.random((n, 4), dtype=np.float32)
+    batch = SampleBatch(
+        obs=obs,
+        actions=rng.integers(0, 2, n).astype(np.int32),
+        rewards=(obs[:, 0] > 0.5).astype(np.float32),
+        next_obs=rng.random((n, 4), dtype=np.float32),
+        dones=rng.random(n).astype(np.float32) < 0.1,
+    )
+    batch["dones"] = batch["dones"].astype(np.float32)
+    path = str(tmp_path / "exp")
+    offline.write_sample_batches([batch], path)
+    buf = offline.load_replay_buffer(path)
+    learner = DQNLearner(observation_size=4, num_actions=2)
+    losses = []
+    for _ in range(20):
+        mb = buf.sample(64)
+        loss, _td = learner.update(mb)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])  # TD error shrinks
