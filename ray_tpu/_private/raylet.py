@@ -184,10 +184,22 @@ class Raylet:
             f"worker-{worker_id.hex()[:12]}.log",
         )
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        interpreter = sys.executable
+        if renv.get("pip"):
+            # per-requirements venv (cached by hash); the worker runs under
+            # its interpreter so the extra packages are importable
+            # (reference: _private/runtime_env/pip.py)
+            from ray_tpu._private.runtime_env_pip import ensure_pip_env
+
+            interpreter = ensure_pip_env(
+                self.session_dir,
+                list(renv["pip"]),
+                renv.get("pip_find_links"),
+            )
         logfile = open(log_path, "ab")
         try:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.default_worker"],
+                [interpreter, "-m", "ray_tpu._private.default_worker"],
                 env=env,
                 cwd=cwd,
                 stdout=logfile,

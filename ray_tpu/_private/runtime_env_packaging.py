@@ -156,6 +156,14 @@ def resolve_runtime_env(
                 else:
                     uris.append(_upload(gcs_call, m, prefix=name))
         out["py_modules"] = uris
+    if runtime_env.get("pip"):
+        # pip requirements pass through verbatim; the venv is built on the
+        # node at worker-spawn time (runtime_env_pip.ensure_pip_env)
+        out["pip"] = list(runtime_env["pip"])
+        if runtime_env.get("pip_find_links"):
+            out["pip_find_links"] = os.path.abspath(
+                os.path.expanduser(str(runtime_env["pip_find_links"]))
+            )
     _env_memo[memo_key] = (now, out)
     return out
 
@@ -177,6 +185,10 @@ def runtime_env_key(runtime_env: Optional[Dict[str, Any]]) -> tuple:
         key.append(("wd", runtime_env["working_dir"]))
     if runtime_env.get("py_modules"):
         key.append(("py", tuple(runtime_env["py_modules"])))
+    if runtime_env.get("pip"):
+        key.append(("pip", tuple(runtime_env["pip"])))
+        if runtime_env.get("pip_find_links"):
+            key.append(("pipfl", str(runtime_env["pip_find_links"])))
     return tuple(key)
 
 

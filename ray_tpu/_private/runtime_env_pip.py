@@ -1,0 +1,158 @@
+"""runtime_env pip environments: per-requirements-hash venvs.
+
+Reference: python/ray/_private/runtime_env/pip.py — each distinct pip
+requirement list gets its own virtualenv, created once per node, cached by
+requirements hash, and the worker runs under that venv's interpreter. The
+TPU build keeps the same contract with ``--system-site-packages`` (jax and
+the baked-in stack stay importable; pip only ADDS packages) and supports
+air-gapped installs via ``pip_find_links`` (local wheel directory +
+``--no-index``), since TPU pods commonly run without egress.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def pip_env_hash(pip: List[str], find_links: Optional[str] = None) -> str:
+    h = hashlib.sha1()
+    for req in pip:
+        h.update(req.encode())
+        h.update(b"\0")
+    if find_links:
+        h.update(find_links.encode())
+    return h.hexdigest()[:16]
+
+
+def _env_root(session_dir: str, pip: List[str], find_links: Optional[str]) -> str:
+    return os.path.join(session_dir, "pip_envs", pip_env_hash(pip, find_links))
+
+
+def env_ready(session_dir: str, pip: List[str],
+              find_links: Optional[str] = None) -> Optional[str]:
+    """Non-blocking probe: the interpreter path if the venv exists (builds
+    land atomically via os.replace, so directory presence == ready)."""
+    root = _env_root(session_dir, pip, find_links)
+    python = os.path.join(root, "bin", "python")
+    return python if os.path.isdir(root) else None
+
+
+_building: set = set()
+_building_lock = threading.Lock()
+
+
+def ensure_pip_env_async(session_dir: str, pip: List[str],
+                         find_links: Optional[str] = None) -> Optional[str]:
+    """Kick a background build (deduped per env hash within this process)
+    and return immediately; returns the interpreter path once ready, else
+    None. Lets the raylet's lease loop keep answering RPCs while a slow
+    install runs (a synchronous build inside the lease handler would time
+    out the client's lease call)."""
+    ready = env_ready(session_dir, pip, find_links)
+    if ready:
+        return ready
+    key = pip_env_hash(pip, find_links)
+    with _building_lock:
+        if key in _building:
+            return None
+        _building.add(key)
+
+    def _run():
+        try:
+            ensure_pip_env(session_dir, pip, find_links)
+        except Exception:
+            logger.exception("background pip env build failed (%s)", pip)
+        finally:
+            with _building_lock:
+                _building.discard(key)
+
+    threading.Thread(target=_run, name=f"pip-env-{key}", daemon=True).start()
+    return None
+
+
+def ensure_pip_env(
+    session_dir: str,
+    pip: List[str],
+    find_links: Optional[str] = None,
+    timeout_s: float = 300.0,
+) -> str:
+    """Create (once) the venv for this requirement list; returns the path
+    of its python interpreter. Builds go into a unique temp dir and
+    os.replace into place — concurrent builders race benignly (the loser's
+    replace fails on the non-empty target and is discarded), and a killed
+    builder leaves only an orphaned temp dir, never a stuck lock."""
+    root = _env_root(session_dir, pip, find_links)
+    python = os.path.join(root, "bin", "python")
+    if os.path.isdir(root):
+        return python
+    os.makedirs(os.path.dirname(root), exist_ok=True)
+    tmp = f"{root}.tmp{os.getpid()}.{threading.get_ident()}"
+    _build_env(tmp, os.path.join(tmp, "bin", "python"), pip, find_links,
+               timeout_s)
+    try:
+        os.replace(tmp, root)
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        if not os.path.isdir(root):
+            raise
+    return python
+
+
+def _build_env(root: str, python: str, pip: List[str],
+               find_links: Optional[str], timeout_s: float) -> None:
+    t0 = time.monotonic()
+    import venv
+
+    # system-site-packages: the baked-in jax/numpy stack stays importable;
+    # pip only layers additional packages on top (reference pip.py uses the
+    # same inheritance model)
+    venv.EnvBuilder(
+        system_site_packages=True, with_pip=True, symlinks=True
+    ).create(root)
+    # the spawning interpreter is often itself a venv (e.g. /opt/venv):
+    # system_site_packages only reaches the BASE python's site dir, so
+    # chain this process's site-packages explicitly via a .pth (same
+    # inheritance the reference gets from --system-site-packages on a
+    # bare-metal python)
+    import site
+
+    child_site = os.path.join(
+        root, "lib", f"python{sys.version_info[0]}.{sys.version_info[1]}",
+        "site-packages",
+    )
+    try:
+        parents = [p for p in site.getsitepackages() if os.path.isdir(p)]
+    except Exception:
+        parents = []
+    if parents and os.path.isdir(child_site):
+        with open(os.path.join(child_site, "_parent_env.pth"), "w") as f:
+            f.write("\n".join(parents) + "\n")
+    cmd = [python, "-m", "pip", "install", "--quiet",
+           "--disable-pip-version-check"]
+    if find_links:
+        # air-gapped: only the local wheel directory, no network
+        cmd += ["--no-index", "--find-links", find_links]
+    cmd += list(pip)
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout_s
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pip install for runtime_env failed "
+            f"(requirements={pip}):\n{proc.stderr[-2000:]}"
+        )
+    logger.info(
+        "built pip runtime_env %s (%d reqs) in %.1fs",
+        os.path.basename(root), len(pip), time.monotonic() - t0,
+    )

@@ -79,7 +79,7 @@ def _check_options(options: Dict[str, Any]):
         raise ValueError(f"unknown options: {sorted(unknown)}")
     env = options.get("runtime_env")
     if env is not None:
-        supported = {"env_vars", "working_dir", "py_modules"}
+        supported = {"env_vars", "working_dir", "py_modules", "pip", "pip_find_links"}
         extra = set(env) - supported
         if extra:
             # pip/conda need a per-node package installer (not built);
@@ -104,6 +104,18 @@ def _check_options(options: Dict[str, Any]):
         ):
             raise ValueError(
                 "runtime_env py_modules must be a list of path strings"
+            )
+        pip = env.get("pip")
+        if pip is not None and (
+            isinstance(pip, str)  # "numpy" would iterate as characters
+            or not all(isinstance(r, str) for r in pip)
+        ):
+            raise ValueError(
+                "runtime_env pip must be a list of requirement strings"
+            )
+        if env.get("pip_find_links") and not pip:
+            raise ValueError(
+                "runtime_env pip_find_links requires pip requirements"
             )
 
 

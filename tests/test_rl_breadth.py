@@ -163,7 +163,7 @@ def test_offline_dqn_training(ray_rl, tmp_path):
         obs=obs,
         actions=rng.integers(0, 2, n).astype(np.int32),
         rewards=(obs[:, 0] > 0.5).astype(np.float32),
-        next_obs=rng.random((n, 4), dtype=np.float32),
+        new_obs=rng.random((n, 4), dtype=np.float32),
         dones=rng.random(n).astype(np.float32) < 0.1,
     )
     batch["dones"] = batch["dones"].astype(np.float32)

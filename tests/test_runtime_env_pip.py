@@ -1,0 +1,105 @@
+"""runtime_env pip: per-requirements venvs, air-gapped via find_links
+(reference: python/ray/_private/runtime_env/pip.py)."""
+
+import os
+import zipfile
+
+import pytest
+
+import ray_tpu
+
+
+def _make_wheel(dirpath: str, name: str = "tinydep", version: str = "0.1") -> str:
+    """Hand-roll a minimal valid wheel (a zip with dist-info), so the test
+    needs no network and no build backend."""
+    os.makedirs(dirpath, exist_ok=True)
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    metadata = (
+        f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n"
+    )
+    wheel_meta = (
+        "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+        "Tag: py3-none-any\n"
+    )
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", "MAGIC = 'from-pip-env'\n")
+        z.writestr(f"{dist}/METADATA", metadata)
+        z.writestr(f"{dist}/WHEEL", wheel_meta)
+        z.writestr(f"{dist}/RECORD", "")
+    return whl
+
+
+def test_pip_env_hash_stable(tmp_path):
+    from ray_tpu._private.runtime_env_pip import pip_env_hash
+
+    a = pip_env_hash(["x==1", "y"], "/links")
+    assert a == pip_env_hash(["x==1", "y"], "/links")
+    assert a != pip_env_hash(["x==2", "y"], "/links")
+    assert a != pip_env_hash(["x==1", "y"])
+
+
+def test_ensure_pip_env_builds_and_caches(tmp_path):
+    from ray_tpu._private.runtime_env_pip import ensure_pip_env
+
+    links = str(tmp_path / "wheels")
+    _make_wheel(links)
+    session = str(tmp_path / "session")
+    os.makedirs(session)
+    py = ensure_pip_env(session, ["tinydep"], links)
+    assert os.path.exists(py)
+    import subprocess
+
+    out = subprocess.run(
+        [py, "-c", "import tinydep; print(tinydep.MAGIC)"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0 and out.stdout.strip() == "from-pip-env"
+    # baked-in packages remain importable (system-site-packages)
+    out2 = subprocess.run(
+        [py, "-c", "import numpy; print('np-ok')"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out2.stdout.strip() == "np-ok"
+    # second call is a cache hit (no rebuild: returns instantly)
+    import time
+
+    t0 = time.monotonic()
+    assert ensure_pip_env(session, ["tinydep"], links) == py
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_task_runs_with_package_driver_lacks(tmp_path):
+    """The acceptance test from VERDICT #8: a task imports a package the
+    driver process does not have, provided through runtime_env pip."""
+    with pytest.raises(ImportError):
+        import tinydep  # noqa: F401  (driver must NOT have it)
+
+    links = str(tmp_path / "wheels")
+    _make_wheel(links)
+    ray_tpu.init(num_cpus=2, log_level="ERROR")
+    try:
+
+        @ray_tpu.remote(
+            runtime_env={"pip": ["tinydep"], "pip_find_links": links}
+        )
+        def uses_dep():
+            import tinydep
+
+            return tinydep.MAGIC
+
+        assert ray_tpu.get(uses_dep.remote(), timeout=180) == "from-pip-env"
+
+        # plain tasks still run in plain workers (pool keyed by env)
+        @ray_tpu.remote
+        def no_dep():
+            try:
+                import tinydep  # noqa: F401
+
+                return "leaked"
+            except ImportError:
+                return "clean"
+
+        assert ray_tpu.get(no_dep.remote(), timeout=60) == "clean"
+    finally:
+        ray_tpu.shutdown()

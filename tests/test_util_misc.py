@@ -195,8 +195,9 @@ def test_runtime_env_env_vars(ray_start_regular):
     ).remote()
     assert ray_tpu.get(a.val.remote(), timeout=60) == "xyz"
 
+    # pip is supported now (runtime_env_pip); conda/containers are not
     with pytest.raises(ValueError):
-        read_env.options(runtime_env={"pip": ["numpy"]})
+        read_env.options(runtime_env={"conda": "env.yml"})
     with pytest.raises(ValueError):
         read_env.options(runtime_env={"env_vars": {"A": 1}})
 
