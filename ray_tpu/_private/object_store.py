@@ -592,6 +592,11 @@ class PlasmaClient:
     def delete(self, object_id: ObjectID):
         self._rpc("store_delete", object_id)
 
+    def delete_batch(self, object_ids: List[ObjectID]):
+        """One RPC frees many objects (the ref-gc thread coalesces)."""
+        if object_ids:
+            self._rpc("store_delete_batch", list(object_ids))
+
     def close(self):
         try:
             self._view.release()

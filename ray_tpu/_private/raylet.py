@@ -366,13 +366,33 @@ class Raylet:
                     and h.tpu == need_tpu
                     and h.env_hash == env_hash
                 ) + self._spawns_inflight.get((need_tpu, env_hash), 0)
+                env_building = False
+                if runtime_env and runtime_env.get("pip"):
+                    # pip venv builds can take minutes: run them in the
+                    # background and keep this request parked (its server-
+                    # side deadline returns None and the client retries)
+                    # instead of wedging the lease handler past the client
+                    # RPC timeout
+                    from ray_tpu._private.runtime_env_pip import (
+                        ensure_pip_env_async,
+                    )
+
+                    env_building = (
+                        ensure_pip_env_async(
+                            self.session_dir,
+                            list(runtime_env["pip"]),
+                            runtime_env.get("pip_find_links"),
+                        )
+                        is None
+                    )
                 # each parked request holds one spawn credit, so concurrent
                 # requests overlap worker startups (up to the cap) instead
                 # of serializing on a single spawn-per-registration cycle;
                 # the spawning==0 fallback re-arms a request whose spawned
                 # worker was taken by a competing lease
                 if (
-                    (not my_spawned or spawning == 0)
+                    not env_building
+                    and (not my_spawned or spawning == 0)
                     and spawning < GlobalConfig.worker_spawn_parallelism
                     and len(self._workers) < GlobalConfig.max_workers_per_node
                 ):
@@ -720,6 +740,11 @@ class Raylet:
 
     def rpc_store_delete(self, conn, payload):
         self.store.delete(payload)
+        return True
+
+    def rpc_store_delete_batch(self, conn, payload):
+        for oid in payload:
+            self.store.delete(oid)
         return True
 
     def rpc_store_abort(self, conn, payload):

@@ -96,8 +96,14 @@ def ensure_pip_env(
         return python
     os.makedirs(os.path.dirname(root), exist_ok=True)
     tmp = f"{root}.tmp{os.getpid()}.{threading.get_ident()}"
-    _build_env(tmp, os.path.join(tmp, "bin", "python"), pip, find_links,
-               timeout_s)
+    try:
+        _build_env(tmp, os.path.join(tmp, "bin", "python"), pip, find_links,
+                   timeout_s)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     try:
         os.replace(tmp, root)
     except OSError:

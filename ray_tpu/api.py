@@ -82,7 +82,7 @@ def _check_options(options: Dict[str, Any]):
         supported = {"env_vars", "working_dir", "py_modules", "pip", "pip_find_links"}
         extra = set(env) - supported
         if extra:
-            # pip/conda need a per-node package installer (not built);
+            # conda/container envs need infrastructure not in this build;
             # fail loudly rather than silently ignore
             raise ValueError(
                 f"runtime_env fields {sorted(extra)} not supported "
