@@ -47,6 +47,7 @@ def env_ready(session_dir: str, pip: List[str],
 
 
 _building: set = set()
+_build_failures: Dict[str, str] = {}
 _building_lock = threading.Lock()
 
 
@@ -62,6 +63,12 @@ def ensure_pip_env_async(session_dir: str, pip: List[str],
         return ready
     key = pip_env_hash(pip, find_links)
     with _building_lock:
+        failure = _build_failures.get(key)
+        if failure is not None:
+            # sticky: the same requirements will fail the same way — raise
+            # so the lease handler fails the task with the pip error
+            # instead of rebuilding (and hanging the caller) forever
+            raise RuntimeError(failure)
         if key in _building:
             return None
         _building.add(key)
@@ -69,8 +76,12 @@ def ensure_pip_env_async(session_dir: str, pip: List[str],
     def _run():
         try:
             ensure_pip_env(session_dir, pip, find_links)
-        except Exception:
+        except Exception as e:  # noqa: BLE001
             logger.exception("background pip env build failed (%s)", pip)
+            with _building_lock:
+                _build_failures[key] = (
+                    f"runtime_env pip build failed for {pip}: {e}"
+                )
         finally:
             with _building_lock:
                 _building.discard(key)

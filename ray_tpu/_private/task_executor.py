@@ -96,6 +96,7 @@ class TaskExecutor:
         server.register("create_actor", self.rpc_create_actor)
         server.register("kill_self", self.rpc_kill_self)
         server.register("health", lambda conn, p: "ok")
+        server.register("profile", self.rpc_profile)
 
     # ------------------------------------------------------------------
 
@@ -391,6 +392,45 @@ class TaskExecutor:
             self._actors[actor_id] = _ActorState(instance, max_concurrency)
         logger.info("actor %s (%s) created", actor_id.hex()[:8], spec.get("class_name"))
         return True
+
+    def rpc_profile(self, conn: ServerConn, payload) -> Dict[str, Any]:
+        """On-demand CPU profile: sample every thread's stack for
+        ``duration_s`` at ``interval_s`` and return folded stacks (the
+        flamegraph text format). The in-process stand-in for the
+        reference's py-spy integration (dashboard/modules/reporter/
+        profile_manager.py:10-25) — no subprocess, no ptrace, works on any
+        live worker/actor."""
+        import sys as _sys
+        import time as _time
+
+        payload = payload or {}
+        duration = min(float(payload.get("duration_s", 2.0)), 30.0)
+        interval = max(float(payload.get("interval_s", 0.01)), 0.001)
+        folded: Dict[str, int] = {}
+        samples = 0
+        deadline = _time.monotonic() + duration
+        my_thread = threading.get_ident()
+        while _time.monotonic() < deadline:
+            for tid, frame in _sys._current_frames().items():
+                if tid == my_thread:
+                    continue  # don't profile the profiler
+                parts = []
+                f = frame
+                while f is not None:
+                    code = f.f_code
+                    parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                                 f"{code.co_name}:{f.f_lineno}")
+                    f = f.f_back
+                stack = ";".join(reversed(parts))
+                folded[stack] = folded.get(stack, 0) + 1
+            samples += 1
+            _time.sleep(interval)
+        return {
+            "pid": os.getpid(),
+            "samples": samples,
+            "duration_s": duration,
+            "folded": folded,
+        }
 
     def rpc_kill_self(self, conn: ServerConn, payload) -> bool:
         def _die():

@@ -160,6 +160,20 @@ class DashboardServer:
             "/api/summary": lambda: s.summarize_tasks(address=a),
             "/api/cluster": lambda: self._cluster_overview(),
         }
+        if path.split("?", 1)[0] == "/api/profile":
+            # /api/profile?actor=<hex>&duration=2 -> folded stacks
+            from urllib.parse import parse_qs
+
+            q = parse_qs(path.split("?", 1)[1] if "?" in path else "")
+            actor = (q.get("actor") or [""])[0]
+            duration = float((q.get("duration") or ["2.0"])[0])
+            prof = s.profile_actor(
+                actor, duration_s=duration, address=a
+            )
+            return (
+                json.dumps(_to_jsonable(prof)).encode(),
+                "application/json",
+            )
         fn = routes.get(path.split("?", 1)[0])
         if fn is None:
             return None, ""

@@ -88,6 +88,49 @@ def _map_block_task(fn, blk, batch_size, batch_format, fn_kwargs, mode):
     return out, _meta_of(out)
 
 
+def _zip_blocks_task(a_blk, b_blk):
+    cols = {name: a_blk.column(name) for name in a_blk.column_names}
+    for name in b_blk.column_names:
+        # right-side name collisions get a _1 suffix (the reference's
+        # Dataset.zip does the same disambiguation)
+        out = name if name not in cols else f"{name}_1"
+        cols[out] = b_blk.column(name)
+    table = pa.table(cols)
+    return table, _meta_of(table)
+
+
+_zip_blocks_task = ray_tpu.remote(_zip_blocks_task)
+
+
+def _join_partition_task(key, how, n_left, *parts):
+    # empty partition blocks still carry their side's SCHEMA (take() of
+    # zero indices preserves it), so never filter them out: an empty left
+    # partition must merge as an empty frame with left's columns, not the
+    # right's (outer/left/right joins null-fill correctly only then)
+    left = list(parts[:n_left])
+    right = list(parts[n_left:])
+    if not left or not right:
+        out = pa.table({})
+        return out, _meta_of(out)
+
+    def _concat_keep_schema(blocks):
+        # concat_blocks drops empties and would return a schema-LESS table
+        # for an all-empty side; the first block always carries the schema
+        nonempty = [b for b in blocks if b.num_rows]
+        if nonempty:
+            return pa.concat_tables(nonempty, promote_options="default")
+        return blocks[0]
+
+    a = _concat_keep_schema(left).to_pandas()
+    b = _concat_keep_schema(right).to_pandas()
+    merged = a.merge(b, on=key, how=how, suffixes=("", "_1"))
+    out = pa.Table.from_pandas(merged, preserve_index=False)
+    return out, _meta_of(out)
+
+
+_join_partition_task = ray_tpu.remote(_join_partition_task)
+
+
 @ray_tpu.remote
 def _slice_block_task(blk, start, end):
     out = B.block_slice(blk, start, end)
@@ -523,6 +566,97 @@ class Dataset:
             blocks += o._block_refs
             metas += o._meta_refs
         return Dataset(blocks, metas, self._stats + [("union", 0.0)])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned column concatenation (reference: Dataset.zip).
+        Both datasets repartition to identical row boundaries, then each
+        aligned block pair combines columns in one task."""
+        t0 = time.perf_counter()
+        n_a = sum(m.num_rows for m in self._fetch_metas())
+        n_b = sum(m.num_rows for m in other._fetch_metas())
+        if n_a != n_b:
+            raise ValueError(
+                f"zip requires equal row counts, got {n_a} vs {n_b}"
+            )
+        rows_a = [m.num_rows for m in self._fetch_metas()]
+        rows_b = [m.num_rows for m in other._fetch_metas()]
+        if rows_a == rows_b:
+            a, b = self, other  # already row-aligned: no data movement
+        else:
+            n = max(self.num_blocks(), 1)
+            a = self.repartition(n)
+            b = other.repartition(n)
+        pairs = [
+            _zip_blocks_task.options(num_returns=2).remote(ra, rb)
+            for ra, rb in zip(a._block_refs, b._block_refs)
+        ]
+        return self._derived(pairs, "zip", t0)
+
+    def join(self, other: "Dataset", key: str, *, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join on ``key`` (inner/left/right/outer).
+        Both sides hash-partition on the key; each partition joins via a
+        pandas merge in its own task (the all-to-all exchange pattern of
+        the reference's join operator)."""
+        t0 = time.perf_counter()
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+        P = num_partitions or max(self.num_blocks(), other.num_blocks(), 1)
+
+        def _partition(ds):
+            if P == 1:
+                return [[ref] for ref in ds._block_refs]
+            return [
+                _groupby_partition_task.options(num_returns=P).remote(
+                    ref, key, P
+                )
+                for ref in ds._block_refs
+            ]
+
+        parts_a = _partition(self)
+        parts_b = _partition(other)
+        if P == 1:
+            pairs = [
+                _join_partition_task.options(num_returns=2).remote(
+                    key, how, len(self._block_refs),
+                    *[r[0] for r in parts_a], *[r[0] for r in parts_b],
+                )
+            ]
+        else:
+            pairs = [
+                _join_partition_task.options(num_returns=2).remote(
+                    key, how, len(parts_a),
+                    *[parts_a[i][j] for i in range(len(parts_a))],
+                    *[parts_b[i][j] for i in range(len(parts_b))],
+                )
+                for j in range(P)
+            ]
+        return self._derived(pairs, f"join({key},{how})", t0)
+
+    def split_blocks(self, target_bytes: int) -> "Dataset":
+        """Split any block larger than ``target_bytes`` into row-aligned
+        slices (the reference's size-based output splitting in map
+        operators — bounded per-block memory for downstream consumers)."""
+        t0 = time.perf_counter()
+        metas = self._fetch_metas()
+        pairs = []
+        for i, (ref, m) in enumerate(zip(self._block_refs, metas)):
+            size = m.size_bytes or 0
+            if size <= target_bytes or m.num_rows <= 1:
+                # keep the known meta: (ref, None) would force a full block
+                # fetch later just to recompute row counts
+                pairs.append((ref, self._meta_refs[i]))
+                continue
+            k = min(-(-size // target_bytes), m.num_rows)
+            bounds = [m.num_rows * i // k for i in range(k + 1)]
+            for lo, hi in zip(bounds, bounds[1:]):
+                if lo < hi:
+                    pairs.append(
+                        _slice_block_task.options(num_returns=2).remote(
+                            ref, lo, hi
+                        )
+                    )
+        return self._derived(pairs, "split_blocks", t0)
 
     def limit(self, n: int) -> "Dataset":
         t0 = time.perf_counter()

@@ -226,7 +226,7 @@ class AsyncHTTPProxy:
                 value = (
                     await loop.run_in_executor(
                         self._submit_pool,
-                        lambda r=item: ray_tpu.get(r, timeout=30.0),
+                        lambda r=item: ray_tpu.get(r, timeout=90.0),
                     )
                     if hasattr(item, "binary")
                     else item

@@ -19,6 +19,8 @@ from collections import Counter, defaultdict
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
+    "profile_actor",
+    "folded_to_text",
     "list_actors",
     "list_jobs",
     "list_nodes",
@@ -76,6 +78,54 @@ def _gcs_call(method: str, payload=None, *, address: Optional[str] = None):
 
 def list_nodes(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
     return _gcs_call("get_nodes", address=address)
+
+
+def profile_actor(
+    actor_id,
+    *,
+    duration_s: float = 2.0,
+    interval_s: float = 0.01,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Sample a live actor's worker process and return folded stacks (the
+    flamegraph text format) — the reference's on-demand py-spy profile
+    (dashboard/modules/reporter/profile_manager.py:10-25), implemented as
+    in-process stack sampling over the worker's RPC server.
+
+    ``actor_id`` may be an ActorID, its hex string, or an ActorHandle."""
+    from ray_tpu._private.ids import ActorID
+    from ray_tpu._private.rpc import RpcClient
+
+    if hasattr(actor_id, "_actor_id"):
+        actor_id = actor_id._actor_id
+    if isinstance(actor_id, str):
+        actor_id = ActorID.from_hex(actor_id)
+    actors = list_actors(address=address)
+    row = next(
+        (a for a in actors if a["actor_id"] == actor_id and a["state"] == "ALIVE"),
+        None,
+    )
+    if row is None:
+        raise ValueError(f"no ALIVE actor {actor_id.hex()[:16]}")
+    client = RpcClient(tuple(row["address"]))
+    try:
+        return client.call(
+            "profile",
+            {"duration_s": duration_s, "interval_s": interval_s},
+            timeout=duration_s + 30.0,
+        )
+    finally:
+        client.close()
+
+
+def folded_to_text(profile: Dict[str, Any]) -> str:
+    """Render a profile result as flamegraph.pl-compatible folded lines."""
+    return "\n".join(
+        f"{stack} {count}"
+        for stack, count in sorted(
+            profile["folded"].items(), key=lambda kv: -kv[1]
+        )
+    )
 
 
 def list_actors(*, address: Optional[str] = None) -> List[Dict[str, Any]]:

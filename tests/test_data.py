@@ -217,3 +217,77 @@ def test_groupby_string_keys_across_workers(ray_start_regular):
     )
     sums = {r["city"]: r["sum(v)"] for r in ds.groupby("city").sum("v").take_all()}
     assert sums == {"NYC": 10.0, "SF": 10.0, "LA": 10.0}
+
+
+def test_zip(ray_start_regular):
+    import ray_tpu.data as rt
+
+    a = rt.range(40, parallelism=4).materialize()
+    b = a.map_batches(lambda x, **_: {"double": x["id"] * 2}).materialize()
+    z = a.zip(b)
+    rows = sorted(z.take_all(), key=lambda r: r["id"])
+    assert rows[5] == {"id": 5, "double": 10}
+    assert len(rows) == 40
+    # name collision gets the _1 suffix
+    z2 = a.zip(a)
+    assert set(z2.take(1)[0]) == {"id", "id_1"}
+
+
+def test_join_inner_and_left(ray_start_regular):
+    import ray_tpu.data as rt
+
+    left = rt.from_items(
+        [{"k": i, "a": i * 10} for i in range(8)], parallelism=2
+    ).materialize()
+    right = rt.from_items(
+        [{"k": i, "b": i * 100} for i in range(4, 12)], parallelism=3
+    ).materialize()
+    inner = sorted(left.join(right, "k").take_all(), key=lambda r: r["k"])
+    assert [r["k"] for r in inner] == [4, 5, 6, 7]
+    assert inner[0] == {"k": 4, "a": 40, "b": 400}
+    lj = sorted(left.join(right, "k", how="left").take_all(),
+                key=lambda r: r["k"])
+    assert len(lj) == 8
+    assert lj[0]["b"] is None or lj[0]["b"] != lj[0]["b"]  # null-filled
+
+
+def test_split_blocks_bounds_block_size(ray_start_regular):
+    import numpy as np
+
+    import ray_tpu.data as rt
+
+    ds = rt.from_numpy(
+        {"x": np.arange(20000, dtype=np.int64)}, parallelism=1
+    ).materialize()
+    assert ds.num_blocks() == 1
+    small = ds.split_blocks(16 * 1024)  # 160KB block -> ~10 slices
+    metas = small._fetch_metas()
+    assert len(metas) >= 8
+    assert all(m.size_bytes <= 32 * 1024 for m in metas)
+    assert sum(m.num_rows for m in metas) == 20000
+    got = np.sort(np.concatenate(
+        [b["x"] for b in small.iter_batches(batch_size=None)]
+    ))
+    np.testing.assert_array_equal(got, np.arange(20000))
+
+
+def test_join_right_with_one_sided_partitions(ray_start_regular):
+    """Partitions holding only one side must keep that side's schema
+    (empty-side frames null-fill, never adopt the other side's columns)."""
+    import ray_tpu.data as rt
+
+    left = rt.from_items(
+        [{"k": i, "a": i} for i in range(3)], parallelism=2
+    ).materialize()
+    right = rt.from_items(
+        [{"k": i, "b": i * 2} for i in range(2, 9)], parallelism=3
+    ).materialize()
+    rj = sorted(
+        left.join(right, "k", how="right", num_partitions=5).take_all(),
+        key=lambda r: r["k"],
+    )
+    assert [r["k"] for r in rj] == [2, 3, 4, 5, 6, 7, 8]
+    assert all("a" in r and "b" in r for r in rj)
+    assert rj[0]["a"] == 2 and rj[0]["b"] == 4
+    unmatched = [r for r in rj if r["k"] > 2]
+    assert all(r["a"] is None or r["a"] != r["a"] for r in unmatched)

@@ -79,6 +79,7 @@ def test_streaming_ndjson_response(serve_cluster):
     status, body = _post(f"{proxy.address}/tokens/stream", 5)
     assert status == 200
     lines = [json.loads(l) for l in body.decode().strip().splitlines()]
+    assert all("result" in l for l in lines), lines  # no error lines
     assert [l["result"]["token"] for l in lines] == [0, 1, 2, 3, 4]
     proxy.stop()
 
