@@ -47,7 +47,10 @@ def env_ready(session_dir: str, pip: List[str],
 
 
 _building: set = set()
-_build_failures: Dict[str, str] = {}
+# key -> (monotonic_ts, message); entries expire so a transient failure
+# (index 503, disk blip) retries instead of poisoning the env forever
+_build_failures: Dict[str, tuple] = {}
+_BUILD_FAILURE_TTL_S = 60.0
 _building_lock = threading.Lock()
 
 
@@ -65,10 +68,13 @@ def ensure_pip_env_async(session_dir: str, pip: List[str],
     with _building_lock:
         failure = _build_failures.get(key)
         if failure is not None:
-            # sticky: the same requirements will fail the same way — raise
-            # so the lease handler fails the task with the pip error
-            # instead of rebuilding (and hanging the caller) forever
-            raise RuntimeError(failure)
+            ts, msg = failure
+            if time.monotonic() - ts < _BUILD_FAILURE_TTL_S:
+                # raise so the lease handler fails the task with the pip
+                # error instead of rebuilding (and parking callers) in a
+                # tight loop; after the TTL a fresh build retries
+                raise RuntimeError(msg)
+            del _build_failures[key]
         if key in _building:
             return None
         _building.add(key)
@@ -80,7 +86,8 @@ def ensure_pip_env_async(session_dir: str, pip: List[str],
             logger.exception("background pip env build failed (%s)", pip)
             with _building_lock:
                 _build_failures[key] = (
-                    f"runtime_env pip build failed for {pip}: {e}"
+                    time.monotonic(),
+                    f"runtime_env pip build failed for {pip}: {e}",
                 )
         finally:
             with _building_lock:

@@ -92,8 +92,11 @@ def _zip_blocks_task(a_blk, b_blk):
     cols = {name: a_blk.column(name) for name in a_blk.column_names}
     for name in b_blk.column_names:
         # right-side name collisions get a _1 suffix (the reference's
-        # Dataset.zip does the same disambiguation)
-        out = name if name not in cols else f"{name}_1"
+        # Dataset.zip does the same disambiguation); chain suffixes until
+        # free so an existing <name>_1 column is never clobbered
+        out = name
+        while out in cols:
+            out += "_1"
         cols[out] = b_blk.column(name)
     table = pa.table(cols)
     return table, _meta_of(table)
@@ -109,9 +112,18 @@ def _join_partition_task(key, how, n_left, *parts):
     # right's (outer/left/right joins null-fill correctly only then)
     left = list(parts[:n_left])
     right = list(parts[n_left:])
-    if not left or not right:
+    if not left and not right:
         out = pa.table({})
         return out, _meta_of(out)
+    if not left or not right:
+        # one side has ZERO blocks (empty dataset): its schema is unknown
+        # beyond the join key — degrade to a key-only empty frame so
+        # right/outer joins still keep the populated side's rows
+        key_only = pa.table({key: pa.array([], type=pa.null())})
+        if not left:
+            left = [key_only]
+        else:
+            right = [key_only]
 
     def _concat_keep_schema(blocks):
         # concat_blocks drops empties and would return a schema-LESS table
@@ -199,8 +211,18 @@ def _groupby_partition_task(blk, key, n_parts):
     # deterministic hash: Python's hash() is salt-randomized per process
     # for str/bytes, which would scatter one key across partitions
     col = blk.column(key).to_numpy(zero_copy_only=False)
+
+    def _canon(x):
+        # equal keys of different numeric dtypes (int 2, float 2.0) must
+        # land in the same partition — pandas merge would match them
+        if isinstance(x, bool):
+            return repr(x)
+        if isinstance(x, (int, float, np.integer, np.floating)):
+            return repr(float(x))
+        return repr(x)
+
     h = np.array(
-        [zlib.crc32(repr(x).encode()) % n_parts for x in col.tolist()]
+        [zlib.crc32(_canon(x).encode()) % n_parts for x in col.tolist()]
     )
     return [blk.take(pa.array(np.nonzero(h == j)[0])) for j in range(n_parts)]
 

@@ -104,3 +104,95 @@ def test_client_mode_end_to_end(ray_start_regular):
         assert out["task_err"] is True
     finally:
         server.stop()
+
+
+CONCURRENT_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1])
+    M = int(sys.argv[2])  # captured by value into the task closure
+
+    @ray_tpu.remote
+    def mul(x, m=M):
+        return x * m
+
+    vals = ray_tpu.get([mul.remote(i) for i in range(10)], timeout=120)
+    assert vals == [i * M for i in range(10)], vals
+    print("CLIENT_OK")
+    ray_tpu.shutdown()
+    """
+)
+
+
+def _client_env():
+    env = {**os.environ, "PYTHONPATH": REPO}
+    if _rpc_mod.session_token():
+        env["RAYTPU_AUTH_TOKEN"] = _rpc_mod.session_token()
+    return env
+
+
+def test_two_concurrent_clients(ray_start_regular):
+    """Two client processes drive the same bridge at once; results stay
+    isolated per connection (r2 review: client mode was single-test deep)."""
+    server = ClientServer(port=0)
+    host, port = server.address
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-u", "-c", CONCURRENT_SCRIPT,
+                 f"raytpu://{host}:{port}", str(mult)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=_client_env(),
+            )
+            for mult in (3, 7)
+        ]
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out
+            assert "CLIENT_OK" in out
+    finally:
+        server.stop()
+
+
+def test_client_reconnect_after_disconnect(ray_start_regular):
+    """A second session against the same server works after the first
+    client disconnected (connection-scoped pins must not leak/break)."""
+    server = ClientServer(port=0)
+    host, port = server.address
+    try:
+        for attempt in range(2):
+            proc = subprocess.run(
+                [sys.executable, "-u", "-c", CONCURRENT_SCRIPT,
+                 f"raytpu://{host}:{port}", "2"],
+                capture_output=True, text=True, timeout=180,
+                env=_client_env(),
+            )
+            assert proc.returncode == 0, (attempt, proc.stdout, proc.stderr)
+    finally:
+        server.stop()
+
+
+def test_client_rejects_without_token(ray_start_regular):
+    """A client lacking the session token is refused (auth covers the
+    bridge port too)."""
+    if not _rpc_mod.session_token():
+        return  # token-less session: nothing to verify
+    server = ClientServer(port=0)
+    host, port = server.address
+    env = {**os.environ, "PYTHONPATH": REPO}
+    env.pop("RAYTPU_AUTH_TOKEN", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c",
+             "import sys, ray_tpu\n"
+             "ray_tpu.init(address=sys.argv[1])\n"
+             "print('SHOULD-NOT-CONNECT')",
+             f"raytpu://{host}:{port}"],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert "SHOULD-NOT-CONNECT" not in proc.stdout
+        assert proc.returncode != 0
+    finally:
+        server.stop()
