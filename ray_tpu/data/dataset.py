@@ -218,7 +218,7 @@ def _groupby_partition_task(blk, key, n_parts):
         if isinstance(x, bool):
             return repr(x)
         if isinstance(x, (int, float, np.integer, np.floating)):
-            return repr(float(x))
+            return repr(float(x) + 0.0)  # +0.0 folds -0.0 into 0.0
         return repr(x)
 
     h = np.array(

@@ -178,7 +178,9 @@ def test_client_rejects_without_token(ray_start_regular):
     """A client lacking the session token is refused (auth covers the
     bridge port too)."""
     if not _rpc_mod.session_token():
-        return  # token-less session: nothing to verify
+        import pytest
+
+        pytest.skip("token-less session: auth gate not active")
     server = ClientServer(port=0)
     host, port = server.address
     env = {**os.environ, "PYTHONPATH": REPO}

@@ -85,28 +85,15 @@ def test_dashboard_apis(ray_start_regular):
 def test_dashboard_apis_and_metrics(dashboard_cluster):
     """Every JSON API route answers with well-formed data; /metrics serves
     Prometheus exposition (r2 review: dashboard was single-test deep)."""
-    import json as _json
-    import urllib.request
-
     base = dashboard_cluster
     for route in ("/api/nodes", "/api/actors", "/api/tasks", "/api/jobs",
                   "/api/placement_groups", "/api/summary", "/api/cluster"):
-        with urllib.request.urlopen(f"{base}{route}", timeout=30) as r:
-            assert r.status == 200, route
-            _json.loads(r.read())
-    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
-        assert r.status == 200
-    with urllib.request.urlopen(f"{base}/", timeout=30) as r:
-        assert b"<html" in r.read().lower()
+        json.loads(_get(f"{base}{route}"))
+    assert _get(f"{base}/metrics") is not None
+    assert b"<html" in _get(f"{base}/").lower()
 
 
 def test_dashboard_profile_endpoint(dashboard_cluster):
-    import json as _json
-    import time
-    import urllib.request
-
-    import ray_tpu
-
     @ray_tpu.remote(max_concurrency=2)
     class Spin:
         def busy_spin(self, s):
@@ -124,8 +111,7 @@ def test_dashboard_profile_endpoint(dashboard_cluster):
     time.sleep(0.3)
     url = (f"{dashboard_cluster}/api/profile?"
            f"actor={a._actor_id.hex()}&duration=1")
-    with urllib.request.urlopen(url, timeout=60) as r:
-        prof = _json.loads(r.read())
+    prof = json.loads(_get(url))
     assert prof["samples"] > 5
     assert any("busy_spin" in stack for stack in prof["folded"])
     ray_tpu.get(ref, timeout=60)
@@ -133,7 +119,6 @@ def test_dashboard_profile_endpoint(dashboard_cluster):
 
 def test_dashboard_unknown_route_404(dashboard_cluster):
     import urllib.error
-    import urllib.request
 
     try:
         urllib.request.urlopen(f"{dashboard_cluster}/api/nope", timeout=30)

@@ -227,16 +227,20 @@ def test_memory_monitor_kills_busy_worker():
             with raylet._res_cv:
                 busy = [
                     h for h in raylet._workers.values()
-                    if not h.idle and h.proc is not None and not h.actor_ids
+                    if not h.idle and h.proc is not None
+                    and h.registered.is_set() and not h.actor_ids
                 ]
             if busy:
                 break
             time.sleep(0.1)
         assert busy, "task never started"
+        # let the push land on the worker before killing it: a kill racing
+        # the push exercises the lease-retry path, not the crash path
+        time.sleep(0.5)
 
         assert raylet._kill_for_memory(0.99) is True
         with pytest.raises(ray_tpu.RayTpuError):
-            ray_tpu.get(ref, timeout=60)
+            ray_tpu.get(ref, timeout=120)
 
         @ray_tpu.remote
         def ok():

