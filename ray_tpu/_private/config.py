@@ -43,9 +43,19 @@ class _Config:
         # --- scheduling ---
         "worker_lease_timeout_s": 30.0,
         # concurrent worker startups per raylet: overlaps interpreter boot
-        # (reference: worker_pool.h maximum_startup_concurrency)
-        "worker_spawn_parallelism": 4,
+        # (reference: worker_pool.h maximum_startup_concurrency). Forked
+        # workers cost ~10ms each, so a deeper pipeline keeps the core busy
+        # during the RPC-bound parts of worker registration.
+        "worker_spawn_parallelism": 12,
         "worker_pool_prestart": 0,
+        # max normal tasks pipelined to one leased worker in a single frame
+        # (reference: backlog-driven pipelined submission,
+        # direct_task_transport.cc:346)
+        "task_push_batch": 64,
+        # fork workers from a pre-imported template process instead of
+        # booting a fresh interpreter (~2s import cost) per worker
+        # (reference: worker prestart/startup concurrency, worker_pool.h:167)
+        "worker_forkserver": True,
         "worker_idle_timeout_s": 60.0,
         "max_workers_per_node": 64,
         "scheduler_spread_threshold": 0.5,
@@ -102,6 +112,15 @@ class _Config:
             env = os.environ.get(_ENV_PREFIX + name.upper())
             if env is not None:
                 self._values[name] = _coerce(env, default)
+
+    def refresh_from_env(self):
+        """Re-read RAYTPU_* env overrides. Needed by fork-server workers:
+        the template imported this module (snapshotting os.environ) long
+        before the per-fork env — including runtime_env env_vars — was
+        applied in the child, so Popen-spawned and forked workers would
+        otherwise honor different configs for the same runtime_env."""
+        with self._lock:
+            self._load_env()
 
     def initialize(self, system_config: Dict[str, Any] | None):
         """Apply a _system_config dict (wins over env)."""

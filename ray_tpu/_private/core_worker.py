@@ -237,6 +237,7 @@ class CoreWorker:
         self._dynamic_children: Dict[bytes, List[bytes]] = {}
         self._lease_waiting: Dict[Tuple, Any] = {}  # sig -> deque[spec]
         self._lease_inflight: Dict[Tuple, int] = {}  # sig -> lease rpcs out
+        self._active_pushes: Dict[Tuple, int] = {}  # sig -> pushes in flight
         self._lease_lock = threading.Lock()
         # raylet clients for spillback leasing on other nodes
         self._raylet_clients: Dict[Tuple[str, int], RpcClient] = {}
@@ -517,7 +518,22 @@ class CoreWorker:
             return  # another thread promoted it concurrently
         self.plasma._view[offset : offset + size] = data
         self.raylet.call("store_seal", object_id)
-        self._promoted.add(object_id.binary())
+        binary = object_id.binary()
+        self._promoted.add(binary)
+        # Close the seal->mark window (ADVICE r3): if the final local ref
+        # dropped while we were sealing, _process_ref_deleted classified the
+        # object inline-only (mark not yet visible) and skipped the plasma
+        # delete — detect that here and free the copy ourselves. Marking
+        # BEFORE create would be worse: the deleter may then free the
+        # UNSEALED entry while this thread is still memcpying into it.
+        with self._local_refs_lock:
+            gone = self._local_refs.get(binary, 0) <= 0
+        if gone:
+            self._promoted.discard(binary)
+            try:
+                self.plasma.delete(object_id)
+            except Exception:
+                pass
 
     def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -920,8 +936,28 @@ class CoreWorker:
                 if not stack or not waiting:
                     return
                 lease, lease_raylet, client, _ts = stack.pop()
-                spec = waiting.popleft()
-            self._push_spec(spec, sig, lease, lease_raylet, client)
+                specs = self._pop_waiting_batch_locked(sig)
+            self._push_specs(specs, sig, lease, lease_raylet, client)
+
+    def _pop_waiting_batch_locked(self, sig: Tuple) -> List[Dict[str, Any]]:
+        """Pop a fair share of the waiting backlog (lease lock held). Backlog
+        beyond one task rides a single batched push — the 1-frame-per-task
+        round trip is what capped async throughput at 0.16x baseline
+        (reference analogue: backlog-driven pipelined grants,
+        direct_task_transport.cc:346). The share divides the backlog by the
+        number of workers currently running pushes so one idle worker never
+        swallows work that other (about-to-be-idle) workers should get —
+        batching must not serialize long tasks onto one process."""
+        waiting = self._lease_waiting.get(sig)
+        active = self._active_pushes.get(sig, 0)
+        cap = min(
+            GlobalConfig.task_push_batch,
+            max(1, len(waiting) // (active + 1)),
+        )
+        out = [waiting.popleft()]
+        while waiting and len(out) < cap:
+            out.append(waiting.popleft())
+        return out
 
     def _ensure_lease_requests(self, sig: Tuple):
         """Keep enough lease requests in flight to cover the waiting queue
@@ -1003,30 +1039,46 @@ class CoreWorker:
             self._ensure_lease_requests(sig)
 
     def _on_worker_idle(self, sig, lease, lease_raylet, client, stash_ok=True):
-        """A leased worker has no task: give it the next waiting spec, or
-        (when ``stash_ok``, i.e. it just finished a task) cache the lease
+        """A leased worker has no task: give it the waiting backlog (batched),
+        or (when ``stash_ok``, i.e. it just finished a task) cache the lease
         briefly — the sweeper returns it if demand stays zero. A freshly
         granted lease with no takers goes straight back to the raylet."""
         with self._lease_lock:
             waiting = self._lease_waiting.get(sig)
-            spec = waiting.popleft() if waiting else None
-            if spec is None and stash_ok:
+            specs = self._pop_waiting_batch_locked(sig) if waiting else None
+            if specs is None and stash_ok:
                 if len(self._idle_leases.setdefault(sig, [])) < 16:
                     self._idle_leases[sig].append(
                         (lease, lease_raylet, client, time.monotonic())
                     )
                     return
-        if spec is None:
+        if specs is None:
             self._return_lease(lease, lease_raylet)
             return
-        self._push_spec(spec, sig, lease, lease_raylet, client)
+        self._push_specs(specs, sig, lease, lease_raylet, client)
+
+    def _push_active_inc(self, sig):
+        if sig is not None:
+            with self._lease_lock:
+                self._active_pushes[sig] = self._active_pushes.get(sig, 0) + 1
+
+    def _push_active_dec(self, sig):
+        if sig is not None:
+            with self._lease_lock:
+                n = self._active_pushes.get(sig, 1) - 1
+                if n > 0:
+                    self._active_pushes[sig] = n
+                else:
+                    self._active_pushes.pop(sig, None)
 
     def _push_spec(self, spec, sig, lease, lease_raylet, client, cacheable=True):
         """Push one task to a leased worker; when the reply arrives the
         worker goes back through _on_worker_idle (cacheable leases) or the
         lease is returned (affinity leases)."""
+        self._push_active_inc(sig)
 
         def _worker_idle():
+            self._push_active_dec(sig)
             if cacheable:
                 self._on_worker_idle(sig, lease, lease_raylet, client)
             else:
@@ -1037,6 +1089,7 @@ class CoreWorker:
                 _worker_idle()
                 self._handle_reply(spec, payload)
             elif isinstance(payload, (ConnectionLost, OSError)):
+                self._push_active_dec(sig)
                 self._return_lease(lease, lease_raylet)
                 # worker died mid-task: owner-side retry (task_manager.h:277)
                 if spec["retries_left"] > 0:
@@ -1059,6 +1112,44 @@ class CoreWorker:
                 self._fail_task(spec, payload)
 
         client.call_async("push_task", spec, on_done)
+
+    def _push_specs(self, specs, sig, lease, lease_raylet, client):
+        """Push a backlog batch to one leased worker in a single frame; the
+        worker executes sequentially and replies with a list (one entry per
+        spec, exceptions inline). On worker death the whole batch retries."""
+        if len(specs) == 1:
+            self._push_spec(specs[0], sig, lease, lease_raylet, client)
+            return
+        self._push_active_inc(sig)
+
+        def on_done(kind, payload, specs=specs):
+            self._push_active_dec(sig)
+            if kind == rpc_mod.RESPONSE:
+                self._on_worker_idle(sig, lease, lease_raylet, client)
+                for spec, reply in zip(specs, payload):
+                    if isinstance(reply, BaseException):
+                        self._fail_task(spec, reply)
+                    else:
+                        self._handle_reply(spec, reply)
+            elif isinstance(payload, (ConnectionLost, OSError)):
+                self._return_lease(lease, lease_raylet)
+                for spec in specs:
+                    if spec["retries_left"] > 0:
+                        spec["retries_left"] -= 1
+                        self._submit_queue.put(spec)
+                    else:
+                        self._fail_task(
+                            spec,
+                            WorkerCrashedError(
+                                f"worker died running {spec['name']}: {payload}"
+                            ),
+                        )
+            else:
+                self._on_worker_idle(sig, lease, lease_raylet, client)
+                for spec in specs:
+                    self._fail_task(spec, payload)
+
+        client.call_async("push_task_batch", specs, on_done)
 
     def _sweep_idle_leases(self, max_age: float = 1.0):
         """Return leases that sat unused past max_age (runs on the event

@@ -41,6 +41,21 @@ def main():
     gcs_addr = (os.environ["RAYTPU_GCS_HOST"], int(os.environ["RAYTPU_GCS_PORT"]))
     session_dir = os.environ.get("RAYTPU_SESSION_DIR", "/tmp")
 
+    import time as _time
+
+    _boot_t0 = _time.monotonic()
+    _timing = os.environ.get("RAYTPU_BOOT_TIMING") == "1"
+
+    def _mark(stage: str):
+        if _timing:
+            print(
+                f"[boot-timing] {stage} +{_time.monotonic() - _boot_t0:.3f}s"
+                f" wall={_time.time():.3f}",
+                flush=True,
+            )
+
+    _mark("main_entry")
+
     core = CoreWorker(
         mode="worker",
         job_id=JobID.from_int(0),
@@ -49,6 +64,7 @@ def main():
         worker_id=worker_id,
         session_dir=session_dir,
     )
+    _mark("core_worker")
     # adopt the cluster-wide config (the driver's _system_config) before
     # any task runs; local RAYTPU_* env overrides keep precedence
     from ray_tpu._private.config import GlobalConfig
@@ -57,9 +73,12 @@ def main():
         GlobalConfig.apply_cluster(core.gcs.call("get_config", timeout=10.0))
     except Exception:
         logging.getLogger(__name__).warning("could not fetch cluster config")
+    _mark("cluster_config")
     server = RpcServer(f"worker-{worker_id.hex()[:8]}")
     TaskExecutor(core, server)
+    _mark("task_executor")
     core.late_register(server.address)
+    _mark("late_register")
 
     # expose the runtime to user code running in tasks
     worker_mod.global_worker = worker_mod.Worker(core, session_dir, is_driver=False)

@@ -202,3 +202,11 @@ class ObjectRefGenerator:
 
     def __repr__(self):
         return f"ObjectRefGenerator({len(self._refs)} refs)"
+
+
+# ObjectRefGenerator is a plain value type but neither a BaseID nor an
+# exception, so the control-plane unpickler's structural passes don't cover
+# it — register it explicitly (rpc._ControlUnpickler policy).
+from ray_tpu._private.rpc import register_control_class  # noqa: E402
+
+register_control_class(ObjectRefGenerator)

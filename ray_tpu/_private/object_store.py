@@ -243,14 +243,23 @@ class PlasmaStore:
         self._fd = os.open(self.path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         os.ftruncate(self._fd, self.capacity)
         if GlobalConfig.object_store_prealloc:
-            # allocate the tmpfs pages up front (~0.1s/GiB): first-touch
-            # writes then take minor faults (~1.5 GiB/s) instead of
-            # allocate+zero faults (~0.3 GiB/s); the background prefault
-            # below upgrades that to full memcpy speed shortly after boot
+            # allocate tmpfs pages up front (~0.1s/GiB): first-touch writes
+            # then take minor faults (~1.5 GiB/s) instead of allocate+zero
+            # faults (~0.3 GiB/s). Bounded to half the free shm space so
+            # multi-raylet in-process clusters (tests/bench run 4+ stores on
+            # one host) don't commit N×capacity of RAM while idle — the
+            # remainder stays allocate-on-use (ADVICE r3).
+            prealloc = self.capacity
             try:
-                os.posix_fallocate(self._fd, 0, self.capacity)
+                st = os.statvfs(shm_dir)
+                prealloc = min(prealloc, (st.f_bavail * st.f_frsize) // 2)
             except OSError:
                 pass
+            if prealloc > 0:
+                try:
+                    os.posix_fallocate(self._fd, 0, prealloc)
+                except OSError:
+                    pass
         self._map = mmap.mmap(self._fd, self.capacity)
         self._view = memoryview(self._map)
         self._arena = _make_arena(self.capacity)

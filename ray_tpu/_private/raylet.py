@@ -33,6 +33,166 @@ from ray_tpu._private.runtime_env_packaging import (
 logger = logging.getLogger(__name__)
 
 
+class ForkedProc:
+    """Popen-shaped handle for a worker forked by the fork-server template.
+
+    The child is the TEMPLATE's child, not ours, so Popen semantics are
+    emulated with signals: liveness via ``kill(pid, 0)`` (the template reaps
+    zombies promptly, so a dead child stops answering within its reap tick).
+    """
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except (ProcessLookupError, PermissionError):
+            self.returncode = -1
+            return self.returncode
+
+    def terminate(self):
+        try:
+            os.kill(self.pid, 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def kill(self):
+        try:
+            os.kill(self.pid, 9)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def send_signal(self, sig: int):
+        try:
+            os.kill(self.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+
+class ForkServer:
+    """Process-wide client to ONE worker fork-server template (shared by
+    every in-process raylet — per-fork requests carry the full worker
+    identity, so multi-raylet test clusters reuse a single template).
+    Template boot (~2-5 s: interpreter + jax via sitecustomize + framework
+    imports) is paid once, lazily, on the first CPU-worker spawn."""
+
+    _instance: Optional["ForkServer"] = None
+    _ilock = threading.Lock()
+
+    @classmethod
+    def get(cls, session_dir: str) -> "ForkServer":
+        with cls._ilock:
+            if cls._instance is None or not cls._instance.alive():
+                old = cls._instance
+                if old is not None:
+                    # reap the dead template (poll() waits the zombie) and
+                    # release its socket before standing up a replacement
+                    try:
+                        old._proc.poll()
+                        if old._conn is not None:
+                            old._conn.close()
+                    except OSError:
+                        pass
+                cls._instance = cls(session_dir)
+                import atexit
+
+                atexit.register(cls._instance.stop)
+            return cls._instance
+
+    def __init__(self, session_dir: str):
+        import socket as _socket
+
+        self._lock = threading.Lock()
+        self._sock_path = os.path.join(
+            session_dir, f"forkserver_{os.getpid()}.sock"
+        )
+        env = dict(os.environ)
+        env["RAYTPU_FORKSERVER_SOCK"] = self._sock_path
+        env["JAX_PLATFORMS"] = "cpu"  # forked workers are CPU workers
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (pkg_root, env.get("PYTHONPATH", "")) if p
+        )
+        log_path = os.path.join(session_dir, "logs", "forkserver.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        with open(log_path, "ab") as logfile:
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_forkserver"],
+                env=env,
+                stdout=logfile,
+                stderr=subprocess.STDOUT,
+            )
+        # the template accepts connections only after its imports finish
+        deadline = time.monotonic() + 120
+        self._conn = None
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"fork-server template exited with {self._proc.returncode} "
+                    f"(see {log_path})"
+                )
+            try:
+                c = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+                c.connect(self._sock_path)
+                self._conn = c
+                break
+            except OSError:
+                time.sleep(0.1)
+        if self._conn is None:
+            raise RuntimeError("fork-server template did not come up")
+
+    def alive(self) -> bool:
+        return self._proc.poll() is None and self._conn is not None
+
+    def fork_worker(
+        self,
+        env: Dict[str, str],
+        log_path: str,
+        cwd: Optional[str],
+        sys_path: List[str],
+    ) -> ForkedProc:
+        from ray_tpu._private.worker_forkserver import _read_msg, _send_msg
+
+        with self._lock:
+            _send_msg(
+                self._conn,
+                {"env": env, "log_path": log_path, "cwd": cwd, "sys_path": sys_path},
+            )
+            reply = _read_msg(self._conn)
+        if not reply or "pid" not in reply:
+            raise RuntimeError("fork-server did not return a pid")
+        return ForkedProc(reply["pid"])
+
+    def stop(self):
+        try:
+            from ray_tpu._private.worker_forkserver import _send_msg
+
+            with self._lock:
+                _send_msg(self._conn, {"op": "shutdown"})
+        except OSError:
+            pass
+        try:
+            self._proc.terminate()
+        except OSError:
+            pass
+
+
 class WorkerHandle:
     def __init__(self, worker_id: WorkerID, proc: Optional[subprocess.Popen], tpu: bool = False,
                  env_hash: tuple = ()):
@@ -133,11 +293,14 @@ class Raylet:
                       runtime_env: Optional[Dict[str, Any]] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         renv = runtime_env or {}
-        env = dict(os.environ)
+        # env OVERRIDES relative to this process's environment: applied on
+        # top of os.environ for the Popen path, or inside the forked child
+        # for the fork-server path (whose template inherited os.environ)
+        overrides: Dict[str, str] = {}
         if renv.get("env_vars"):
             # runtime_env: workers are pooled per runtime_env hash (the
             # reference keys its worker pool the same way)
-            env.update(renv["env_vars"])
+            overrides.update(renv["env_vars"])
         # working_dir / py_modules: extract once per node into the session
         # cache; the worker starts with cwd inside the working_dir and the
         # extracted roots on PYTHONPATH (reference:
@@ -156,15 +319,43 @@ class Raylet:
         from ray_tpu._private import rpc as rpc_mod
 
         if rpc_mod.session_token():
-            env["RAYTPU_AUTH_TOKEN"] = rpc_mod.session_token()
-        env["RAYTPU_WORKER_ID"] = worker_id.hex()
-        env["RAYTPU_RAYLET_HOST"] = self.server.host
-        env["RAYTPU_RAYLET_PORT"] = str(self.server.port)
-        env["RAYTPU_GCS_HOST"] = self.gcs_address[0]
-        env["RAYTPU_GCS_PORT"] = str(self.gcs_address[1])
-        env["RAYTPU_SESSION_DIR"] = self.session_dir
-        env["RAYTPU_NODE_ID"] = self.node_id.hex()
-        env["PYTHONUNBUFFERED"] = "1"  # prints stream to the log monitor
+            overrides["RAYTPU_AUTH_TOKEN"] = rpc_mod.session_token()
+        overrides["RAYTPU_WORKER_ID"] = worker_id.hex()
+        overrides["RAYTPU_RAYLET_HOST"] = self.server.host
+        overrides["RAYTPU_RAYLET_PORT"] = str(self.server.port)
+        overrides["RAYTPU_GCS_HOST"] = self.gcs_address[0]
+        overrides["RAYTPU_GCS_PORT"] = str(self.gcs_address[1])
+        overrides["RAYTPU_SESSION_DIR"] = self.session_dir
+        overrides["RAYTPU_NODE_ID"] = self.node_id.hex()
+        overrides["PYTHONUNBUFFERED"] = "1"  # prints stream to the log monitor
+        # per-node log dir: each raylet's log monitor tails only ITS OWN
+        # workers (a shared dir made every monitor scan every worker's log —
+        # O(nodes x workers) file churn and duplicate publishes)
+        log_path = os.path.join(
+            self.session_dir, "logs", self.node_id.hex()[:12],
+            f"worker-{worker_id.hex()[:12]}.log",
+        )
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        env_hash = runtime_env_key(renv)
+        # fast path: fork from the pre-imported template (~10 ms) instead of
+        # booting an interpreter (~2 s). TPU workers keep the Popen path —
+        # the template pinned JAX_PLATFORMS=cpu at its own import time — and
+        # pip envs need a different interpreter entirely.
+        if GlobalConfig.worker_forkserver and not tpu and not renv.get("pip"):
+            try:
+                proc = ForkServer.get(self.session_dir).fork_worker(
+                    overrides, log_path, cwd, env_paths
+                )
+                handle = WorkerHandle(worker_id, proc, tpu=tpu, env_hash=env_hash)
+                with self._res_cv:
+                    self._workers[worker_id] = handle
+                return handle
+            except Exception:
+                logger.exception(
+                    "fork-server spawn failed; falling back to subprocess"
+                )
+        env = dict(os.environ)
+        env.update(overrides)
         if not tpu:
             # CPU workers must not claim the TPU runtime: force the CPU
             # platform and disable the TPU PJRT plugin registration.
@@ -176,14 +367,6 @@ class Raylet:
         env["PYTHONPATH"] = os.pathsep.join(
             p for p in (*env_paths, pkg_root, env.get("PYTHONPATH", "")) if p
         )
-        # per-node log dir: each raylet's log monitor tails only ITS OWN
-        # workers (a shared dir made every monitor scan every worker's log —
-        # O(nodes x workers) file churn and duplicate publishes)
-        log_path = os.path.join(
-            self.session_dir, "logs", self.node_id.hex()[:12],
-            f"worker-{worker_id.hex()[:12]}.log",
-        )
-        os.makedirs(os.path.dirname(log_path), exist_ok=True)
         interpreter = sys.executable
         if renv.get("pip"):
             # per-requirements venv (cached by hash); the worker runs under
@@ -208,7 +391,7 @@ class Raylet:
         finally:
             logfile.close()  # the child holds its own inherited fd
         handle = WorkerHandle(
-            worker_id, proc, tpu=tpu, env_hash=runtime_env_key(renv),
+            worker_id, proc, tpu=tpu, env_hash=env_hash,
         )
         with self._res_cv:
             self._workers[worker_id] = handle

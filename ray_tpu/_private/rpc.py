@@ -21,6 +21,7 @@ call sites.
 
 from __future__ import annotations
 
+import hmac
 import itertools
 import os
 import pickle
@@ -34,13 +35,19 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private.config import GlobalConfig
 
-# Versioned wire header: magic + version byte + payload length. A frame
-# whose magic/version don't match is a protocol error and drops the
-# connection — the role the reference's typed protobuf services play
+# Versioned wire header: magic + version + frame kind + payload length.
+# A frame whose magic/version don't match is a protocol error and drops
+# the connection — the role the reference's typed protobuf services play
 # (src/ray/protobuf/gcs_service.proto) for wire-format evolution.
+#
+# v2 moved the frame kind out of the pickled body and into the header so
+# that AUTH frames carry the raw token bytes (no pickle) and a server can
+# refuse to unpickle ANYTHING from an unauthenticated peer: decoding —
+# even through the restricted unpickler — happens only after the token
+# check passes.
 _MAGIC = 0x5254  # "RT"
-_WIRE_VERSION = 1
-_HEADER = struct.Struct(">HBI")
+_WIRE_VERSION = 2
+_HEADER = struct.Struct(">HBBI")
 
 REQUEST = 0
 RESPONSE = 1
@@ -130,6 +137,26 @@ def load_or_create_token(session_dir: str, create: bool = False) -> Optional[str
     return token
 
 
+#: Explicit allowlist of framework value classes that may be constructed by
+#: the control-plane unpickler, beyond the two structural passes in
+#: find_class (ray_tpu exception subclasses and hierarchical IDs, which are
+#: pure value types). A module that defines any OTHER wire-crossing value
+#: class must register it via :func:`register_control_class` (ids.py
+#: registers ObjectRefGenerator this way). Everything else under
+#: ``ray_tpu.*`` is refused: classes with side-effectful constructors
+#: (Node, Cluster, PlasmaStore...) must never be reachable via REDUCE.
+_control_classes: Dict[Tuple[str, str], type] = {}
+
+
+def register_control_class(cls: type) -> type:
+    """Mark a framework class as safe to reconstruct on the control plane.
+
+    Usable as a decorator. Only value-like classes (plain data holders whose
+    construction has no side effects) should ever be registered."""
+    _control_classes[(cls.__module__, cls.__qualname__)] = cls
+    return cls
+
+
 class _ControlUnpickler(pickle.Unpickler):
     """Restricted unpickler for control frames: only framework/stdlib-value
     classes may be constructed. User payloads (task args, results, function
@@ -139,10 +166,11 @@ class _ControlUnpickler(pickle.Unpickler):
     arbitrary reduce callables (VERDICT r2 missing #9).
 
     The policy is deliberately narrow: exact (module, name) pairs for the
-    few stdlib/numpy reconstruction helpers pickle actually emits, plus
-    ray_tpu-defined CLASSES only. No module-prefix passes for callables —
-    pickle.loads-as-REDUCE-trampoline, builtins.getattr, and attribute
-    walks into re-exported modules are all refused."""
+    few stdlib/numpy reconstruction helpers pickle actually emits, plus an
+    explicit registry of ray_tpu value classes and framework ID/exception
+    subclasses. No module-prefix passes — pickle.loads-as-REDUCE-trampoline,
+    builtins.getattr, attribute walks into re-exported modules, and
+    side-effectful framework constructors are all refused."""
 
     # exact reconstruction helpers (callables) pickle emits for values
     _SAFE_CALLABLES = frozenset(
@@ -196,17 +224,33 @@ class _ControlUnpickler(pickle.Unpickler):
                 f"blocked control-plane callable builtins.{name}"
             )
         if module == "ray_tpu" or module.startswith("ray_tpu."):
+            cls = _control_classes.get((module, name))
+            if cls is not None:
+                return cls
             obj = super().find_class(module, name)
-            if isinstance(obj, type) and getattr(
-                obj, "__module__", ""
-            ).startswith("ray_tpu"):
-                return obj  # framework classes (ids, specs, exceptions)
+            if (
+                isinstance(obj, type)
+                and getattr(obj, "__module__", "").startswith("ray_tpu")
+                and (issubclass(obj, BaseException) or _is_framework_id(obj))
+            ):
+                # framework exceptions and hierarchical IDs are pure value
+                # types; everything else needs explicit registration
+                return obj
             raise pickle.UnpicklingError(
-                f"blocked non-class attribute {module}.{name}"
+                f"blocked unregistered attribute {module}.{name}"
             )
         raise pickle.UnpicklingError(
             f"blocked class {module}.{name} on the control plane"
         )
+
+
+def _is_framework_id(obj: type) -> bool:
+    try:
+        from ray_tpu._private.ids import BaseID
+
+        return issubclass(obj, BaseID)
+    except Exception:  # circular import during bootstrap
+        return False
 
 
 def _loads_control(data) -> Any:
@@ -228,6 +272,21 @@ class ConnectionLost(RpcError):
     pass
 
 
+def _wire_safe_exc(e: BaseException) -> BaseException:
+    """Downcast an exception to one the peer's restricted unpickler will
+    accept. A handler can raise anything (e.g. subprocess.TimeoutExpired out
+    of a runtime_env pip install); shipping it verbatim would make the
+    CLIENT's frame decode blow up and tear down the whole multiplexed
+    connection — every in-flight call on it would see ConnectionLost instead
+    of one call failing. Round-trip through the restricted unpickler here
+    and substitute an RpcError carrying the repr when it doesn't survive."""
+    try:
+        _loads_control(pickle.dumps(e, protocol=5))
+        return e
+    except Exception:
+        return RpcError(f"{type(e).__name__}: {e}")
+
+
 class _SendState:
     """Per-connection outbound state: a lock for frame atomicity plus a
     buffer for bytes the kernel wouldn't take. When the buffer is non-empty
@@ -245,8 +304,18 @@ class _SendState:
         self.sock = sock
 
     def send_frame(self, obj: Any):
-        data = pickle.dumps(obj, protocol=5)
-        payload = _HEADER.pack(_MAGIC, _WIRE_VERSION, len(data)) + data
+        kind, msg_id, method, payload_obj = obj
+        if kind == AUTH:
+            # raw bytes — the peer must be able to verify the token without
+            # running any unpickler on attacker-reachable input
+            data = (
+                payload_obj.encode()
+                if isinstance(payload_obj, str)
+                else bytes(payload_obj or b"")
+            )
+        else:
+            data = pickle.dumps((msg_id, method, payload_obj), protocol=5)
+        payload = _HEADER.pack(_MAGIC, _WIRE_VERSION, kind, len(data)) + data
         with self.lock:
             if self.buf:
                 self._buffer(payload)
@@ -412,6 +481,13 @@ class _Poller:
                         stream.on_closed(exc)
                     except Exception:
                         pass
+                    # close the fd so the peer sees EOF promptly (a refused
+                    # pre-auth client would otherwise wait out its timeout
+                    # on a half-dead socket)
+                    try:
+                        key.fileobj.close()
+                    except OSError:
+                        pass
 
 
 class _FrameBuffer:
@@ -422,12 +498,14 @@ class _FrameBuffer:
     def __init__(self):
         self._rbuf = bytearray()
 
-    def feed(self, sock: socket.socket, on_frame: Callable[[Any], None]):
-        """Read available bytes and dispatch every complete frame. The read
-        budget bounds work per callback: one fast data-plane connection
-        (8 MiB transfer chunks) must not monopolize the poller thread while
-        heartbeats and lease replies on other sockets go unread — the
-        level-triggered selector re-fires for the remainder."""
+    def feed(self, sock: socket.socket, on_frame: Callable[[int, bytes], None]):
+        """Read available bytes and dispatch every complete frame as
+        ``on_frame(kind, body_bytes)`` — the body stays UNDECODED here so the
+        receiver can apply its auth policy before any unpickling happens.
+        The read budget bounds work per callback: one fast data-plane
+        connection (8 MiB transfer chunks) must not monopolize the poller
+        thread while heartbeats and lease replies on other sockets go
+        unread — the level-triggered selector re-fires for the remainder."""
         budget = 8 * _RECV_CHUNK
         while budget > 0:
             try:
@@ -444,7 +522,7 @@ class _FrameBuffer:
                 buf = self._rbuf
                 if len(buf) < _HEADER.size:
                     break
-                magic, version, length = _HEADER.unpack_from(buf, 0)
+                magic, version, kind, length = _HEADER.unpack_from(buf, 0)
                 if magic != _MAGIC or version != _WIRE_VERSION:
                     raise RpcError(
                         f"bad frame header (magic={magic:#x} version={version})"
@@ -454,9 +532,9 @@ class _FrameBuffer:
                 end = _HEADER.size + length
                 if len(buf) < end:
                     break
-                frame = _loads_control(memoryview(buf)[_HEADER.size : end])
+                body = bytes(memoryview(buf)[_HEADER.size : end])
                 del buf[:end]
-                on_frame(frame)
+                on_frame(kind, body)
 
 
 # ---------------------------------------------------------------------------
@@ -567,28 +645,33 @@ class ServerConn:
     def on_readable(self):
         self._frames.feed(self.sock, self._on_frame)
 
-    def _on_frame(self, frame):
-        kind, msg_id, method, payload = frame
+    def _on_frame(self, kind: int, body: bytes):
         if kind == AUTH:
             if session_token() is None:
                 return  # server requires no auth: over-credentialed is fine
-            self.meta["authed"] = payload == session_token()
+            # raw-bytes constant-time compare — no unpickling of the
+            # attacker-controlled body, no timing side channel
+            self.meta["authed"] = hmac.compare_digest(
+                body, session_token().encode()
+            )
             if not self.meta["authed"]:
                 raise ConnectionLost("bad auth token")
             return
-        if kind != REQUEST:
-            return
         if session_token() is not None and not self.meta.get("authed"):
-            # unauthenticated request on a token-gated session: refuse and
-            # drop the connection (reply so well-meaning misconfigured
-            # clients see why)
+            # unauthenticated frame on a token-gated session: refuse WITHOUT
+            # decoding the body (even the restricted unpickler must not run
+            # on pre-auth input), reply so well-meaning misconfigured
+            # clients see why, and drop the connection
             try:
                 self.sender.send_frame(
-                    (ERROR, msg_id, method, RpcError("authentication required"))
+                    (ERROR, 0, "", RpcError("authentication required"))
                 )
             except (ConnectionLost, OSError):
                 pass
             raise ConnectionLost("unauthenticated request")
+        if kind != REQUEST:
+            return
+        msg_id, method, payload = _loads_control(body)
         srv = self._server
         if method in srv._inline:
             # order-sensitive handlers run right here on the poller thread
@@ -741,7 +824,7 @@ class RpcServer:
             reply = handler(conn, payload)
         except Exception as e:  # noqa: BLE001
             try:
-                conn.sender.send_frame((ERROR, msg_id, method, e))
+                conn.sender.send_frame((ERROR, msg_id, method, _wire_safe_exc(e)))
             except (ConnectionLost, OSError):
                 conn.closed.set()
             return
@@ -757,7 +840,10 @@ class RpcServer:
         def _send(d: Deferred):
             try:
                 kind = ERROR if d.is_error else RESPONSE
-                conn.sender.send_frame((kind, msg_id, method, d.value))
+                value = d.value
+                if d.is_error and isinstance(value, BaseException):
+                    value = _wire_safe_exc(value)
+                conn.sender.send_frame((kind, msg_id, method, value))
             except (ConnectionLost, OSError):
                 conn.closed.set()
 
@@ -777,13 +863,9 @@ class RpcServer:
             conn.closed.set()
         except Exception as e:  # noqa: BLE001 - forwarded to caller
             try:
-                conn.sender.send_frame((ERROR, msg_id, method, e))
+                conn.sender.send_frame((ERROR, msg_id, method, _wire_safe_exc(e)))
             except (ConnectionLost, OSError):
                 conn.closed.set()
-            except Exception:
-                conn.sender.send_frame(
-                    (ERROR, msg_id, method, RpcError(repr(e)))
-                )
 
     def stop(self):
         self._stopped.set()
@@ -850,8 +932,13 @@ class RpcClient:
     def on_readable(self):
         self._frames.feed(self._sock, self._on_frame)
 
-    def _on_frame(self, frame):
-        kind, msg_id, method, payload = frame
+    def _on_frame(self, kind: int, body: bytes):
+        msg_id, method, payload = _loads_control(body)
+        if kind == ERROR and msg_id == 0:
+            # connection-level refusal (e.g. "authentication required"):
+            # there is no per-call slot to route it to — fail everything
+            exc = payload if isinstance(payload, Exception) else RpcError(str(payload))
+            raise ConnectionLost(str(exc))
         if kind == NOTIFY:
             if self._on_notify is not None:
                 self._enqueue_notify(method, payload)

@@ -1,7 +1,9 @@
 """Control-plane hardening: versioned frames, restricted unpickler, auth
 (reference analogue: typed protobuf services src/ray/protobuf/*.proto +
 redis password gating). A process that can reach a control port must not
-be able to crash or code-exec the GCS."""
+be able to crash or code-exec the GCS — and on a token-gated session no
+byte of attacker input may reach even the restricted unpickler before the
+raw-bytes token check passes."""
 
 import pickle
 import socket
@@ -13,6 +15,17 @@ import pytest
 
 from ray_tpu._private import rpc as rpc_mod
 from ray_tpu._private.rpc import RpcClient, RpcServer
+
+_HDR = struct.Struct(">HBBI")
+
+
+def _frame(kind, msg_id, method, payload):
+    body = pickle.dumps((msg_id, method, payload), protocol=5)
+    return _HDR.pack(0x5254, 2, kind, len(body)) + body
+
+
+def _auth_frame(token_bytes):
+    return _HDR.pack(0x5254, 2, rpc_mod.AUTH, len(token_bytes)) + token_bytes
 
 
 @pytest.fixture
@@ -28,8 +41,9 @@ def test_garbage_frames_do_not_crash_server(server):
     for garbage in (
         b"\x00" * 64,                      # zeros
         b"GET / HTTP/1.1\r\n\r\n",          # wrong protocol
-        struct.pack(">HBI", 0x5254, 1, 2**31),  # huge declared length
-        struct.pack(">HBI", 0xDEAD, 9, 4) + b"abcd",  # bad magic/version
+        _HDR.pack(0x5254, 2, 0, 2**31),     # huge declared length
+        _HDR.pack(0xDEAD, 9, 0, 4) + b"abcd",  # bad magic/version
+        _HDR.pack(0x5254, 1, 0, 4) + b"abcd",  # stale wire version
     ):
         s = socket.create_connection((host, port), timeout=5)
         s.sendall(garbage)
@@ -50,10 +64,8 @@ def test_pickle_bomb_blocked(server):
         def __reduce__(self):
             return (hit.append, ("boom",))
 
-    evil = pickle.dumps((0, 1, "echo", Bomb()), protocol=5)
-    frame = struct.pack(">HBI", 0x5254, 1, len(evil)) + evil
     s = socket.create_connection((host, port), timeout=5)
-    s.sendall(frame)
+    s.sendall(_frame(rpc_mod.REQUEST, 1, "echo", Bomb()))
     time.sleep(0.3)
     s.close()
     assert hit == []  # reduce callable never ran server-side (it's local-only
@@ -66,9 +78,61 @@ def test_pickle_bomb_blocked(server):
 def test_os_system_payload_rejected_by_unpickler():
     import os
 
-    evil = pickle.dumps((0, 1, "m", type("X", (), {"__reduce__": lambda s: (os.system, ("true",))})()))
+    evil = pickle.dumps((1, "m", type("X", (), {"__reduce__": lambda s: (os.system, ("true",))})()))
     with pytest.raises(pickle.UnpicklingError, match="blocked class"):
         rpc_mod._loads_control(evil)
+
+
+def test_side_effect_framework_classes_rejected():
+    """ray_tpu.* is NOT a pass: classes with side-effectful constructors
+    (Node, Cluster, PlasmaStore) are refused; only registered value classes
+    plus ID/exception subclasses survive find_class (ADVICE r3 high)."""
+    from ray_tpu._private.rpc import _ControlUnpickler
+    import io
+
+    u = _ControlUnpickler(io.BytesIO(b""))
+    for module, name in (
+        ("ray_tpu._private.node", "Node"),
+        ("ray_tpu.cluster_utils", "Cluster"),
+        ("ray_tpu._private.object_store", "PlasmaStore"),
+        ("ray_tpu._private.rpc", "RpcServer"),
+        ("ray_tpu._private.worker", "Worker"),
+    ):
+        with pytest.raises(pickle.UnpicklingError):
+            u.find_class(module, name)
+    # value types still pass
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.core_worker import ActorDiedError
+
+    assert u.find_class("ray_tpu._private.ids", "ObjectID") is ObjectID
+    assert (
+        u.find_class("ray_tpu._private.core_worker", "ActorDiedError")
+        is ActorDiedError
+    )
+
+
+def test_foreign_exception_downcast_keeps_connection_alive():
+    """A handler raising a non-allowlisted exception type must fail only
+    that one call, not tear down the multiplexed connection
+    (ADVICE r3 medium)."""
+    import subprocess
+
+    srv = RpcServer("exc-test")
+
+    def boom(conn, p):
+        raise subprocess.TimeoutExpired(cmd="pip install", timeout=300)
+
+    srv.register("boom", boom)
+    srv.register("echo", lambda conn, p: p)
+    try:
+        c = RpcClient(srv.address)
+        with pytest.raises(rpc_mod.RpcError, match="TimeoutExpired"):
+            c.call("boom", None, timeout=10)
+        # the SAME connection still works: only the one call failed
+        assert c.call("echo", "alive", timeout=10) == "alive"
+        c.close()
+    finally:
+        srv.stop()
 
 
 def test_auth_gate():
@@ -84,8 +148,7 @@ def test_auth_gate():
             # raw socket without AUTH is refused
             host, port = srv.address
             s = socket.create_connection((host, port), timeout=5)
-            payload = pickle.dumps((0, 7, "echo", "hi"), protocol=5)
-            s.sendall(struct.pack(">HBI", 0x5254, 1, len(payload)) + payload)
+            s.sendall(_frame(rpc_mod.REQUEST, 7, "echo", "hi"))
             s.settimeout(5)
             data = s.recv(65536)
             assert b"authentication required" in data
@@ -93,10 +156,8 @@ def test_auth_gate():
             # wrong token refused: raw socket (flipping the process-global
             # token would race the server, which shares it)
             s2 = socket.create_connection((host, port), timeout=5)
-            bad = pickle.dumps((4, 0, "", "not-the-token"), protocol=5)
-            s2.sendall(struct.pack(">HBI", 0x5254, 1, len(bad)) + bad)
-            req = pickle.dumps((0, 9, "echo", "hi"), protocol=5)
-            s2.sendall(struct.pack(">HBI", 0x5254, 1, len(req)) + req)
+            s2.sendall(_auth_frame(b"not-the-token"))
+            s2.sendall(_frame(rpc_mod.REQUEST, 9, "echo", "hi"))
             s2.settimeout(5)
             data = b""
             try:
@@ -114,6 +175,42 @@ def test_auth_gate():
         finally:
             srv.stop()
     finally:
+        rpc_mod.configure_auth(None)
+
+
+def test_unauthenticated_bytes_never_reach_unpickler():
+    """Pre-auth frames are refused WITHOUT decoding: a pickle bomb sent
+    before AUTH on a token-gated server can't even exercise the restricted
+    unpickler's code paths (ADVICE r3 high: auth precedes decode)."""
+    rpc_mod.configure_auth("s3cret2")
+    calls = []
+    orig = rpc_mod._loads_control
+
+    def spy(data):
+        calls.append(bytes(data))
+        return orig(data)
+
+    rpc_mod._loads_control = spy
+    try:
+        srv = RpcServer("preauth-test")
+        srv.register("echo", lambda conn, p: p)
+        try:
+            host, port = srv.address
+            s = socket.create_connection((host, port), timeout=5)
+            s.sendall(_frame(rpc_mod.REQUEST, 3, "echo", "evil"))
+            s.settimeout(5)
+            try:
+                s.recv(65536)
+            except OSError:
+                pass
+            s.close()
+            time.sleep(0.2)
+            marker = pickle.dumps((3, "echo", "evil"), protocol=5)
+            assert all(marker != c for c in calls)
+        finally:
+            srv.stop()
+    finally:
+        rpc_mod._loads_control = orig
         rpc_mod.configure_auth(None)
 
 
