@@ -30,6 +30,9 @@ def main():
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument(
+        "--remat-policy", default=None, choices=["nothing", "dots", "attn"]
+    )
     args = ap.parse_args()
 
     from ray_tpu.models.gpt import gpt_1b, gpt_125m, gpt_nano, train_step_flops
@@ -44,10 +47,13 @@ def main():
     on_tpu = platform not in ("cpu",)
     if args.model is None:
         args.model = "1b" if on_tpu else "nano"
+    extra = {}
+    if args.remat_policy:
+        extra["remat_policy"] = args.remat_policy
     if args.model == "1b":
         # bf16 params+moments so the full Adam state fits one 16G chip; a
         # real multi-chip run keeps f32 master state sharded over fsdp.
-        cfg = gpt_1b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+        cfg = gpt_1b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, **extra)
         batch, seq, iters = 8, 2048, 20
     elif args.model == "125m":
         cfg = gpt_125m(dtype=jnp.bfloat16)

@@ -949,10 +949,19 @@ class CoreWorker:
         swallows work that other (about-to-be-idle) workers should get —
         batching must not serialize long tasks onto one process."""
         waiting = self._lease_waiting.get(sig)
-        active = self._active_pushes.get(sig, 0)
+        # every source that can absorb queued work counts against this
+        # batch's share: workers mid-push, lease RPCs in flight (incl.
+        # spillback grants on OTHER nodes), and cached idle leases — a
+        # batch that swallowed the whole queue would serialize work the
+        # cluster could run in parallel (and defeat spillback balancing)
+        slots = (
+            self._active_pushes.get(sig, 0)
+            + self._lease_inflight.get(sig, 0)
+            + len(self._idle_leases.get(sig) or ())
+        )
         cap = min(
             GlobalConfig.task_push_batch,
-            max(1, len(waiting) // (active + 1)),
+            max(1, len(waiting) // (slots + 1)),
         )
         out = [waiting.popleft()]
         while waiting and len(out) < cap:

@@ -311,12 +311,17 @@ class TaskExecutor:
         return d
 
     def _run_batch(self, d: Deferred, specs):
+        from ray_tpu._private.rpc import _wire_safe_exc
+
         replies = []
         for spec in specs:
             try:
                 replies.append(self._execute_normal_task(spec))
             except Exception as e:  # noqa: BLE001
-                replies.append(e)
+                # these ride inside a RESPONSE frame, which skips the
+                # server-side ERROR downcast: apply it here or one bad
+                # exception tears down the owner's whole connection
+                replies.append(_wire_safe_exc(e))
         d.resolve(replies)
 
     def _resolve_with(self, d: Deferred, fn, spec):

@@ -84,8 +84,15 @@ def _child_main(req: dict) -> None:
         print(f"[boot-timing] child_main wall={_t.time():.3f}", flush=True)
     if req.get("cwd"):
         os.chdir(req["cwd"])
-    for p in reversed(req.get("sys_path") or ()):
+    sys_path = list(req.get("sys_path") or ())
+    for p in reversed(sys_path):
         sys.path.insert(0, p)
+    if sys_path:
+        # keep parity with the Popen spawn path: a task that launches its
+        # own python subprocess must see working_dir/py_modules roots too
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            [*sys_path, os.environ.get("PYTHONPATH", "")]
+        ).rstrip(os.pathsep)
     _timing = os.environ.get("RAYTPU_BOOT_TIMING") == "1"
 
     def _mark(stage):

@@ -5,6 +5,7 @@ resource_demand_scheduler.py (bin-packing), _private/gcp/node.py (TPU pods).
 """
 
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.gcp import GcpHttpClient, GcpTpuNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     LocalSubprocessNodeProvider,
     NodeProvider,
@@ -13,6 +14,8 @@ from ray_tpu.autoscaler.node_provider import (
 
 __all__ = [
     "AutoscalerConfig",
+    "GcpHttpClient",
+    "GcpTpuNodeProvider",
     "LocalSubprocessNodeProvider",
     "NodeProvider",
     "StandardAutoscaler",

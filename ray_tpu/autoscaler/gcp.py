@@ -1,0 +1,265 @@
+"""GCP Cloud TPU node provider: TPU-VM slices via the TPU API.
+
+Reference surface: autoscaler/_private/gcp/node.py (GCPTPUNode wrapping
+``tpu.googleapis.com`` v2, ``wait_for_operation``), autoscaler/gcp/tpu.yaml
+(TPU pod config: accelerator_type, runtime_version, one "node" = one whole
+TPU-VM pod slice) and the queued-resources flow GKE/GCE users drive today.
+TPU-first semantics preserved exactly:
+
+- the atomic unit is a SLICE: a create provisions every host of the slice
+  or nothing (queued resources guarantee this server-side); terminate
+  deletes the whole slice;
+- creations go through **queued resources** (states ACCEPTED →
+  PROVISIONING → ACTIVE; FAILED/SUSPENDED are terminal) — the modern quota
+  path — with a direct ``nodes.create`` fallback for reserved capacity;
+- every API interaction goes through an injectable ``api`` client, so the
+  provider's state machine is fully testable without GCP (the environment
+  here has no egress): tests drive a mock that replays the real API's JSON
+  shapes; production uses :class:`GcpHttpClient` (metadata-server auth).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+# accelerator_type → (hosts, chips per host). v5e: 8 chips/host below 16
+# chips, 4 chips/host on pods; v4: 4 chips/host.
+_TOPOLOGY = {
+    "v5litepod-4": (1, 4),
+    "v5litepod-8": (1, 8),
+    "v5litepod-16": (4, 4),
+    "v5litepod-32": (8, 4),
+    "v5litepod-64": (16, 4),
+    "v5litepod-128": (32, 4),
+    "v5litepod-256": (64, 4),
+    "v4-8": (1, 4),
+    "v4-16": (2, 4),
+    "v4-32": (4, 4),
+}
+
+
+class GcpHttpClient:
+    """Minimal authenticated JSON client for tpu.googleapis.com.
+
+    Auth comes from the GCE metadata server (the reference's provider runs
+    on the head node inside GCP, same assumption). Kept dependency-free:
+    urllib only."""
+
+    BASE = "https://tpu.googleapis.com/v2"
+    TOKEN_URL = (
+        "http://metadata.google.internal/computeMetadata/v1/"
+        "instance/service-accounts/default/token"
+    )
+
+    def __init__(self):
+        self._token: Optional[str] = None
+        self._token_expiry = 0.0
+
+    def _auth_token(self) -> str:
+        import urllib.request
+
+        if self._token and time.time() < self._token_expiry - 60:
+            return self._token
+        req = urllib.request.Request(
+            self.TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            data = json.loads(resp.read())
+        self._token = data["access_token"]
+        self._token_expiry = time.time() + float(data.get("expires_in", 300))
+        return self._token
+
+    def request(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.BASE}/{path.lstrip('/')}",
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={
+                "Authorization": f"Bearer {self._auth_token()}",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+
+class GcpTpuNodeProvider(NodeProvider):
+    """One provider node == one TPU-VM slice (queued-resource lifecycle)."""
+
+    # queued-resource states (cloud.google.com/tpu/docs/queued-resources)
+    _PENDING_STATES = ("ACCEPTED", "PROVISIONING", "CREATING", "WAITING_FOR_RESOURCES")
+    _READY_STATES = ("ACTIVE", "READY")
+    _DEAD_STATES = ("FAILED", "SUSPENDED", "SUSPENDING", "DELETING")
+
+    def __init__(
+        self,
+        project: str,
+        zone: str,
+        *,
+        accelerator_type: str = "v5litepod-16",
+        runtime_version: str = "v2-alpha-tpuv5-lite",
+        name_prefix: str = "raytpu",
+        use_queued_resources: bool = True,
+        reserved: bool = False,
+        spot: bool = False,
+        api: Optional[Any] = None,
+        poll_interval_s: float = 5.0,
+        provision_timeout_s: float = 1800.0,
+    ):
+        if accelerator_type not in _TOPOLOGY:
+            raise ValueError(
+                f"unknown accelerator_type {accelerator_type!r}; "
+                f"known: {sorted(_TOPOLOGY)}"
+            )
+        self.project = project
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.name_prefix = name_prefix
+        self.use_queued_resources = use_queued_resources
+        self.reserved = reserved
+        self.spot = spot
+        self.api = api if api is not None else GcpHttpClient()
+        self.poll_interval_s = poll_interval_s
+        self.provision_timeout_s = provision_timeout_s
+        self._lock = threading.Lock()
+        self._parent = f"projects/{project}/locations/{zone}"
+
+    # -- NodeProvider interface -------------------------------------------
+
+    def node_resources(self) -> Dict[str, float]:
+        hosts, chips = _TOPOLOGY[self.accelerator_type]
+        return {"CPU": 8.0 * hosts, "TPU": float(hosts * chips)}
+
+    def create_nodes(self, count: int) -> List[str]:
+        created = []
+        for _ in range(count):
+            node_id = f"{self.name_prefix}-{uuid.uuid4().hex[:8]}"
+            try:
+                if self.use_queued_resources:
+                    self._create_queued(node_id)
+                else:
+                    self._create_direct(node_id)
+            except Exception:
+                # atomic create: anything half-made is torn down
+                logger.exception("slice %s creation failed; cleaning up", node_id)
+                try:
+                    self.terminate_node(node_id)
+                except Exception:
+                    pass
+                continue
+            created.append(node_id)
+        return created
+
+    def _create_queued(self, node_id: str) -> None:
+        """Queued-resource create + poll to ACTIVE (atomic slice grant)."""
+        tier = {}
+        if self.spot:
+            tier = {"spot": {}}
+        elif self.reserved:
+            tier = {"guaranteed": {"reserved": True}}
+        self.api.request(
+            "POST",
+            f"{self._parent}/queuedResources?queuedResourceId={node_id}",
+            {
+                "tpu": {
+                    "nodeSpec": [
+                        {
+                            "parent": self._parent,
+                            "nodeId": node_id,
+                            "node": {
+                                "acceleratorType": self.accelerator_type,
+                                "runtimeVersion": self.runtime_version,
+                                "labels": {"raytpu-cluster": self.name_prefix},
+                            },
+                        }
+                    ]
+                },
+                **tier,
+            },
+        )
+        deadline = time.monotonic() + self.provision_timeout_s
+        while True:
+            qr = self.api.request(
+                "GET", f"{self._parent}/queuedResources/{node_id}"
+            )
+            state = (qr.get("state") or {}).get("state", "ACCEPTED")
+            if state in self._READY_STATES:
+                return
+            if state in self._DEAD_STATES:
+                raise RuntimeError(
+                    f"queued resource {node_id} entered {state}: "
+                    f"{(qr.get('state') or {}).get('stateInitiator', '')}"
+                )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"queued resource {node_id} stuck in {state} after "
+                    f"{self.provision_timeout_s}s"
+                )
+            time.sleep(self.poll_interval_s)
+
+    def _create_direct(self, node_id: str) -> None:
+        """nodes.create for reserved capacity; polls the operation."""
+        op = self.api.request(
+            "POST",
+            f"{self._parent}/nodes?nodeId={node_id}",
+            {
+                "acceleratorType": self.accelerator_type,
+                "runtimeVersion": self.runtime_version,
+                "labels": {"raytpu-cluster": self.name_prefix},
+            },
+        )
+        self._wait_operation(op)
+
+    def _wait_operation(self, op: dict) -> None:
+        deadline = time.monotonic() + self.provision_timeout_s
+        name = op.get("name", "")
+        while not op.get("done"):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"operation {name} timed out")
+            time.sleep(self.poll_interval_s)
+            op = self.api.request("GET", name)
+        if "error" in op:
+            raise RuntimeError(f"operation {name} failed: {op['error']}")
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        """Delete the WHOLE slice (queued resource + node, force)."""
+        if self.use_queued_resources:
+            try:
+                self.api.request(
+                    "DELETE",
+                    f"{self._parent}/queuedResources/{provider_node_id}?force=true",
+                )
+                return
+            except Exception:
+                pass  # fall through: maybe created via nodes.create
+        try:
+            self.api.request(
+                "DELETE", f"{self._parent}/nodes/{provider_node_id}"
+            )
+        except Exception:
+            logger.exception("failed to delete TPU node %s", provider_node_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        out: List[str] = []
+        resp = self.api.request("GET", f"{self._parent}/nodes")
+        for node in resp.get("nodes", []):
+            labels = node.get("labels") or {}
+            if labels.get("raytpu-cluster") != self.name_prefix:
+                continue
+            if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                continue
+            out.append(node.get("name", "").rsplit("/", 1)[-1])
+        return out

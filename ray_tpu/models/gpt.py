@@ -45,6 +45,14 @@ class GPTConfig:
     parallel_residual: bool = True     # GPT-J style single-LN parallel block
     tie_embeddings: bool = False
     remat: bool = True
+    # what the layer-remat saves for the backward pass:
+    #   "nothing"  - full remat (lowest HBM, recomputes the whole block)
+    #   "dots"     - jax.checkpoint_policies.dots_with_no_batch_dims_saveable:
+    #                matmul outputs are saved, elementwise ops recompute
+    #                (trades HBM for skipping the fwd matmul replay)
+    #   "attn"     - save tensors tagged with checkpoint_name "attn_out"
+    #                (the flash-attention output: the priciest recompute)
+    remat_policy: str = "nothing"
     scan_layers: bool = True
     attn_use_pallas: Optional[bool] = None  # None → auto (TPU only)
     seq_parallel_impl: str = "ring"         # "ring" | "ulysses" (used when sp>1)
@@ -215,6 +223,10 @@ class Attention(nn.Module):
             out = dot_product_attention(
                 qh, kh, vh, causal=True, use_pallas=cfg.attn_use_pallas
             ).transpose(0, 2, 1, 3)
+        # tag for remat_policy="attn": saving exactly this tensor lets the
+        # backward pass skip replaying the flash-attention forward kernel
+        # while everything cheaper (LN, rotary, gelu) still rematerializes
+        out = jax.ad_checkpoint.checkpoint_name(out, "attn_out")
         return _dense((cfg.embed_dim,), ("heads", "kv", "embed"), cfg, "o", use_bias=False)(
             out
         )
@@ -276,7 +288,14 @@ class ScannedBlocks(nn.Module):
         cfg = self.cfg
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=not cfg.scan_layers)
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy == "attn":
+                policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+            block = nn.remat(
+                Block, prevent_cse=not cfg.scan_layers, policy=policy
+            )
         if cfg.scan_layers:
             x, _ = nn.scan(
                 lambda mdl, carry, _: (mdl(carry, positions), None),

@@ -565,6 +565,11 @@ def dot_product_attention(
     """
     scale_val = float(scale) if scale is not None else 1.0 / float(np.sqrt(q.shape[-1]))
     use = use_pallas if use_pallas is not None else _on_tpu()
+    import os as _os
+
+    # tuning hook: sweep kernel tile sizes without touching call sites
+    block_q = int(_os.environ.get("RAYTPU_FLASH_BLOCK_Q", block_q))
+    block_k = int(_os.environ.get("RAYTPU_FLASH_BLOCK_K", block_k))
     d = q.shape[-1]
     if (
         use

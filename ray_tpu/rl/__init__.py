@@ -9,6 +9,18 @@ learner actors via the host collective layer.
 """
 
 from ray_tpu.rl.algorithm import PPO, PPOConfig
+from ray_tpu.rl.appo import APPO, APPOConfig, APPOLearner
+from ray_tpu.rl.bc import BC, BCConfig, MARWIL, MARWILConfig, monte_carlo_returns
+from ray_tpu.rl.connectors import (
+    ClipActions,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    MeanStdFilter,
+    UnsquashActions,
+)
+from ray_tpu.rl.td3 import TD3, TD3Config, TD3RolloutWorker
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNLearner, DQNRolloutWorker, QNetwork
 from ray_tpu.rl.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rl.impala import Impala, ImpalaConfig, ImpalaLearner, vtrace
@@ -28,6 +40,24 @@ from ray_tpu.rl.rollout_worker import RolloutWorker
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "APPOLearner",
+    "BC",
+    "BCConfig",
+    "ClipActions",
+    "ClipObs",
+    "Connector",
+    "ConnectorPipeline",
+    "FlattenObs",
+    "MARWIL",
+    "MARWILConfig",
+    "MeanStdFilter",
+    "TD3",
+    "TD3Config",
+    "TD3RolloutWorker",
+    "UnsquashActions",
+    "monte_carlo_returns",
     "IndependentCartPoles",
     "MultiAgentEnv",
     "MultiAgentPPO",

@@ -1,0 +1,130 @@
+"""APPO: asynchronous PPO — the IMPALA pipeline with a clipped-surrogate
+learner.
+
+Reference surface: rllib/algorithms/appo/ (appo.py config: IMPALA subclass
+with ``use_critic/use_kl_loss/clip_param``, appo_torch_policy.py loss:
+PPO's clipped surrogate computed on V-trace-corrected advantages). The
+asynchrony is identical to our Impala driver — pipelined
+``sample_trajectory`` futures, stale-by-design fragments, periodic weight
+broadcast — only the loss changes: instead of the plain V-trace policy
+gradient, the importance ratio pi/mu is clipped PPO-style, which tolerates
+the staleness window far better at high pipeline depths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.rl.impala import Impala, ImpalaConfig, vtrace
+from ray_tpu.rl.rl_module import DiscretePolicyModule
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class APPOLearner:
+    """Jitted V-trace + clipped-surrogate update over time-major fragments."""
+
+    def __init__(self, observation_size: int, num_actions: int, *,
+                 hidden: Sequence[int] = (64, 64), lr: float = 5e-4,
+                 gamma: float = 0.99, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, grad_clip: float = 40.0,
+                 clip_param: float = 0.3, rho_bar: float = 1.0,
+                 c_bar: float = 1.0, seed: int = 0):
+        self.net = DiscretePolicyModule(num_actions, tuple(hidden))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, observation_size), jnp.float32),
+        )["params"]
+        self.opt_state = self.optimizer.init(self.params)
+        net = self.net
+
+        def loss_fn(params, batch):
+            t, b, d = batch["obs"].shape
+            logits, values = net.apply(
+                {"params": params}, batch["obs"].reshape(t * b, d)
+            )
+            logits = logits.reshape(t, b, -1)
+            values = values.reshape(t, b)
+            _, bootstrap_value = net.apply(
+                {"params": params}, batch["bootstrap_obs"]
+            )
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+            vs, pg_adv = vtrace(
+                target_logp, batch["behavior_logp"], batch["rewards"],
+                values, bootstrap_value, batch["dones"],
+                gamma=gamma, rho_bar=rho_bar, c_bar=c_bar,
+            )
+            # PPO clipped surrogate on the V-trace advantages (APPO's core:
+            # appo_torch_policy.py computes exactly this pairing)
+            ratio = jnp.exp(target_logp - batch["behavior_logp"])
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+            policy_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            return total, {
+                "policy_loss": policy_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+                "ratio_mean": jnp.mean(ratio),
+                "total_loss": total,
+            }
+
+        def step(params, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, metrics
+
+        self._step = jax.jit(step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.opt_state, jb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclasses.dataclass
+class APPOConfig(ImpalaConfig):
+    clip_param: float = 0.3
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(Impala):
+    """Async driver with the APPO learner (everything else is IMPALA)."""
+
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        # swap in the clipped-surrogate learner; re-broadcast its weights so
+        # rollout workers run the policy that will actually be updated
+        from ray_tpu.rl.env import make_env
+
+        probe = make_env(config.env)
+        self.learner = APPOLearner(
+            probe.observation_size, probe.num_actions,
+            hidden=config.hidden, lr=config.lr, gamma=config.gamma,
+            vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
+            clip_param=config.clip_param, rho_bar=config.rho_bar,
+            c_bar=config.c_bar, seed=config.seed,
+        )
+        self._broadcast_weights()

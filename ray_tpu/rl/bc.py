@@ -1,0 +1,203 @@
+"""MARWIL / BC: offline policy learning from logged SampleBatches.
+
+Reference surface: rllib/algorithms/marwil/ (marwil.py config + the
+advantage-weighted loss in marwil_torch_policy.py) and rllib/algorithms/bc/
+(bc.py: MARWIL with ``beta=0`` — plain behavior cloning). Same relationship
+here: ``BCConfig`` is ``MARWILConfig(beta=0)``.
+
+The loss per (s, a, R): ``-exp(beta * (R - V(s))/norm) * log pi(a|s)`` with
+a squared-error value head; at beta=0 the weight is 1 and the value head
+still trains (harmless) but cannot influence the policy. Training data
+comes from ray_tpu.rl.offline's JSONL sample-batch files — the same files
+rollout workers write — with monte-carlo returns computed at load time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, Iterable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import offline
+from ray_tpu.rl.rl_module import DiscretePolicyModule
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+def monte_carlo_returns(
+    rewards: np.ndarray, dones: np.ndarray, gamma: float
+) -> np.ndarray:
+    """Per-step discounted return-to-go, cut at episode boundaries."""
+    out = np.zeros_like(rewards, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(rewards) - 1, -1, -1):
+        if dones[t]:
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+@dataclasses.dataclass
+class MARWILConfig:
+    input_path: str = ""               # offline JSONL dir (offline.write_sample_batches)
+    beta: float = 1.0                  # 0 = plain behavior cloning
+    lr: float = 1e-3
+    gamma: float = 0.99
+    vf_coeff: float = 1.0
+    batch_size: int = 512
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    # moving normalizer for the advantage exponent (marwil_torch_policy.py
+    # keeps a running average of squared advantages)
+    norm_momentum: float = 0.99
+
+    def build(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+@dataclasses.dataclass
+class BCConfig(MARWILConfig):
+    beta: float = 0.0
+
+    def build(self) -> "BC":
+        return BC(self)  # type: ignore[return-value]
+
+
+class MARWIL:
+    def __init__(self, config: MARWILConfig):
+        self.config = config
+        cols = self._load(config.input_path)
+        self.obs = np.asarray(cols["obs"], np.float32)
+        self.actions = np.asarray(cols["actions"]).astype(np.int32)
+        if "returns" in cols:
+            # rollout workers postprocess GAE returns onto the batch; prefer
+            # them — the flat storage order interleaves envs, so stream-order
+            # monte-carlo would mix trajectories
+            self.returns = np.asarray(cols["returns"], np.float32)
+        else:
+            self.returns = monte_carlo_returns(
+                np.asarray(cols["rewards"], np.float32),
+                np.asarray(cols["dones"]),
+                config.gamma,
+            )
+        obs_size = self.obs.shape[-1]
+        num_actions = int(self.actions.max()) + 1
+        self.net = DiscretePolicyModule(num_actions, tuple(config.hidden))
+        self.params = self.net.init(
+            jax.random.PRNGKey(config.seed),
+            jnp.zeros((1, obs_size), jnp.float32),
+        )["params"]
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._adv_norm = jnp.ones(())  # running E[adv^2]
+        self._rng = np.random.default_rng(config.seed)
+        self._iteration = 0
+        net, cfg = self.net, config
+
+        def loss_fn(params, batch, adv_norm):
+            logits, values = net.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            adv = batch["returns"] - values
+            vf_loss = jnp.mean(adv**2)
+            new_norm = (
+                cfg.norm_momentum * adv_norm
+                + (1 - cfg.norm_momentum) * jnp.mean(adv**2)
+            )
+            weight = (
+                jnp.exp(
+                    cfg.beta
+                    * jax.lax.stop_gradient(adv)
+                    / jnp.sqrt(new_norm + 1e-8)
+                )
+                if cfg.beta != 0.0
+                else jnp.ones_like(logp)
+            )
+            policy_loss = -jnp.mean(jax.lax.stop_gradient(weight) * logp)
+            total = policy_loss + cfg.vf_coeff * vf_loss
+            return total, (policy_loss, vf_loss, new_norm)
+
+        def step(params, opt_state, batch, adv_norm):
+            (total, (pl, vl, norm)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, batch, adv_norm)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            return (
+                optax.apply_updates(params, updates),
+                opt_state,
+                norm,
+                {"total_loss": total, "policy_loss": pl, "vf_loss": vl},
+            )
+
+        self._step = jax.jit(step)
+
+    @staticmethod
+    def _load(path: str) -> SampleBatch:
+        batches: List[SampleBatch] = list(offline.read_sample_batches(path))
+        if not batches:
+            raise ValueError(f"no offline sample batches under {path!r}")
+        return SampleBatch.concat(batches)
+
+    def train(self, epochs: int = 1) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        n = len(self.obs)
+        metrics: Dict[str, Any] = {}
+        # a dataset smaller than batch_size still trains (one short batch
+        # per epoch) instead of silently running zero update steps
+        bs = min(cfg.batch_size, n)
+        for _ in range(epochs):
+            order = self._rng.permutation(n)
+            for lo in range(0, n - bs + 1, bs):
+                idx = order[lo : lo + bs]
+                batch = {
+                    "obs": jnp.asarray(self.obs[idx]),
+                    "actions": jnp.asarray(self.actions[idx]),
+                    "returns": jnp.asarray(self.returns[idx]),
+                }
+                self.params, self.opt_state, self._adv_norm, metrics = self._step(
+                    self.params, self.opt_state, batch, self._adv_norm
+                )
+        self._iteration += 1
+        out = {"training_iteration": self._iteration,
+               "time_this_iter_s": time.perf_counter() - t0}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def evaluate(self, env_name: str, episodes: int = 4, seed: int = 0) -> float:
+        """Greedy rollout return of the learned policy (no exploration)."""
+        from ray_tpu.rl.env import make_env
+
+        net, params = self.net, self.params
+        act = jax.jit(
+            lambda o: jnp.argmax(net.apply({"params": params}, o[None])[0], -1)[0]
+        )
+        total = 0.0
+        for ep in range(episodes):
+            env = make_env(env_name)
+            obs, _ = env.reset(seed=seed + ep)
+            done = False
+            while not done:
+                obs, r, term, trunc, _ = env.step(int(act(jnp.asarray(obs))))
+                total += r
+                done = term or trunc
+        return total / episodes
+
+
+class BC(MARWIL):
+    """Behavior cloning == MARWIL with beta=0 (reference: bc.py)."""
+
+    def __init__(self, config: MARWILConfig):
+        if config.beta != 0.0:
+            config = dataclasses.replace(config, beta=0.0)
+        super().__init__(config)

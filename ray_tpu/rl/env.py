@@ -174,7 +174,14 @@ class VectorEnv:
         (reference: rllib bootstraps on time-limit truncation)."""
         obs, rewards, terms, truncs, finals = [], [], [], [], []
         for i, (env, a) in enumerate(zip(self.envs, actions)):
-            o, r, term, trunc, _ = env.step(int(a))
+            # discrete actions arrive as integer scalars -> python int;
+            # continuous actions are float arrays and MUST pass through
+            # un-truncated (int(a) would quantize a Pendulum torque of 1.7
+            # down to 1 — the stored action would not be the executed one)
+            arr = np.asarray(a)
+            o, r, term, trunc, _ = env.step(
+                int(arr) if arr.dtype.kind in "iub" else arr
+            )
             finals.append(o)
             if term or trunc:
                 o, _ = env.reset()

@@ -22,7 +22,7 @@ from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 class RolloutWorker:
     def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
                  module_config: Dict[str, Any] = None, gamma: float = 0.99,
-                 lam: float = 0.95):
+                 lam: float = 0.95, obs_connectors=None, action_connectors=None):
         self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
         cfg = module_config or {}
         probe = make_env(env_name)
@@ -35,8 +35,36 @@ class RolloutWorker:
         self._rng = np.random.default_rng(seed + 1)
         self.gamma = gamma
         self.lam = lam
+        # connector pipelines between env and policy (reference:
+        # rllib/connectors/ — obs transforms before inference, action
+        # transforms before env.step); None = identity
+        self.obs_connectors = obs_connectors
+        self.action_connectors = action_connectors
         # episode-return tracking (the learning-test metric)
         self._episodes = EpisodeReturnTracker(num_envs)
+
+    def _obs(self, obs: np.ndarray) -> np.ndarray:
+        return self.obs_connectors(obs) if self.obs_connectors is not None else obs
+
+    def _act(self, actions: np.ndarray) -> np.ndarray:
+        if self.action_connectors is not None:
+            return self.action_connectors(actions)
+        return actions
+
+    def connector_state(self) -> Dict[str, Any]:
+        """Stateful-connector sync point (the reference syncs filter state
+        through WorkerSet.foreach_worker)."""
+        return {
+            "obs": self.obs_connectors.state() if self.obs_connectors else {},
+            "action": self.action_connectors.state() if self.action_connectors else {},
+        }
+
+    def set_connector_state(self, state: Dict[str, Any]) -> bool:
+        if self.obs_connectors is not None and state.get("obs"):
+            self.obs_connectors.set_state(state["obs"])
+        if self.action_connectors is not None and state.get("action"):
+            self.action_connectors.set_state(state["action"])
+        return True
 
     def set_weights(self, params) -> bool:
         self.module.set_params(params)
@@ -54,9 +82,11 @@ class RolloutWorker:
         logp_buf = np.empty((num_steps, n), np.float32)
         val_buf = np.empty((num_steps, n), np.float32)
         for t in range(num_steps):
-            obs = self.envs.observations
+            obs = self._obs(self.envs.observations)
             actions, logp, values = self.module.forward_inference(obs, self._rng)
-            next_obs, rewards, terms, truncs, finals = self.envs.step(actions)
+            next_obs, rewards, terms, truncs, finals = self.envs.step(
+                self._act(actions)
+            )
             dones = terms | truncs
             raw_rewards = rewards
             bootstrap = truncs & ~terms
@@ -65,7 +95,7 @@ class RolloutWorker:
                 # value of the final (pre-reset) state into the reward so
                 # GAE's episode cut doesn't bias targets low
                 _, _, final_vals = self.module.forward_inference(
-                    finals, self._rng
+                    self._obs(finals), self._rng
                 )
                 rewards = rewards + self.gamma * final_vals * bootstrap
             obs_buf[t], act_buf[t] = obs, actions
@@ -73,7 +103,7 @@ class RolloutWorker:
             logp_buf[t], val_buf[t] = logp, values
             self._episodes.track(raw_rewards, dones)  # excludes the bootstrap
         _, _, last_values = self.module.forward_inference(
-            self.envs.observations, self._rng
+            self._obs(self.envs.observations), self._rng
         )
         adv, rets = compute_gae(
             rew_buf, val_buf, done_buf, last_values, gamma=self.gamma, lam=self.lam
@@ -105,14 +135,14 @@ class RolloutWorker:
         done_buf = np.empty((num_steps, n), np.bool_)
         logp_buf = np.empty((num_steps, n), np.float32)
         for t in range(num_steps):
-            obs = self.envs.observations
+            obs = self._obs(self.envs.observations)
             actions, logp, _ = self.module.forward_inference(obs, self._rng)
-            _, rewards, terms, truncs, finals = self.envs.step(actions)
+            _, rewards, terms, truncs, finals = self.envs.step(self._act(actions))
             raw_rewards = rewards
             bootstrap = truncs & ~terms
             if bootstrap.any():
                 _, _, final_vals = self.module.forward_inference(
-                    finals, self._rng
+                    self._obs(finals), self._rng
                 )
                 rewards = rewards + self.gamma * final_vals * bootstrap
             obs_buf[t], act_buf[t] = obs, actions
@@ -125,7 +155,7 @@ class RolloutWorker:
             rewards=rew_buf,
             dones=done_buf,
             behavior_logp=logp_buf,
-            bootstrap_obs=self.envs.observations.copy(),
+            bootstrap_obs=np.asarray(self._obs(self.envs.observations)).copy(),
         )
 
     def episode_returns(self, clear: bool = True):

@@ -1,0 +1,288 @@
+"""TD3: twin-delayed deterministic policy gradients for continuous control.
+
+Reference surface: rllib/algorithms/td3/ (td3.py: DDPG config with
+``twin_q=True``, ``policy_delay=2``, ``smooth_target_policy=True``) and
+rllib/algorithms/ddpg/ddpg_torch_policy.py (deterministic actor,
+exploration via additive gaussian noise, polyak targets). TPU-first
+translation mirrors ray_tpu.rl.sac: the whole update — twin critics with
+target-policy smoothing, delayed deterministic actor, polyak sync — is one
+jitted function; CPU rollout actors add exploration noise host-side.
+The delayed actor update is a ``lax.cond`` on the step counter, so the
+jitted graph is the same every call (no Python-side branching in jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.env import EpisodeReturnTracker, VectorEnv, make_env
+from ray_tpu.rl.replay_buffers import ReplayBuffer
+from ray_tpu.rl.sac import TwinQ
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class DeterministicPolicy(nn.Module):
+    """mu(s): tanh-bounded deterministic actor."""
+
+    action_size: int
+    hidden: Sequence[int] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"torso_{i}")(x))
+        return jnp.tanh(nn.Dense(self.action_size, name="mu")(x))
+
+
+@ray_tpu.remote
+class TD3RolloutWorker:
+    """Deterministic policy + additive exploration noise on a vector env."""
+
+    def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
+                 hidden: Tuple[int, ...] = (128, 128),
+                 exploration_noise: float = 0.1):
+        self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
+        probe = make_env(env_name)
+        self.scale = float(probe.action_high)
+        self.noise = exploration_noise * self.scale
+        self.policy = DeterministicPolicy(probe.action_size, tuple(hidden))
+        self.params = self.policy.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, probe.observation_size), jnp.float32),
+        )["params"]
+        self._act = jax.jit(
+            lambda p, o: self.policy.apply({"params": p}, o) * self.scale
+        )
+        self._np_rng = np.random.default_rng(seed + 1)
+        self._episodes = EpisodeReturnTracker(num_envs)
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int, random_actions: bool = False) -> SampleBatch:
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        n = self.envs.num_envs
+        a_dim = self.policy.action_size
+        for _ in range(num_steps):
+            obs = self.envs.observations
+            if random_actions:
+                actions = self._np_rng.uniform(
+                    -self.scale, self.scale, (n, a_dim)
+                ).astype(np.float32)
+            else:
+                mu = np.asarray(self._act(self.params, jnp.asarray(obs)))
+                noise = self._np_rng.normal(0.0, self.noise, mu.shape)
+                actions = np.clip(
+                    mu + noise, -self.scale, self.scale
+                ).astype(np.float32)
+            next_obs, rewards, terms, truncs, finals = self.envs.step(actions)
+            obs_l.append(obs)
+            act_l.append(actions)
+            rew_l.append(rewards)
+            next_l.append(finals)  # bootstrap through truncation
+            done_l.append(terms)
+            self._episodes.track(rewards, terms | truncs)
+        return SampleBatch(
+            obs=np.concatenate(obs_l).astype(np.float32),
+            actions=np.concatenate(act_l).astype(np.float32),
+            rewards=np.concatenate(rew_l).astype(np.float32),
+            next_obs=np.concatenate(next_l).astype(np.float32),
+            dones=np.concatenate(done_l).astype(np.float32),
+        )
+
+    def episode_returns(self) -> List[float]:
+        return self._episodes.drain()
+
+
+@dataclasses.dataclass
+class TD3Config:
+    env: str = "Pendulum-v1"
+    num_rollout_workers: int = 1
+    num_envs_per_worker: int = 4
+    rollout_fragment_length: int = 64
+    buffer_capacity: int = 100_000
+    warmup_steps: int = 1_000
+    batch_size: int = 256
+    updates_per_iteration: int = 64
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    policy_delay: int = 2              # critic updates per actor update
+    target_noise: float = 0.2          # target-policy smoothing stddev
+    target_noise_clip: float = 0.5
+    exploration_noise: float = 0.1
+    hidden: tuple = (128, 128)
+    seed: int = 0
+
+    def build(self) -> "TD3":
+        return TD3(self)
+
+
+class TD3:
+    def __init__(self, config: TD3Config):
+        self.config = config
+        probe = make_env(config.env)
+        self.scale = float(probe.action_high)
+        self.policy = DeterministicPolicy(probe.action_size, tuple(config.hidden))
+        self.qnet = TwinQ(tuple(config.hidden))
+        rng = jax.random.PRNGKey(config.seed)
+        obs0 = jnp.zeros((1, probe.observation_size), jnp.float32)
+        act0 = jnp.zeros((1, probe.action_size), jnp.float32)
+        self.pi_params = self.policy.init(rng, obs0)["params"]
+        self.q_params = self.qnet.init(rng, obs0, act0)["params"]
+        self.pi_target = jax.tree.map(jnp.copy, self.pi_params)
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.pi_opt = optax.adam(config.actor_lr)
+        self.q_opt = optax.adam(config.critic_lr)
+        self.pi_opt_state = self.pi_opt.init(self.pi_params)
+        self.q_opt_state = self.q_opt.init(self.q_params)
+        self.buffer = ReplayBuffer(config.buffer_capacity)
+        self.workers = [
+            TD3RolloutWorker.remote(
+                config.env,
+                num_envs=config.num_envs_per_worker,
+                seed=config.seed + 1000 * i,
+                hidden=tuple(config.hidden),
+                exploration_noise=config.exploration_noise,
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self._rng = jax.random.PRNGKey(config.seed + 7)
+        self._env_steps = 0
+        self._updates = 0
+        self._iteration = 0
+        self._update = self._build_update()
+
+    def _build_update(self):
+        policy, qnet = self.policy, self.qnet
+        cfg = self.config
+        scale = self.scale
+
+        def update(pi_p, q_p, pi_t, q_t, pi_os, q_os, batch, rng, step):
+            # -- critic: clipped double-Q with target-policy smoothing -----
+            noise = jnp.clip(
+                jax.random.normal(rng, batch["actions"].shape)
+                * cfg.target_noise * scale,
+                -cfg.target_noise_clip * scale,
+                cfg.target_noise_clip * scale,
+            )
+            next_a = jnp.clip(
+                policy.apply({"params": pi_t}, batch["next_obs"]) * scale + noise,
+                -scale, scale,
+            )
+            tq1, tq2 = qnet.apply({"params": q_t}, batch["next_obs"], next_a)
+            target_q = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]
+            ) * jnp.minimum(tq1, tq2)
+            target_q = jax.lax.stop_gradient(target_q)
+
+            def q_loss_fn(qp):
+                q1, q2 = qnet.apply({"params": qp}, batch["obs"], batch["actions"])
+                return ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+
+            q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_p)
+            q_upd, q_os = self.q_opt.update(q_grads, q_os)
+            q_p = optax.apply_updates(q_p, q_upd)
+
+            # -- delayed deterministic actor (lax.cond keeps it jittable) --
+            def pi_loss_fn(pp):
+                a = policy.apply({"params": pp}, batch["obs"]) * scale
+                q1, _ = qnet.apply({"params": q_p}, batch["obs"], a)
+                return -q1.mean()
+
+            def do_actor(args):
+                pi_p, pi_os, pi_t, q_t = args
+                pi_loss, pi_grads = jax.value_and_grad(pi_loss_fn)(pi_p)
+                pi_upd, pi_os = self.pi_opt.update(pi_grads, pi_os)
+                pi_p = optax.apply_updates(pi_p, pi_upd)
+                pi_t = jax.tree.map(
+                    lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, pi_t, pi_p
+                )
+                q_t2 = jax.tree.map(
+                    lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, q_t, q_p
+                )
+                return (pi_p, pi_os, pi_t, q_t2, pi_loss)
+
+            def skip_actor(args):
+                pi_p, pi_os, pi_t, q_t = args
+                return (pi_p, pi_os, pi_t, q_t, jnp.zeros(()))
+
+            pi_p, pi_os, pi_t, q_t, pi_loss = jax.lax.cond(
+                step % cfg.policy_delay == 0,
+                do_actor,
+                skip_actor,
+                (pi_p, pi_os, pi_t, q_t),
+            )
+            metrics = {"q_loss": q_loss, "pi_loss": pi_loss}
+            return pi_p, q_p, pi_t, q_t, pi_os, q_os, metrics
+
+        return jax.jit(update)
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        random_phase = self._env_steps < cfg.warmup_steps
+        batches = ray_tpu.get(
+            [
+                w.sample.remote(cfg.rollout_fragment_length, random_phase)
+                for w in self.workers
+            ],
+            timeout=300,
+        )
+        for b in batches:
+            self.buffer.add(b)
+            self._env_steps += len(b)
+        metrics: Dict[str, Any] = {}
+        if len(self.buffer) >= max(cfg.batch_size, cfg.warmup_steps):
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.batch_size)
+                self._rng, sub = jax.random.split(self._rng)
+                (
+                    self.pi_params, self.q_params, self.pi_target,
+                    self.q_target, self.pi_opt_state, self.q_opt_state,
+                    metrics,
+                ) = self._update(
+                    self.pi_params, self.q_params, self.pi_target,
+                    self.q_target, self.pi_opt_state, self.q_opt_state,
+                    {k: jnp.asarray(v) for k, v in batch.items()},
+                    sub,
+                    jnp.asarray(self._updates),
+                )
+                self._updates += 1
+            ray_tpu.get(
+                [w.set_weights.remote(self.pi_params) for w in self.workers],
+                timeout=120,
+            )
+        self._iteration += 1
+        returns = [
+            r
+            for w in self.workers
+            for r in ray_tpu.get(w.episode_returns.remote(), timeout=60)
+        ]
+        out = {
+            "iteration": self._iteration,
+            "env_steps": self._env_steps,
+            "episode_return_mean": float(np.mean(returns)) if returns else None,
+            "time_s": round(time.perf_counter() - t0, 2),
+        }
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -19,6 +19,7 @@ from ray_tpu.tune.search import (
     BasicVariantGenerator,
     ConcurrencyLimiter,
     Searcher,
+    TPESearcher,
     choice,
     generate_variants,
     grid_search,

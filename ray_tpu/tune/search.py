@@ -165,6 +165,201 @@ class Searcher:
         pass
 
 
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator searcher (no external deps).
+
+    Capability analogue of the reference's Optuna integration
+    (reference: python/ray/tune/search/optuna/optuna_search.py, behind the
+    Searcher ABC at tune/search/searcher.py:21); the algorithm itself is
+    TPE (Bergstra et al. 2011), the default sampler Optuna would run:
+
+    - the first ``n_startup`` suggestions sample the space uniformly;
+    - afterwards, completed trials split at the ``gamma`` quantile into
+      "good" and "bad" sets; each dimension gets a Parzen (Gaussian-kernel)
+      density for both sets; ``n_candidates`` draws from the good density
+      are scored by the likelihood ratio l(x)/g(x) and the argmax wins.
+
+    Dimensions are treated independently (Optuna's default independent
+    sampler). Supports Uniform/LogUniform/RandInt/Choice domains plus
+    fixed values; grid_search axes are rejected (a model-based searcher
+    over an exhaustive axis is a contradiction — use BasicVariantGenerator).
+    """
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: Optional[str] = None,
+        mode: str = "max",
+        n_startup: int = 8,
+        gamma: float = 0.25,
+        n_candidates: int = 24,
+        num_samples: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric, mode)
+        self._rng = random.Random(seed)
+        self._leaves = _walk(param_space or {})
+        for p, v in self._leaves:
+            if _is_grid(v):
+                raise ValueError(
+                    f"TPESearcher does not accept grid_search axes ({'.'.join(p)}); "
+                    "use BasicVariantGenerator for exhaustive sweeps"
+                )
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.num_samples = num_samples
+        self._suggested = 0
+        self._live: Dict[str, Dict[Tuple, Any]] = {}  # trial_id -> flat cfg
+        self._history: List[Tuple[Dict[Tuple, Any], float]] = []
+
+    # -- domain helpers ----------------------------------------------------
+
+    @staticmethod
+    def _to_unit(domain: Domain, value: Any) -> Optional[float]:
+        """Map a sampled value into [0,1] for kernel density work; None for
+        categorical domains (handled by counts, not kernels)."""
+        import math
+
+        if isinstance(domain, Uniform):
+            span = domain.high - domain.low
+            return (value - domain.low) / span if span else 0.5
+        if isinstance(domain, LogUniform):
+            span = domain._hi - domain._lo
+            return (math.log(value) - domain._lo) / span if span else 0.5
+        if isinstance(domain, RandInt):
+            span = domain.high - 1 - domain.low
+            return (value - domain.low) / span if span else 0.5
+        return None
+
+    @staticmethod
+    def _from_unit(domain: Domain, u: float) -> Any:
+        import math
+
+        u = min(1.0, max(0.0, u))
+        if isinstance(domain, Uniform):
+            return domain.low + u * (domain.high - domain.low)
+        if isinstance(domain, LogUniform):
+            return math.exp(domain._lo + u * (domain._hi - domain._lo))
+        if isinstance(domain, RandInt):
+            return int(round(domain.low + u * (domain.high - 1 - domain.low)))
+        raise TypeError(f"not a numeric domain: {domain}")
+
+    def _split_history(self):
+        """(good, bad) observation lists, best ``gamma`` fraction first."""
+        hist = sorted(
+            self._history,
+            key=lambda cv: cv[1],
+            reverse=(self.mode == "max"),
+        )
+        n_good = max(1, int(len(hist) * self.gamma))
+        return hist[:n_good], hist[n_good:]
+
+    def _parzen_sample_and_score(self, domain, good_vals, bad_vals):
+        """Draw candidates from the good-set KDE, return the best by l/g."""
+        import math
+
+        gu = [u for u in (self._to_unit(domain, v) for v in good_vals) if u is not None]
+        bu = [u for u in (self._to_unit(domain, v) for v in bad_vals) if u is not None]
+        if not gu:
+            return domain.sample(self._rng)
+        # Scott-ish bandwidth on the unit interval, floored so early sparse
+        # sets still explore
+        bw = max(0.1, 1.0 / (1 + len(gu)) ** 0.5 * 0.5)
+
+        def kde(us, x):
+            if not us:
+                return 1.0  # uniform prior
+            s = sum(math.exp(-0.5 * ((x - u) / bw) ** 2) for u in us)
+            return s / (len(us) * bw * math.sqrt(2 * math.pi)) + 1e-12
+
+        best_x, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            center = self._rng.choice(gu)
+            x = min(1.0, max(0.0, self._rng.gauss(center, bw)))
+            ratio = kde(gu, x) / kde(bu, x)
+            if ratio > best_ratio:
+                best_x, best_ratio = x, ratio
+        return self._from_unit(domain, best_x)
+
+    def _categorical_sample(self, domain: Choice, good_vals, bad_vals):
+        """Score categories by smoothed good/bad frequency ratio."""
+        cats = domain.categories
+
+        def counts(vals):
+            c = {id(cat): 1.0 for cat in cats}  # +1 smoothing
+            for v in vals:
+                for cat in cats:
+                    if v == cat:
+                        c[id(cat)] += 1.0
+                        break
+            total = sum(c.values())
+            return {k: v / total for k, v in c.items()}
+
+        pg, pb = counts(good_vals), counts(bad_vals)
+        weights = [pg[id(cat)] / pb[id(cat)] for cat in cats]
+        total = sum(weights)
+        r = self._rng.uniform(0, total)
+        acc = 0.0
+        for cat, w in zip(cats, weights):
+            acc += w
+            if r <= acc:
+                return cat
+        return cats[-1]
+
+    # -- Searcher interface ------------------------------------------------
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self.num_samples is not None and self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        flat: Dict[Tuple, Any] = {}
+        use_model = len(self._history) >= self.n_startup
+        good, bad = self._split_history() if use_model else ([], [])
+        cfg: Dict[str, Any] = {}
+        for p, v in self._leaves:
+            if isinstance(v, SampleFrom):
+                val = v.fn(cfg)
+            elif isinstance(v, Choice):
+                val = (
+                    self._categorical_sample(
+                        v, [c[p] for c, _ in good], [c[p] for c, _ in bad]
+                    )
+                    if use_model
+                    else v.sample(self._rng)
+                )
+            elif isinstance(v, Domain):
+                val = (
+                    self._parzen_sample_and_score(
+                        v, [c[p] for c, _ in good], [c[p] for c, _ in bad]
+                    )
+                    if use_model
+                    else v.sample(self._rng)
+                )
+            else:
+                val = v
+            flat[p] = val
+            _set_path(cfg, p, val)
+        self._live[trial_id] = flat
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None):
+        flat = self._live.pop(trial_id, None)
+        if flat is None or not result or self.metric not in result:
+            return
+        try:
+            value = float(result[self.metric])
+        except (TypeError, ValueError):
+            return
+        import math
+
+        if not math.isfinite(value):
+            # NaN/inf would poison the good/bad quantile split (NaN sorts
+            # arbitrarily); a diverged trial is simply not evidence
+            return
+        self._history.append((flat, value))
+
+
 class BasicVariantGenerator(Searcher):
     """Grid/random sweep as a Searcher (reference: search/basic_variant.py)."""
 

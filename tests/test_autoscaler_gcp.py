@@ -1,0 +1,146 @@
+"""GCP TPU provider: queued-resource lifecycle against a mock API
+(reference: autoscaler/_private/gcp/node.py, autoscaler/gcp/tpu.yaml —
+one node == one TPU-VM pod slice, atomic create/delete)."""
+
+import threading
+
+import pytest
+
+from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+
+class MockTpuApi:
+    """In-memory tpu.googleapis.com v2: queued resources advance one state
+    per poll (ACCEPTED -> PROVISIONING -> ACTIVE); deletes are immediate.
+    Replays the real API's JSON shapes."""
+
+    def __init__(self, fail_ids=(), stuck_ids=()):
+        self.lock = threading.Lock()
+        self.queued = {}  # id -> state
+        self.nodes = {}   # id -> node dict
+        self.fail_ids = set(fail_ids)    # go FAILED instead of ACTIVE
+        self.stuck_ids = set(stuck_ids)  # never leave ACCEPTED
+        self.calls = []
+
+    def request(self, method, path, body=None):
+        with self.lock:
+            self.calls.append((method, path))
+            if method == "POST" and "queuedResources" in path:
+                qid = path.split("queuedResourceId=")[1]
+                self.queued[qid] = "ACCEPTED"
+                return {"name": f"op/{qid}"}
+            if method == "GET" and "/queuedResources/" in path:
+                qid = path.rsplit("/", 1)[-1]
+                state = self.queued.get(qid, "FAILED")
+                # advance the state machine one tick per poll
+                if qid in self.stuck_ids:
+                    pass
+                elif state == "ACCEPTED":
+                    self.queued[qid] = (
+                        "FAILED" if qid in self.fail_ids else "PROVISIONING"
+                    )
+                elif state == "PROVISIONING":
+                    self.queued[qid] = "ACTIVE"
+                    self.nodes[qid] = {
+                        "name": f"projects/p/locations/z/nodes/{qid}",
+                        "state": "READY",
+                        "labels": {"raytpu-cluster": "raytpu"},
+                    }
+                return {"state": {"state": self.queued.get(qid, "FAILED")}}
+            if method == "DELETE" and "/queuedResources/" in path:
+                qid = path.rsplit("/", 1)[-1].split("?")[0]
+                self.queued.pop(qid, None)
+                self.nodes.pop(qid, None)
+                return {}
+            if method == "DELETE" and "/nodes/" in path:
+                nid = path.rsplit("/", 1)[-1]
+                self.nodes.pop(nid, None)
+                return {}
+            if method == "GET" and path.endswith("/nodes"):
+                return {"nodes": list(self.nodes.values())}
+            raise AssertionError(f"unexpected API call {method} {path}")
+
+
+def _provider(api, **kw):
+    return GcpTpuNodeProvider(
+        "proj", "us-central2-b",
+        accelerator_type=kw.pop("accelerator_type", "v5litepod-16"),
+        api=api, poll_interval_s=0.0, provision_timeout_s=kw.pop("timeout", 5.0),
+        **kw,
+    )
+
+
+def test_queued_resource_create_to_active():
+    api = MockTpuApi()
+    p = _provider(api)
+    ids = p.create_nodes(2)
+    assert len(ids) == 2
+    assert sorted(p.non_terminated_nodes()) == sorted(ids)
+    # v5litepod-16 = 4 hosts x 4 chips
+    assert p.node_resources() == {"CPU": 32.0, "TPU": 16.0}
+
+
+def test_failed_queued_resource_is_cleaned_up():
+    api = MockTpuApi()
+    # every id this provider generates will fail: patch fail set dynamically
+    orig_post = api.request
+
+    def failing(method, path, body=None):
+        if method == "POST" and "queuedResources" in path:
+            qid = path.split("queuedResourceId=")[1]
+            api.fail_ids.add(qid)
+        return orig_post(method, path, body)
+
+    api.request = failing
+    p = _provider(api)
+    ids = p.create_nodes(1)
+    assert ids == []  # atomic: failed slice is not reported as created
+    assert p.non_terminated_nodes() == []
+    # the dead queued resource was force-deleted
+    assert any(m == "DELETE" for m, _ in api.calls)
+
+
+def test_stuck_provisioning_times_out_and_tears_down():
+    api = MockTpuApi()
+    orig = api.request
+
+    def stuck(method, path, body=None):
+        if method == "POST" and "queuedResources" in path:
+            api.stuck_ids.add(path.split("queuedResourceId=")[1])
+        return orig(method, path, body)
+
+    api.request = stuck
+    p = _provider(api, timeout=0.2)
+    assert p.create_nodes(1) == []
+    assert p.non_terminated_nodes() == []
+
+
+def test_terminate_deletes_whole_slice():
+    api = MockTpuApi()
+    p = _provider(api)
+    (nid,) = p.create_nodes(1)
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+
+
+def test_unknown_accelerator_rejected():
+    with pytest.raises(ValueError, match="accelerator_type"):
+        GcpTpuNodeProvider("p", "z", accelerator_type="v9-gigantic", api=MockTpuApi())
+
+
+def test_list_filters_foreign_and_dying_nodes():
+    api = MockTpuApi()
+    p = _provider(api)
+    (nid,) = p.create_nodes(1)
+    # a node from another cluster and a deleting node must not count
+    api.nodes["other"] = {
+        "name": "projects/p/locations/z/nodes/other",
+        "state": "READY",
+        "labels": {"raytpu-cluster": "someone-else"},
+    }
+    api.nodes["dying"] = {
+        "name": "projects/p/locations/z/nodes/dying",
+        "state": "DELETING",
+        "labels": {"raytpu-cluster": "raytpu"},
+    }
+    assert p.non_terminated_nodes() == [nid]

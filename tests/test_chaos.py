@@ -1,0 +1,90 @@
+"""Chaos: kill a worker node DURING a JaxTrainer.fit and assert
+checkpoint-restart recovery (reference: release/nightly_tests/chaos_test/
++ _private/test_utils.py:1367 NodeKillerActor — random node kills during a
+live training workload, not just targeted unit-test kills)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def test_node_kill_during_training_recovers(tmp_path):
+    """Two train workers SPREAD over two nodes; the non-head node dies
+    mid-run; a replacement node joins (what the autoscaler would do) and
+    the trainer restarts from the last checkpoint and finishes."""
+    cluster = Cluster()
+    cluster.add_node(num_cpus=3)  # head: trainer driver + one worker
+    victim = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, log_level="ERROR")
+    started = tmp_path / "started"
+
+    def loop(config):
+        start = 0
+        ck = train.get_checkpoint()
+        if ck is not None:
+            start = ck.to_dict()["step"] + 1
+        for step in range(start, 6):
+            train.report(
+                {"step": step},
+                checkpoint=Checkpoint.from_dict({"step": step}),
+            )
+            if step >= 1:
+                open(config["started_marker"], "a").close()
+            time.sleep(0.6)  # wide kill window
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"started_marker": str(started)},
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"CPU": 2},
+            placement_strategy="SPREAD",
+        ),
+        run_config=RunConfig(
+            name="chaos",
+            storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=3),
+        ),
+    )
+
+    result_box = {}
+
+    def run_fit():
+        result_box["result"] = trainer.fit()
+
+    t = threading.Thread(target=run_fit, daemon=True)
+    t.start()
+    try:
+        # wait until training is genuinely under way (past step 1)
+        deadline = time.monotonic() + 120
+        while not started.exists():
+            assert time.monotonic() < deadline, "training never started"
+            assert t.is_alive(), "fit() died before the chaos kill"
+            time.sleep(0.2)
+        # chaos: kill the whole worker node mid-step
+        cluster.remove_node(victim)
+        # the autoscaler's replacement: capacity to re-form the gang
+        cluster.add_node(num_cpus=2)
+        t.join(timeout=300)
+        assert not t.is_alive(), "fit() hung after node kill"
+        result = result_box["result"]
+        assert result.error is None, f"fit failed: {result.error}"
+        # the post-restart run resumed from a checkpoint and finished
+        assert result.metrics["step"] == 5
+        assert result.checkpoint.to_dict()["step"] == 5
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

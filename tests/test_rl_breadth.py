@@ -178,3 +178,202 @@ def test_offline_dqn_training(ray_rl, tmp_path):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < np.mean(losses[:5])  # TD error shrinks
+
+
+# ---------------------------------------------------------------------------
+# round-4 breadth: APPO, TD3, BC/MARWIL, connectors
+# ---------------------------------------------------------------------------
+
+
+def test_appo_learns_cartpole(ray_rl):
+    """APPO (async clipped-surrogate over the IMPALA pipeline) must learn
+    CartPole (reference: rllib/algorithms/appo/)."""
+    from ray_tpu.rl import APPOConfig
+
+    algo = APPOConfig(
+        num_rollout_workers=2,
+        num_envs_per_worker=4,
+        rollout_fragment_length=32,
+        lr=1e-3,
+        seed=0,
+    ).build()
+    best = 0.0
+    try:
+        for _ in range(40):
+            result = algo.train(num_updates=8)
+            if np.isfinite(result["episode_return_mean"]):
+                best = max(best, result["episode_return_mean"])
+            if best >= 80.0:
+                break
+        assert best >= 80.0, f"APPO failed to learn CartPole: best {best}"
+        assert np.isfinite(result["ratio_mean"])
+    finally:
+        algo.stop()
+
+
+def test_td3_update_mechanics(ray_rl):
+    """One TD3 iteration past warmup: critic trains every update, actor only
+    every policy_delay-th; targets polyak-move (reference:
+    rllib/algorithms/td3/)."""
+    from ray_tpu.rl import TD3Config
+    import jax
+
+    algo = TD3Config(
+        env="Pendulum-v1",
+        warmup_steps=128,
+        batch_size=64,
+        rollout_fragment_length=64,
+        updates_per_iteration=8,
+        policy_delay=2,
+        seed=0,
+    ).build()
+    try:
+        q_t0 = jax.tree.map(lambda x: x.copy(), algo.q_target)
+        r1 = algo.train()  # warmup fill
+        r2 = algo.train()  # real updates
+        assert np.isfinite(r2["q_loss"])
+        moved = jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            q_t0, algo.q_target,
+        )
+        assert max(jax.tree.leaves(moved)) > 0.0, "target never synced"
+        assert algo._updates == 16
+    finally:
+        algo.stop()
+
+
+def test_td3_improves_pendulum(ray_rl):
+    """TD3 should clearly beat the random-action baseline on Pendulum."""
+    from ray_tpu.rl import TD3Config
+
+    algo = TD3Config(
+        env="Pendulum-v1",
+        num_envs_per_worker=4,
+        warmup_steps=512,
+        batch_size=256,
+        rollout_fragment_length=64,
+        # ~1:1 update:env-step ratio — TD3's sweet spot on Pendulum; at
+        # 0.25:1 it improves but too slowly for a bounded test
+        updates_per_iteration=256,
+        actor_lr=1e-3,
+        critic_lr=1e-3,
+        tau=0.01,
+        seed=0,
+    ).build()
+    try:
+        first, best = None, -1e9
+        for _ in range(30):
+            r = algo.train()
+            m = r["episode_return_mean"]
+            if m is not None and np.isfinite(m):
+                if first is None:
+                    first = m
+                best = max(best, m)
+            if best > -400.0:
+                break
+        # random policy on Pendulum averages around -1100..-1400
+        assert best > -400.0, f"TD3 did not improve: first {first}, best {best}"
+    finally:
+        algo.stop()
+
+
+def _collect_cartpole_dataset(tmp_path, steps=1500):
+    """Train PPO briefly, then log its (decent) rollouts as offline data."""
+    from ray_tpu.rl import PPOConfig, offline
+
+    algo = PPOConfig(
+        num_rollout_workers=2, num_envs_per_worker=4,
+        rollout_fragment_length=64, seed=0,
+    ).build()
+    try:
+        for _ in range(10):
+            r = algo.train()
+            if (r.get("episode_return_mean") or 0) >= 60.0:
+                break
+        batches = ray_tpu.get(
+            [w.sample.remote(steps // (2 * 4)) for w in algo.workers],
+            timeout=300,
+        )
+        path = str(tmp_path / "cartpole_offline")
+        offline.write_sample_batches(batches, path)
+        returns = [
+            x for w in algo.workers
+            for x in ray_tpu.get(w.episode_returns.remote(), timeout=60)
+        ]
+        behavior = float(np.mean(returns)) if returns else 0.0
+    finally:
+        algo.stop()
+    return path, behavior
+
+
+def test_bc_marwil_learn_from_offline(ray_rl, tmp_path):
+    """BC clones the behavior policy from logged data; MARWIL's
+    advantage-weighted loss trains too (reference: rllib/algorithms/bc/,
+    rllib/algorithms/marwil/)."""
+    from ray_tpu.rl import BCConfig, MARWILConfig
+
+    path, behavior_return = _collect_cartpole_dataset(tmp_path)
+
+    bc = BCConfig(input_path=path, lr=1e-3, batch_size=256, seed=0).build()
+    first = bc.train(epochs=1)["policy_loss"]
+    for _ in range(20):
+        last = bc.train(epochs=1)["policy_loss"]
+    assert last < first, f"BC loss did not decrease: {first} -> {last}"
+    bc_return = bc.evaluate("CartPole-v1", episodes=4)
+    # the clone should reach a decent fraction of the behavior policy
+    assert bc_return >= min(40.0, 0.5 * max(behavior_return, 1.0)), (
+        bc_return, behavior_return,
+    )
+
+    mw = MARWILConfig(input_path=path, beta=1.0, lr=1e-3,
+                      batch_size=256, seed=0).build()
+    m1 = mw.train(epochs=1)
+    for _ in range(10):
+        m2 = mw.train(epochs=1)
+    assert np.isfinite(m2["total_loss"])
+    assert m2["vf_loss"] < m1["vf_loss"], "MARWIL value head did not train"
+
+
+def test_connector_pipeline():
+    """Composable obs/action connectors with stateful filter sync
+    (reference: rllib/connectors/)."""
+    from ray_tpu.rl import (
+        ClipActions, ConnectorPipeline, FlattenObs, MeanStdFilter,
+        UnsquashActions,
+    )
+
+    rng = np.random.default_rng(0)
+    obs = rng.normal(5.0, 3.0, (64, 2, 2))
+    pipe = ConnectorPipeline([FlattenObs(), MeanStdFilter()])
+    out = pipe(obs)
+    assert out.shape == (64, 4)
+    # after seeing data, the filter recentres
+    out2 = pipe(rng.normal(5.0, 3.0, (512, 2, 2)))
+    assert abs(out2.mean()) < 0.3 and 0.5 < out2.std() < 2.0
+
+    # filter state round-trips across "workers"
+    other = ConnectorPipeline([FlattenObs(), MeanStdFilter()])
+    other.set_state(pipe.state())
+    a = pipe(np.ones((1, 2, 2)) * 5.0)
+    b = other(np.ones((1, 2, 2)) * 5.0)
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    acts = ConnectorPipeline([UnsquashActions(-2.0, 2.0), ClipActions(-2.0, 2.0)])
+    np.testing.assert_allclose(acts(np.array([[-1.0], [0.0], [1.0]])),
+                               [[-2.0], [0.0], [2.0]])
+
+
+def test_rollout_worker_with_connectors(ray_rl):
+    """Connectors plug into the rollout path: normalized observations reach
+    the policy, raw observations reach the batch."""
+    from ray_tpu.rl import ConnectorPipeline, MeanStdFilter
+    from ray_tpu.rl.rollout_worker import RolloutWorker
+
+    w = RolloutWorker.remote(
+        "CartPole-v1", num_envs=2, seed=0,
+        obs_connectors=ConnectorPipeline([MeanStdFilter()]),
+    )
+    batch = ray_tpu.get(w.sample.remote(16), timeout=120)
+    assert batch["obs"].shape == (32, 4)
+    state = ray_tpu.get(w.connector_state.remote(), timeout=60)
+    assert state["obs"]["0"]["count"] == 32 * 1.0 or state["obs"]["0"]["count"] > 0
