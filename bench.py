@@ -33,6 +33,10 @@ def main():
     ap.add_argument(
         "--remat-policy", default=None, choices=["nothing", "dots", "attn"]
     )
+    ap.add_argument(
+        "--scan-layers", default=None, choices=["on", "off"],
+        help="force lax.scan over layers on/off (1b default: off/unrolled)",
+    )
     args = ap.parse_args()
 
     from ray_tpu.models.gpt import gpt_1b, gpt_125m, gpt_nano, train_step_flops
@@ -50,16 +54,24 @@ def main():
     extra = {}
     if args.remat_policy:
         extra["remat_policy"] = args.remat_policy
+    if args.scan_layers is not None:
+        extra["scan_layers"] = args.scan_layers == "on"
     if args.model == "1b":
         # bf16 params+moments so the full Adam state fits one 16G chip; a
         # real multi-chip run keeps f32 master state sharded over fsdp.
+        # Tuned on v5e (r4 sweep): batch 12 + 1024x1024 flash tiles +
+        # 512-row CE chunks + unrolled layers = 0.622 MFU vs 0.570 before.
+        extra.setdefault("attn_block_q", 1024)
+        extra.setdefault("attn_block_k", 1024)
+        extra.setdefault("ce_chunk", 512)
+        extra.setdefault("scan_layers", False)
         cfg = gpt_1b(dtype=jnp.bfloat16, param_dtype=jnp.bfloat16, **extra)
-        batch, seq, iters = 8, 2048, 20
+        batch, seq, iters = 12, 2048, 20
     elif args.model == "125m":
-        cfg = gpt_125m(dtype=jnp.bfloat16)
+        cfg = gpt_125m(dtype=jnp.bfloat16, **extra)
         batch, seq, iters = 16, 2048, 30
     else:
-        cfg = gpt_nano()
+        cfg = gpt_nano(**extra)
         batch, seq, iters = 4, 128, 3
     batch = args.batch or batch
     seq = args.seq or seq

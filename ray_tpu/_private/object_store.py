@@ -260,6 +260,7 @@ class PlasmaStore:
                     os.posix_fallocate(self._fd, 0, prealloc)
                 except OSError:
                     pass
+            self._prefault_bytes = prealloc
         self._map = mmap.mmap(self._fd, self.capacity)
         self._view = memoryview(self._map)
         self._arena = _make_arena(self.capacity)
@@ -276,6 +277,19 @@ class PlasmaStore:
         self._closed = False
         self._flush_queue: List[ObjectID] = []
         self._spill_pending_bytes = 0  # un-flushed spill_data held in heap
+        self._spilled_bytes_total = 0  # lifetime spill volume (stats)
+        # background page population: fallocate reserves blocks but the
+        # first WRITE to each page still takes a minor fault (~1.5 GB/s
+        # effective vs ~7.5 GB/s on populated pages, measured on this host).
+        # Populate the arena once off the hot path; pages stay resident
+        # after arena frees, so steady-state puts run at warm-memcpy speed.
+        if GlobalConfig.object_store_prealloc and getattr(self, "_prefault_bytes", 0) > 0:
+            threading.Thread(
+                target=self._prefault_loop,
+                args=(self._prefault_bytes,),
+                name=f"{name}-prefault",
+                daemon=True,
+            ).start()
         if self._spill_enabled:
             # disk writes happen off the store lock: _spill_locked only
             # copies bytes out of the arena; this thread persists them
@@ -283,6 +297,22 @@ class PlasmaStore:
                 target=self._flush_loop, name=f"{name}-spill-flush", daemon=True
             )
             self._flusher.start()
+
+    def _prefault_loop(self, total: int, step: int = 32 * 1024 * 1024):
+        for start in range(0, total, step):
+            if self._closed:
+                return
+            length = min(step, total - start)
+            t0 = time.monotonic()
+            try:
+                self._map.madvise(_MADV_POPULATE_WRITE, start, length)
+            except (ValueError, OSError, AttributeError):
+                return  # kernel without MADV_POPULATE_WRITE: faults apply
+            # self-pacing at ~50% duty: finish a 2 GiB arena in a few
+            # seconds without monopolizing a small host's core — too gentle
+            # and the contention window stretches across the caller's whole
+            # early workload, which costs more than the pacing saves
+            time.sleep(max(0.01, time.monotonic() - t0))
 
     # -- server-side API (called via raylet RPC handlers or locally) --
 
@@ -415,6 +445,7 @@ class PlasmaStore:
         async flush. Backpressure: once un-flushed bytes exceed half the
         arena, write synchronously (bounded memory beats bounded latency
         when producers outrun the disk)."""
+        self._spilled_bytes_total += e.size
         if self._spill_pending_bytes > self.capacity // 2:
             os.makedirs(self._spill_dir, exist_ok=True)
             path = os.path.join(self._spill_dir, object_id.hex())
@@ -504,12 +535,33 @@ class PlasmaStore:
             # be spilled/evicted between lock release and the copy
             return bytes(self._view[base + offset : base + offset + length])
 
+    def read_view(
+        self, object_id: ObjectID, offset: int, length: int
+    ) -> Optional[memoryview]:
+        """Zero-copy chunk view for the transfer plane. ONLY safe when the
+        caller guarantees the entry stays resident until the view is
+        consumed (the puller pins the object for the whole pull); spilled
+        entries fall back to the copying read."""
+        with self._cv:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed:
+                return None
+            if not e.resident:
+                pass  # fall through to the copying read below
+            else:
+                length = min(length, e.size - offset)
+                base = e.offset
+                return self._view[base + offset : base + offset + length]
+        data = self.read(object_id, offset, length)
+        return None if data is None else memoryview(data)
+
     def stats(self) -> Dict[str, int]:
         with self._cv:
             return {
                 "capacity": self.capacity,
                 "num_objects": len(self._entries),
                 "allocated_bytes": sum(e.size for e in self._entries.values()),
+                "spilled_bytes_total": self._spilled_bytes_total,
             }
 
     def list_objects(self) -> List[Dict[str, object]]:

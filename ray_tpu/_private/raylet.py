@@ -958,9 +958,79 @@ class Raylet:
             return client
 
     def rpc_store_fetch(self, conn, payload):
-        """Serve a chunk of a sealed local object to a peer raylet."""
+        """Serve a chunk of a sealed local object to a peer raylet.
+
+        Returned as a PickleBuffer view straight into the shm arena: wire v3
+        ships it out-of-band (no serialize copy here, no deserialize copy on
+        the puller). The puller holds a remote pin for the duration of the
+        pull, so the viewed range cannot be evicted mid-send."""
+        import pickle as _pickle
+
         object_id, offset, length = payload
-        return self.store.read(object_id, offset, length)
+        view = self.store.read_view(object_id, offset, length)
+        if view is None:
+            return None
+        return _pickle.PickleBuffer(view)
+
+    def _pull_chunks_pipelined(
+        self, client: RpcClient, object_id, view, size: int, window: int = 4
+    ) -> bool:
+        """Keep ``window`` chunk fetches in flight so the wire never idles
+        while this thread memcpys the previous chunk into the arena
+        (reference: object_manager.h:63 object_chunk_size + the push
+        manager's in-flight chunk pipeline, push_manager.cc). The serial
+        request-per-chunk loop this replaces left a full RTT gap between
+        chunks — the put/weights path sat at ~0.26x reference bandwidth."""
+        from ray_tpu._private import rpc as rpc_mod
+
+        done: Dict[int, Any] = {}
+        cv = threading.Condition()
+
+        def make_cb(pos: int):
+            def cb(kind, payload):
+                with cv:
+                    done[pos] = (kind, payload)
+                    cv.notify_all()
+
+            return cb
+
+        next_send = 0
+        next_write = 0
+        while next_write < size:
+            while (
+                next_send < size
+                and next_send - next_write < window * self._PULL_CHUNK
+            ):
+                n = min(self._PULL_CHUNK, size - next_send)
+                client.call_async(
+                    "store_fetch", (object_id, next_send, n), make_cb(next_send)
+                )
+                next_send += n
+            with cv:
+                deadline = time.monotonic() + 60.0
+                while next_write not in done:
+                    if not cv.wait(timeout=max(0.0, deadline - time.monotonic())):
+                        raise TimeoutError(
+                            f"chunk fetch at {next_write} timed out"
+                        )
+                kind, payload = done.pop(next_write)
+            if kind != rpc_mod.RESPONSE or payload is None or len(payload) == 0:
+                if isinstance(payload, BaseException):
+                    raise payload
+                return False
+            view[next_write : next_write + len(payload)] = payload
+            requested = min(self._PULL_CHUNK, size - next_write)
+            next_write += len(payload)
+            if len(payload) < requested and next_write < size:
+                # short read (metadata/size disagreement): re-request the
+                # gap — its key is exactly the new next_write, so the
+                # ordered wait above picks it up like any other chunk
+                client.call_async(
+                    "store_fetch",
+                    (object_id, next_write, requested - len(payload)),
+                    make_cb(next_write),
+                )
+        return True
 
     def rpc_store_pull(self, conn, payload):
         """Fetch an object from a peer raylet into the local store.
@@ -991,16 +1061,10 @@ class Raylet:
             if size > 8 * 1024 * 1024:
                 object_store._populate_range(self.store._map, offset, size)
             view = self.store.view(offset, size)
-            pos = 0
             try:
-                while pos < size:
-                    n = min(self._PULL_CHUNK, size - pos)
-                    chunk = client.call("store_fetch", (object_id, pos, n), timeout=60.0)
-                    if chunk is None:
-                        self.store.abort(object_id)
-                        return False
-                    view[pos : pos + len(chunk)] = chunk
-                    pos += len(chunk)
+                if not self._pull_chunks_pipelined(client, object_id, view, size):
+                    self.store.abort(object_id)
+                    return False
             except Exception:
                 self.store.abort(object_id)
                 raise

@@ -45,9 +45,18 @@ from ray_tpu._private.config import GlobalConfig
 # refuse to unpickle ANYTHING from an unauthenticated peer: decoding —
 # even through the restricted unpickler — happens only after the token
 # check passes.
+#
+# v3 adds pickle-5 out-of-band buffers: a non-AUTH body is
+#   u32 meta_len | meta (pickle) | { u32 buf_len | raw bytes }*
+# so large binary payloads (object-transfer chunks, weights) ride the wire
+# raw — no pickle.dumps copy on the sender, no unpickle copy on the
+# receiver (the loaded object views straight into the receive buffer).
 _MAGIC = 0x5254  # "RT"
-_WIRE_VERSION = 2
+_WIRE_VERSION = 3
 _HEADER = struct.Struct(">HBBI")
+_U32 = struct.Struct(">I")
+# buffers at least this big go out-of-band; smaller ones pickle in-band
+_OOB_MIN_BYTES = 64 * 1024
 
 REQUEST = 0
 RESPONSE = 1
@@ -253,15 +262,30 @@ def _is_framework_id(obj: type) -> bool:
         return False
 
 
-def _loads_control(data) -> Any:
+def _loads_control(data, buffers=()) -> Any:
     import io as _io
 
     try:
-        return _ControlUnpickler(_io.BytesIO(data)).load()
+        return _ControlUnpickler(_io.BytesIO(data), buffers=buffers).load()
     except pickle.UnpicklingError:
         raise
     except Exception as e:  # truncated/garbage stream
         raise RpcError(f"undecodable control frame: {type(e).__name__}") from e
+
+
+def _decode_body(body) -> Any:
+    """Parse a v3 body (meta + out-of-band buffers) and unpickle."""
+    view = memoryview(body)
+    (meta_len,) = _U32.unpack_from(view, 0)
+    offset = _U32.size + meta_len
+    meta = view[_U32.size : offset]
+    buffers = []
+    while offset < len(view):
+        (blen,) = _U32.unpack_from(view, offset)
+        offset += _U32.size
+        buffers.append(view[offset : offset + blen])
+        offset += blen
+    return _loads_control(meta, buffers=buffers)
 
 
 class RpcError(Exception):
@@ -313,23 +337,73 @@ class _SendState:
                 if isinstance(payload_obj, str)
                 else bytes(payload_obj or b"")
             )
+            parts = [_HEADER.pack(_MAGIC, _WIRE_VERSION, kind, len(data)), data]
         else:
-            data = pickle.dumps((msg_id, method, payload_obj), protocol=5)
-        payload = _HEADER.pack(_MAGIC, _WIRE_VERSION, kind, len(data)) + data
+            bufs: list = []
+
+            def _cb(pb: pickle.PickleBuffer):
+                v = pb.raw()
+                if v.nbytes >= _OOB_MIN_BYTES and v.contiguous:
+                    bufs.append(v.cast("B"))
+                    return False  # ship raw, out-of-band
+                return True  # small/strided: in-band
+
+            meta = pickle.dumps(
+                (msg_id, method, payload_obj), protocol=5, buffer_callback=_cb
+            )
+            total = _U32.size + len(meta) + sum(
+                _U32.size + b.nbytes for b in bufs
+            )
+            parts = [
+                _HEADER.pack(_MAGIC, _WIRE_VERSION, kind, total),
+                _U32.pack(len(meta)),
+                meta,
+            ]
+            for b in bufs:
+                parts.append(_U32.pack(b.nbytes))
+                parts.append(b)
+            # coalesce adjacent small parts into single sends: header+meta
+            # must leave as ONE segment (tiny writes each become a TCP
+            # segment under NODELAY), and per-part syscalls add up; only
+            # large out-of-band buffers are worth sending from their own
+            # memory without a copy
+            merged: list = []
+            run: list = []
+            for p in parts:
+                if isinstance(p, memoryview) and p.nbytes > 256 * 1024:
+                    if run:
+                        merged.append(b"".join(run))
+                        run = []
+                    merged.append(p)
+                else:
+                    run.append(bytes(p) if isinstance(p, memoryview) else p)
+            if run:
+                merged.append(b"".join(run))
+            parts = merged
         with self.lock:
             if self.buf:
-                self._buffer(payload)
+                for p in parts:
+                    self._buffer(bytes(p) if isinstance(p, memoryview) else p)
                 return
-            view = memoryview(payload)
-            while view:
-                try:
-                    n = self.sock.send(view)
-                    view = view[n:]
-                except (BlockingIOError, InterruptedError):
-                    self._buffer(bytes(view))
-                    return
-                except OSError as e:
-                    raise ConnectionLost(str(e)) from e
+            for i, p in enumerate(parts):
+                view = p if isinstance(p, memoryview) else memoryview(p)
+                while view:
+                    try:
+                        n = self.sock.send(view)
+                        view = view[n:]
+                    except (BlockingIOError, InterruptedError):
+                        # kernel is full: buffer the unsent tail (one copy)
+                        # plus every remaining part and let the poller flush
+                        self._buffer(bytes(view))
+                        for rest in parts[i + 1 :]:
+                            self._buffer(
+                                bytes(rest)
+                                if isinstance(rest, memoryview)
+                                else rest
+                            )
+                        return
+                    except OSError as e:
+                        raise ConnectionLost(str(e)) from e
 
     def _buffer(self, tail: bytes):
         # called under self.lock
@@ -671,7 +745,7 @@ class ServerConn:
             raise ConnectionLost("unauthenticated request")
         if kind != REQUEST:
             return
-        msg_id, method, payload = _loads_control(body)
+        msg_id, method, payload = _decode_body(body)
         srv = self._server
         if method in srv._inline:
             # order-sensitive handlers run right here on the poller thread
@@ -769,7 +843,24 @@ class RpcServer:
         )
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind((host, port))
+        # a server restarting on its well-known port (GCS failover) can race
+        # its predecessor's teardown: retry EADDRINUSE briefly instead of
+        # failing the restart outright (ephemeral binds never collide)
+        import errno
+
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                self._listener.bind((host, port))
+                break
+            except OSError as e:
+                if (
+                    port == 0
+                    or e.errno != errno.EADDRINUSE
+                    or time.monotonic() > deadline
+                ):
+                    raise
+                time.sleep(0.1)
         self._listener.listen(128)
         self.host, self.port = self._listener.getsockname()
         self._conns: Dict[int, ServerConn] = {}
@@ -933,7 +1024,7 @@ class RpcClient:
         self._frames.feed(self._sock, self._on_frame)
 
     def _on_frame(self, kind: int, body: bytes):
-        msg_id, method, payload = _loads_control(body)
+        msg_id, method, payload = _decode_body(body)
         if kind == ERROR and msg_id == 0:
             # connection-level refusal (e.g. "authentication required"):
             # there is no per-call slot to route it to — fail everything

@@ -109,6 +109,22 @@ def block_slice(block: Block, start: int, end: int) -> Block:
     return block.slice(start, end - start)
 
 
+def copy_block(block: Block) -> Block:
+    """Deep-copy a block into freshly-owned heap buffers.
+
+    Blocks deserialized from task args are ZERO-COPY views into the plasma
+    arena; an actor that stashes one beyond its task's lifetime (e.g. the
+    streaming shuffle's merge actors) would otherwise hold dangling views
+    once the owner drops the ref and the arena range is reused. The arrow
+    IPC round-trip is type-exact and guarantees fresh buffers."""
+    import pyarrow as _pa
+
+    sink = _pa.BufferOutputStream()
+    with _pa.ipc.new_stream(sink, block.schema) as writer:
+        writer.write_table(block)
+    return _pa.ipc.open_stream(sink.getvalue()).read_all()
+
+
 def concat_blocks(blocks: List[Block]) -> Block:
     blocks = [b for b in blocks if b.num_rows > 0]
     if not blocks:

@@ -1,0 +1,150 @@
+"""Push-based streaming shuffle: all-to-all WITHOUT a pipeline barrier.
+
+Reference: python/ray/data/_internal/push_based_shuffle.py (two-stage
+map-partition → pipelined merge) and the streaming all-to-all operator
+(_internal/execution/operators/all_to_all_operator.py). The r3 design made
+every random_shuffle a barrier that materialized the whole upstream dataset
+into the object store (plan.py docstring) — a terabyte pipeline with one
+shuffle lost its bounded-memory property.
+
+This implementation keeps the stream flowing:
+
+- upstream blocks arrive one at a time through the streaming executor's
+  bounded window;
+- a partition task splits each block row-wise into P random partitions
+  (P object refs, one hop in the store);
+- P merge ACTORS each ingest their partition pieces into their own heap
+  and the driver immediately drops the piece refs — the object store never
+  holds more than the in-flight window of pieces, so a dataset many times
+  the store capacity shuffles without spilling;
+- after upstream drains, each merger permutes its rows once and serves
+  shuffled output blocks on demand, one ref at a time, as the downstream
+  consumer pulls them (output blocks are freed by the consumer's iteration
+  like any other stream block).
+
+Uniformity: each row lands in a uniformly random partition, and each
+partition applies a uniform permutation — the classic two-stage shuffle.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as B
+
+
+@ray_tpu.remote
+def _partition_task(seed: int, num_partitions: int, blk: B.Block):
+    """Split one block into ``num_partitions`` row-subsets uniformly at
+    random. Returns a list of blocks (static num_returns=P at call site)."""
+    n = blk.num_rows
+    rng = np.random.default_rng(seed)
+    assignment = rng.integers(0, num_partitions, size=n)
+    out = []
+    for p in range(num_partitions):
+        idx = np.nonzero(assignment == p)[0]
+        out.append(blk.take(idx))
+    return tuple(out) if num_partitions > 1 else out[0]
+
+
+@ray_tpu.remote(num_cpus=0.25)
+class _ShuffleMerger:
+    """Accumulates one partition's pieces in actor heap; serves shuffled
+    blocks after ``finish`` (reference: push_based_shuffle.py merge tasks,
+    except long-lived so ingestion pipelines with upstream execution)."""
+
+    def __init__(self, seed: int):
+        self._pieces: List[B.Block] = []
+        self._rows = 0
+        self._blocks: Optional[List[B.Block]] = None
+        self._seed = seed
+
+    def add(self, piece: B.Block) -> int:
+        if piece.num_rows:
+            # MUST deep-copy: the arg is a zero-copy view into plasma and
+            # the driver frees the piece object right after this call —
+            # keeping the view would dangle once the arena range is reused
+            self._pieces.append(B.copy_block(piece))
+            self._rows += piece.num_rows
+        return self._rows
+
+    def finish(self, target_block_rows: int) -> int:
+        """Permute the accumulated rows; returns the output block count."""
+        if not self._pieces:
+            self._blocks = []
+            return 0
+        merged = B.concat_blocks(self._pieces)
+        self._pieces = []
+        rng = np.random.default_rng(self._seed)
+        perm = rng.permutation(merged.num_rows)
+        merged = merged.take(perm)
+        self._blocks = [
+            merged.slice(lo, min(target_block_rows, merged.num_rows - lo))
+            for lo in range(0, merged.num_rows, target_block_rows)
+        ]
+        return len(self._blocks)
+
+    def get_block(self, i: int) -> B.Block:
+        blk = self._blocks[i]
+        # hand out and forget: the merger's heap shrinks as the consumer
+        # drains, keeping end-to-end memory bounded by what is in flight.
+        # copy_block trims the slice to owned buffers — pickling an arrow
+        # slice would otherwise serialize the WHOLE merged partition's
+        # backing buffers per output block
+        self._blocks[i] = None
+        return B.copy_block(blk)
+
+
+def streaming_shuffle_refs(
+    upstream_stream: Iterator,
+    *,
+    num_partitions: int = 8,
+    seed: Optional[int] = None,
+    target_block_rows: int = 32_768,
+    window: int = 3,
+) -> Iterator[Any]:
+    """Drive the push-based shuffle over an upstream (block_ref, meta_ref)
+    stream; yields output block refs one at a time."""
+    base = seed if seed is not None else random.randint(0, 2**31)
+    mergers = [_ShuffleMerger.remote(base + 7919 * (i + 1)) for i in range(num_partitions)]
+    pending_adds: List[Any] = []
+    block_i = 0
+    try:
+        for blk_ref, _meta in upstream_stream:
+            refs = _partition_task.options(num_returns=num_partitions).remote(
+                base + 31 * block_i, num_partitions, blk_ref
+            )
+            if num_partitions == 1:
+                refs = [refs]
+            for p, piece_ref in enumerate(refs):
+                pending_adds.append(mergers[p].add.remote(piece_ref))
+            del refs, blk_ref  # drop piece/source refs: store frees behind us
+            block_i += 1
+            if len(pending_adds) > window * num_partitions:
+                # backpressure: wait out the oldest round of ingests
+                ray_tpu.get(pending_adds[:num_partitions], timeout=600)
+                del pending_adds[:num_partitions]
+        if pending_adds:
+            ray_tpu.get(pending_adds, timeout=600)
+        counts = ray_tpu.get(
+            [m.finish.remote(target_block_rows) for m in mergers], timeout=600
+        )
+        for m, count in zip(mergers, counts):
+            for i in range(count):
+                ref = m.get_block.remote(i)
+                # wait for the block to EXIST before yielding: a consumer
+                # like materialize() collects refs without getting them, and
+                # the finally-kill below must not shoot an actor that still
+                # owes queued get_block results
+                ray_tpu.wait([ref], num_returns=1, timeout=None)
+                yield ref
+    finally:
+        for m in mergers:
+            try:
+                ray_tpu.kill(m)
+            except Exception:
+                pass

@@ -55,6 +55,13 @@ class GPTConfig:
     remat_policy: str = "nothing"
     scan_layers: bool = True
     attn_use_pallas: Optional[bool] = None  # None → auto (TPU only)
+    # flash-attention kernel tile sizes (v5e sweep on the 1B/2048 bench:
+    # 1024/1024 is ~6% faster than 512/512; 2048 overflows VMEM)
+    attn_block_q: int = 512
+    attn_block_k: int = 512
+    # blockwise cross-entropy chunk length (sequence rows per scanned
+    # [b, chunk, vocab] logits block)
+    ce_chunk: int = 256
     seq_parallel_impl: str = "ring"         # "ring" | "ulysses" (used when sp>1)
     # mixture-of-experts (0 = dense MLP); experts shard over the ep axis
     moe_num_experts: int = 0
@@ -221,12 +228,15 @@ class Attention(nn.Module):
             ).transpose(0, 2, 1, 3)
         else:
             out = dot_product_attention(
-                qh, kh, vh, causal=True, use_pallas=cfg.attn_use_pallas
+                qh, kh, vh, causal=True, use_pallas=cfg.attn_use_pallas,
+                block_q=cfg.attn_block_q, block_k=cfg.attn_block_k,
             ).transpose(0, 2, 1, 3)
         # tag for remat_policy="attn": saving exactly this tensor lets the
         # backward pass skip replaying the flash-attention forward kernel
         # while everything cheaper (LN, rotary, gelu) still rematerializes
-        out = jax.ad_checkpoint.checkpoint_name(out, "attn_out")
+        from jax.ad_checkpoint import checkpoint_name
+
+        out = checkpoint_name(out, "attn_out")
         return _dense((cfg.embed_dim,), ("heads", "kv", "embed"), cfg, "o", use_bias=False)(
             out
         )

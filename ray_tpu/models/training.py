@@ -161,7 +161,9 @@ def make_train_step(
         else:
             (hidden, kernel, bias), aux = _apply(params, tokens)
         # Blockwise xent: never materializes the [b, t, vocab] logits.
-        loss = blockwise_next_token_loss(hidden, kernel, bias, tokens)
+        loss = blockwise_next_token_loss(
+            hidden, kernel, bias, tokens, chunk=cfg.ce_chunk
+        )
         return loss + cfg.moe_aux_weight * aux
 
     def step(state: TrainState, tokens: jax.Array):

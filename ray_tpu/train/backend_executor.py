@@ -30,8 +30,17 @@ class JaxConfig:
     group named ``train`` is always available for CPU-tensor sync.
     """
 
-    def __init__(self, init_jax_distributed: bool = False):
+    def __init__(
+        self,
+        init_jax_distributed: bool = False,
+        local_device_count: Optional[int] = None,
+    ):
         self.init_jax_distributed = init_jax_distributed
+        # force an n-device virtual CPU platform per rank BEFORE the
+        # distributed bring-up: how multi-chip-per-host sharding logic
+        # (pp x fsdp x tp meshes) is exercised without TPU hardware
+        # (SURVEY.md §4 takeaway: fake topology on CPU devices)
+        self.local_device_count = local_device_count
 
 
 class TrainingFailedError(RuntimeError):
@@ -92,7 +101,11 @@ class BackendExecutor:
         if self.backend.init_jax_distributed:
             # every rank joins the jax.distributed world NOW (before any
             # other jax call in the worker) — the init_process_group moment
-            self.group.execute("init_jax_distributed", timeout=300.0)
+            self.group.execute(
+                "init_jax_distributed",
+                self.backend.local_device_count,
+                timeout=300.0,
+            )
 
     def start_training(
         self,

@@ -56,12 +56,17 @@ class TrainWorker:
         os.environ["JAX_COORDINATOR_ADDRESS"] = address
         return True
 
-    def init_jax_distributed(self) -> bool:
+    def init_jax_distributed(self, local_device_count=None) -> bool:
         """The dist.init_process_group moment (reference:
         train/torch/config.py:113): join the gang's jax.distributed world
         so device_count spans every rank. On CPU workers the collectives
         ride gloo; on TPU hosts the coordination service uses the native
         backend. Must run before ANY other jax call in this process."""
+        if local_device_count:
+            # n virtual CPU devices per rank (must precede backend init)
+            from ray_tpu._private.virtual_mesh import set_virtual_cpu_env
+
+            set_virtual_cpu_env(local_device_count)
         import jax
 
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":

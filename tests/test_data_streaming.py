@@ -90,3 +90,92 @@ def test_lazy_count_and_explain(ray_start_regular):
     plan = ds.map(lambda r: r).filter(lambda r: r["id"] < 10)
     assert "map -> filter" in plan.explain()
     assert plan.count() == 10
+
+
+def test_streaming_shuffle_correct_and_random(ray_start_regular):
+    """random_shuffle in the lazy pipeline: every row present exactly once,
+    order changed, seeded determinism (reference: push_based_shuffle.py)."""
+    ds = rd.range(2_000, parallelism=8).lazy()
+    out = (
+        ds.map_batches(lambda b, **_: {"id": b["id"]})
+        .random_shuffle(seed=7, num_partitions=4, target_block_rows=300)
+        .take(2_000)
+    )
+    ids = [r["id"] for r in out]
+    assert sorted(ids) == list(range(2_000))
+    assert ids != list(range(2_000)), "shuffle left rows in order"
+    # seeded: same plan, same permutation
+    out2 = (
+        rd.range(2_000, parallelism=8).lazy()
+        .map_batches(lambda b, **_: {"id": b["id"]})
+        .random_shuffle(seed=7, num_partitions=4, target_block_rows=300)
+        .take(2_000)
+    )
+    assert [r["id"] for r in out2] == ids
+
+
+def test_streaming_shuffle_exceeds_store_capacity():
+    """read -> map -> random_shuffle -> iter_batches over a dataset ~4x the
+    object-store capacity completes WITHOUT spilling: the shuffle is not a
+    materialize barrier any more (VERDICT r3 next #3). Merge actors hold
+    partitions in their heaps; only the in-flight window touches plasma."""
+    import ray_tpu as rt
+
+    worker = rt.init(
+        num_cpus=4,
+        object_store_memory=96 * 1024 * 1024,  # 96 MB store
+        log_level="ERROR",
+    )
+    try:
+        store = worker.node.raylet.store
+        rows = 24_000
+        parallelism = 48
+        payload = 16_384  # 16 KB/row x 24k rows = 384 MB, 4x the store
+
+        def fatten(b, **_):
+            n = len(b["id"])
+            return {
+                "id": b["id"],
+                "payload": np.ones((n, payload), np.uint8),
+            }
+
+        ds = (
+            rd.range(rows, parallelism=parallelism)
+            .lazy()
+            .map_batches(fatten)
+            .random_shuffle(seed=3, num_partitions=4, target_block_rows=512)
+        )
+        seen = 0
+        checksum = 0
+        for batch in ds.iter_batches(batch_size=256, batch_format="numpy"):
+            seen += len(batch["id"])
+            checksum += int(batch["id"].sum())
+            assert batch["payload"].shape[1] == payload
+        assert seen == rows
+        assert checksum == rows * (rows - 1) // 2
+        stats = store.stats()
+        # "flat" spill: transient in-flight windows may brush the cap, but
+        # nothing like the old barrier, which materialized the full dataset
+        # through the store (>= 3x capacity would have spilled here)
+        total_bytes = rows * payload
+        assert stats["spilled_bytes_total"] < total_bytes // 10, (
+            f"streaming shuffle spilled {stats['spilled_bytes_total']}B "
+            f"of a {total_bytes}B dataset"
+        )
+    finally:
+        rt.shutdown()
+
+
+def test_streaming_shuffle_materialize_and_chain(ray_start_regular):
+    """materialize()/further-ops after random_shuffle must survive merger
+    teardown: output refs are only yielded once their blocks exist."""
+    ds = rd.range(1_000, parallelism=4).lazy().random_shuffle(seed=1, num_partitions=2)
+    mat = ds.materialize()
+    assert sorted(r["id"] for b in [mat.take(1_000)] for r in b) == list(range(1_000))
+    chained = (
+        rd.range(1_000, parallelism=4).lazy()
+        .random_shuffle(seed=2, num_partitions=2)
+        .map_batches(lambda b, **_: {"id": b["id"] * 2})
+        .take(1_000)
+    )
+    assert sorted(r["id"] for r in chained) == [2 * i for i in range(1_000)]

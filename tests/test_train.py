@@ -209,3 +209,115 @@ def test_jax_distributed_multiprocess_bringup(ray_start_regular):
     assert result.error is None, result.error
     # ranks 1 and 2 each contribute 2 elements: 2*1 + 2*2 = 6
     assert result.metrics["total"] == 6.0
+
+
+def test_north_star_pp_fsdp_tp_gang_failure_resume(ray_start_regular, tmp_path):
+    """The SURVEY §7 step-5/6 composition in one assertion chain
+    (VERDICT r3 next #8): gang-schedule a WorkerGroup on a placement
+    group, bring up jax.distributed across 2 processes (4 virtual CPU
+    devices each), run the composed pp2 x fsdp2 x tp2 train step through
+    JaxTrainer, checkpoint the (device-sharded) state each step, KILL a
+    worker mid-run, and resume from the checkpoint to completion."""
+    import os as _os
+
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+    from ray_tpu.train.backend_executor import JaxConfig
+
+    marker = tmp_path / "killed_once"
+
+    def loop(config):
+        import dataclasses
+        import os
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        from ray_tpu import train
+        from ray_tpu.models.gpt import gpt_nano
+        from ray_tpu.models.training import default_optimizer, init_sharded_state
+        from ray_tpu.parallel import sharding as shd
+        from ray_tpu.parallel.mesh import MeshSpec
+        from ray_tpu.parallel.pipeline import make_pp_train_step
+
+        # the gang really is a 2-process SPMD world over 8 global devices
+        assert jax.process_count() == 2
+        assert jax.device_count() == 8
+        cfg = dataclasses.replace(gpt_nano(), num_layers=4, max_seq_len=32)
+        mesh = MeshSpec(dp=-1, pp=2, fsdp=2, tp=2).build(jax.devices())
+        opt = default_optimizer(1e-3)
+        rules = shd.pp_rules()
+        batch, seq = 4, 32
+        state, shardings = init_sharded_state(
+            cfg, mesh, opt, jax.random.PRNGKey(0), (batch, seq), rules=rules
+        )
+        step = make_pp_train_step(
+            cfg, opt, mesh, num_microbatches=2, rules=rules,
+            state_shardings_tree=shardings,
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size
+        )
+        start = 0
+        ck = train.get_checkpoint()
+        if ck is not None:
+            # restore the sharded state: every rank re-shards the host tree
+            # onto its mesh slice via the saved shardings
+            payload = ck.to_dict()
+            start = payload["step"] + 1
+            host_params = payload["params"]
+            state = dataclasses.replace(
+                state,
+                params=jax.device_put(host_params, shardings.params),
+            )
+        with mesh:
+            for s in range(start, 4):
+                state, metrics = step(state, tokens)
+                loss = float(metrics["loss"])
+                assert np.isfinite(loss)
+                # checkpoint: gather the (tiny) device-sharded params into a
+                # replicated host tree so any restarted gang can re-shard it
+                # via device_put(shardings) — the dict checkpoint then rides
+                # the normal session/CheckpointManager plumbing
+                host_params = jax.tree.map(
+                    lambda x: np.asarray(
+                        multihost_utils.process_allgather(x, tiled=True)
+                    ),
+                    state.params,
+                )
+                train.report(
+                    {"loss": loss, "step": s},
+                    checkpoint=train.Checkpoint.from_dict(
+                        {"step": s, "params": host_params}
+                    ),
+                )
+                if (
+                    s == 1
+                    and train.session.get_world_rank() == 1
+                    and not os.path.exists(config["marker"])
+                ):
+                    open(config["marker"], "w").close()
+                    os._exit(1)  # chaos: the worker PROCESS dies mid-gang
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"marker": str(marker)},
+        scaling_config=ScalingConfig(
+            num_workers=2, placement_strategy="PACK",
+        ),
+        backend_config=JaxConfig(
+            init_jax_distributed=True, local_device_count=4
+        ),
+        run_config=ray_tpu.train.RunConfig(
+            name="northstar",
+            storage_path=str(tmp_path),
+            failure_config=ray_tpu.train.FailureConfig(max_failures=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, f"north-star run failed: {result.error}"
+    assert result.metrics["step"] == 3
+    assert _os.path.exists(marker), "the injected kill never fired"
+    restored = result.checkpoint.to_dict()
+    assert restored["step"] == 3

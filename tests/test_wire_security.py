@@ -20,12 +20,13 @@ _HDR = struct.Struct(">HBBI")
 
 
 def _frame(kind, msg_id, method, payload):
-    body = pickle.dumps((msg_id, method, payload), protocol=5)
-    return _HDR.pack(0x5254, 2, kind, len(body)) + body
+    meta = pickle.dumps((msg_id, method, payload), protocol=5)
+    body = struct.pack(">I", len(meta)) + meta
+    return _HDR.pack(0x5254, 3, kind, len(body)) + body
 
 
 def _auth_frame(token_bytes):
-    return _HDR.pack(0x5254, 2, rpc_mod.AUTH, len(token_bytes)) + token_bytes
+    return _HDR.pack(0x5254, 3, rpc_mod.AUTH, len(token_bytes)) + token_bytes
 
 
 @pytest.fixture
@@ -41,9 +42,9 @@ def test_garbage_frames_do_not_crash_server(server):
     for garbage in (
         b"\x00" * 64,                      # zeros
         b"GET / HTTP/1.1\r\n\r\n",          # wrong protocol
-        _HDR.pack(0x5254, 2, 0, 2**31),     # huge declared length
+        _HDR.pack(0x5254, 3, 0, 2**31),     # huge declared length
         _HDR.pack(0xDEAD, 9, 0, 4) + b"abcd",  # bad magic/version
-        _HDR.pack(0x5254, 1, 0, 4) + b"abcd",  # stale wire version
+        _HDR.pack(0x5254, 2, 0, 4) + b"abcd",  # stale wire version
     ):
         s = socket.create_connection((host, port), timeout=5)
         s.sendall(garbage)
