@@ -984,6 +984,7 @@ class Raylet:
         from ray_tpu._private import rpc as rpc_mod
 
         done: Dict[int, Any] = {}
+        req_len: Dict[int, int] = {}  # offset -> bytes requested at it
         cv = threading.Condition()
 
         def make_cb(pos: int):
@@ -994,6 +995,10 @@ class Raylet:
 
             return cb
 
+        def send(offset: int, n: int):
+            req_len[offset] = n
+            client.call_async("store_fetch", (object_id, offset, n), make_cb(offset))
+
         next_send = 0
         next_write = 0
         while next_write < size:
@@ -1002,9 +1007,7 @@ class Raylet:
                 and next_send - next_write < window * self._PULL_CHUNK
             ):
                 n = min(self._PULL_CHUNK, size - next_send)
-                client.call_async(
-                    "store_fetch", (object_id, next_send, n), make_cb(next_send)
-                )
+                send(next_send, n)
                 next_send += n
             with cv:
                 deadline = time.monotonic() + 60.0
@@ -1019,17 +1022,14 @@ class Raylet:
                     raise payload
                 return False
             view[next_write : next_write + len(payload)] = payload
-            requested = min(self._PULL_CHUNK, size - next_write)
+            requested = req_len.pop(next_write)
             next_write += len(payload)
-            if len(payload) < requested and next_write < size:
-                # short read (metadata/size disagreement): re-request the
-                # gap — its key is exactly the new next_write, so the
-                # ordered wait above picks it up like any other chunk
-                client.call_async(
-                    "store_fetch",
-                    (object_id, next_write, requested - len(payload)),
-                    make_cb(next_write),
-                )
+            if len(payload) < requested:
+                # short read (metadata/size disagreement): re-request ONLY
+                # the remainder of THIS chunk — its key is exactly the new
+                # next_write, so the ordered wait picks it up next; ranges
+                # already in flight at higher offsets are untouched
+                send(next_write, requested - len(payload))
         return True
 
     def rpc_store_pull(self, conn, payload):

@@ -136,7 +136,18 @@ class LazyDataset:
         )
 
     def map_batches(self, fn, *, batch_size=None, batch_format="numpy",
-                    fn_kwargs=None, **_ignored) -> "LazyDataset":
+                    fn_kwargs=None, compute=None, fn_constructor=None,
+                    num_cpus=None, **_ignored) -> "LazyDataset":
+        if compute is not None or fn_constructor is not None or num_cpus is not None:
+            # actor-pool / custom-resource maps run on the eager engine
+            # (stateful per-actor fns don't fuse into the streamed chain):
+            # materialize the upstream, delegate, then come back lazy so
+            # downstream ops (random_shuffle!) keep their streaming forms
+            return self._ensure_materialized().map_batches(
+                fn, batch_size=batch_size, batch_format=batch_format,
+                fn_kwargs=fn_kwargs, compute=compute,
+                fn_constructor=fn_constructor, num_cpus=num_cpus,
+            ).lazy(max_in_flight_blocks=self._max_in_flight)
         return self._with_op(MapOp(fn, "batches", batch_size, batch_format,
                                    fn_kwargs, name="map_batches"))
 

@@ -82,21 +82,24 @@ class _ShuffleMerger:
         rng = np.random.default_rng(self._seed)
         perm = rng.permutation(merged.num_rows)
         merged = merged.take(perm)
+        # own each output block's buffers NOW (copy_block): raw slices would
+        # all share merged's backing buffers, so (a) nulling a served block
+        # would free nothing until the LAST one went, and (b) pickling a
+        # slice would serialize the whole partition per block. Transient
+        # peak here is 2x the partition; after this, the heap genuinely
+        # shrinks as the consumer drains.
         self._blocks = [
-            merged.slice(lo, min(target_block_rows, merged.num_rows - lo))
+            B.copy_block(
+                merged.slice(lo, min(target_block_rows, merged.num_rows - lo))
+            )
             for lo in range(0, merged.num_rows, target_block_rows)
         ]
         return len(self._blocks)
 
     def get_block(self, i: int) -> B.Block:
         blk = self._blocks[i]
-        # hand out and forget: the merger's heap shrinks as the consumer
-        # drains, keeping end-to-end memory bounded by what is in flight.
-        # copy_block trims the slice to owned buffers — pickling an arrow
-        # slice would otherwise serialize the WHOLE merged partition's
-        # backing buffers per output block
-        self._blocks[i] = None
-        return B.copy_block(blk)
+        self._blocks[i] = None  # heap shrinks as the consumer drains
+        return blk
 
 
 def streaming_shuffle_refs(
@@ -133,15 +136,23 @@ def streaming_shuffle_refs(
         counts = ray_tpu.get(
             [m.finish.remote(target_block_rows) for m in mergers], timeout=600
         )
-        for m, count in zip(mergers, counts):
-            for i in range(count):
-                ref = m.get_block.remote(i)
-                # wait for the block to EXIST before yielding: a consumer
-                # like materialize() collects refs without getting them, and
-                # the finally-kill below must not shoot an actor that still
-                # owes queued get_block results
-                ray_tpu.wait([ref], num_returns=1, timeout=None)
-                yield ref
+        # drain with one ref prefetched: the merger serves block i+1 while
+        # the consumer processes block i (no per-block actor RTT on the
+        # critical path). Each ref is waited to EXISTENCE before yielding:
+        # a consumer like materialize() collects refs without getting them,
+        # and the finally-kill below must not shoot an actor that still
+        # owes queued get_block results.
+        jobs = [(m, i) for m, count in zip(mergers, counts) for i in range(count)]
+        prefetched = None
+        for k, (m, i) in enumerate(jobs):
+            ref = prefetched if prefetched is not None else m.get_block.remote(i)
+            prefetched = (
+                jobs[k + 1][0].get_block.remote(jobs[k + 1][1])
+                if k + 1 < len(jobs)
+                else None
+            )
+            ray_tpu.wait([ref], num_returns=1, timeout=None)
+            yield ref
     finally:
         for m in mergers:
             try:

@@ -207,7 +207,9 @@ def make_eval_step(cfg: GPTConfig, mesh: Optional[Mesh] = None) -> Callable:
     @jax.jit
     def eval_step(params, tokens):
         hidden, kernel, bias = model.apply({"params": params}, tokens)
-        return blockwise_next_token_loss(hidden, kernel, bias, tokens)
+        return blockwise_next_token_loss(
+            hidden, kernel, bias, tokens, chunk=cfg.ce_chunk
+        )
 
     return eval_step
 

@@ -230,7 +230,7 @@ def make_pp_train_step(
         y = y * ln["scale"].astype(y.dtype) + ln["bias"].astype(y.dtype)
         head = params["lm_head"]
         return blockwise_next_token_loss(
-            y, head["kernel"], head["bias"], tokens
+            y, head["kernel"], head["bias"], tokens, chunk=cfg.ce_chunk
         )
 
     def loss_fn(params, tokens):

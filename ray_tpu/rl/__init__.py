@@ -8,8 +8,11 @@ functional step that data-parallelizes over a device mesh or across
 learner actors via the host collective layer.
 """
 
+from ray_tpu.rl.a2c import A2C, A2CConfig, A2CLearner
 from ray_tpu.rl.algorithm import PPO, PPOConfig
 from ray_tpu.rl.appo import APPO, APPOConfig, APPOLearner
+from ray_tpu.rl.cql import CQL, CQLConfig
+from ray_tpu.rl.es import ES, ESConfig, ESEvalWorker
 from ray_tpu.rl.bc import BC, BCConfig, MARWIL, MARWILConfig, monte_carlo_returns
 from ray_tpu.rl.connectors import (
     ClipActions,
@@ -40,9 +43,17 @@ from ray_tpu.rl.rollout_worker import RolloutWorker
 from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
+    "A2C",
+    "A2CConfig",
+    "A2CLearner",
     "APPO",
     "APPOConfig",
     "APPOLearner",
+    "CQL",
+    "CQLConfig",
+    "ES",
+    "ESConfig",
+    "ESEvalWorker",
     "BC",
     "BCConfig",
     "ClipActions",

@@ -1,0 +1,165 @@
+"""A2C: synchronous advantage actor-critic.
+
+Reference surface: rllib/algorithms/a2c/ (a2c.py: sync sampling +
+single-pass policy-gradient update on GAE advantages — PPO's machinery
+minus the clipped surrogate and the SGD epochs). Shares this package's
+rollout workers and GAE postprocessing; the learner is one jitted
+policy-gradient step per sampled batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.rl_module import DiscretePolicyModule
+from ray_tpu.rl.rollout_worker import RolloutWorker
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class A2CLearner:
+    def __init__(self, observation_size: int, num_actions: int, *,
+                 hidden: Sequence[int] = (64, 64), lr: float = 1e-3,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 grad_clip: float = 10.0, seed: int = 0):
+        self.net = DiscretePolicyModule(num_actions, tuple(hidden))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, observation_size), jnp.float32),
+        )["params"]
+        self.opt_state = self.optimizer.init(self.params)
+        net = self.net
+
+        def loss_fn(params, batch):
+            logits, values = net.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            policy_loss = -jnp.mean(logp * adv)
+            vf_loss = 0.5 * jnp.mean((batch["returns"] - values) ** 2)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = policy_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+            return total, {
+                "policy_loss": policy_loss,
+                "vf_loss": vf_loss,
+                "entropy": entropy,
+                "total_loss": total,
+            }
+
+        def step(params, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, metrics
+
+        self._step = jax.jit(step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.opt_state, jb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclasses.dataclass
+class A2CConfig:
+    env: str = "CartPole-v1"
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 4
+    rollout_fragment_length: int = 32
+    lr: float = 1e-3
+    gamma: float = 0.99
+    lam: float = 1.0      # A2C classically uses plain returns (lambda=1)
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "A2C":
+        return A2C(self)
+
+
+class A2C:
+    """Synchronous driver: sample from all workers, one update, broadcast."""
+
+    def __init__(self, config: A2CConfig):
+        self.config = config
+        probe = make_env(config.env)
+        module_config = {
+            "observation_size": probe.observation_size,
+            "num_actions": probe.num_actions,
+            "hidden": config.hidden,
+        }
+        self.workers = [
+            RolloutWorker.remote(
+                config.env,
+                num_envs=config.num_envs_per_worker,
+                seed=config.seed + 1000 * i,
+                module_config=module_config,
+                gamma=config.gamma,
+                lam=config.lam,
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self.learner = A2CLearner(
+            probe.observation_size, probe.num_actions,
+            hidden=config.hidden, lr=config.lr,
+            vf_coeff=config.vf_coeff, entropy_coeff=config.entropy_coeff,
+            seed=config.seed,
+        )
+        self._iteration = 0
+        self._env_steps = 0
+        self._broadcast()
+
+    def _broadcast(self):
+        w = self.learner.get_weights()
+        ray_tpu.get([x.set_weights.remote(w) for x in self.workers], timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        cfg = self.config
+        batches = ray_tpu.get(
+            [w.sample.remote(cfg.rollout_fragment_length) for w in self.workers],
+            timeout=300,
+        )
+        batch = SampleBatch.concat(batches)
+        metrics = self.learner.update(batch)
+        self._broadcast()
+        self._env_steps += len(batch)
+        returns: List[float] = []
+        for w in self.workers:
+            returns.extend(ray_tpu.get(w.episode_returns.remote(), timeout=60))
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._env_steps,
+            "episode_return_mean": float(np.mean(returns)) if returns else float("nan"),
+            "time_this_iter_s": time.perf_counter() - t0,
+            **metrics,
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

@@ -1,0 +1,199 @@
+"""CQL: conservative Q-learning for OFFLINE continuous control.
+
+Reference surface: rllib/algorithms/cql/ (cql.py: SAC trained from offline
+data with the conservative regularizer; cql_torch_policy.py: the
+logsumexp-over-sampled-actions penalty that pushes Q down on out-of-
+distribution actions and up on dataset actions). Reuses this package's SAC
+networks (GaussianPolicy/TwinQ) and the offline parquet datasets; the
+whole update — twin critics + CQL penalty, actor, temperature, polyak —
+is one jitted function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl import offline
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.replay_buffers import ReplayBuffer
+from ray_tpu.rl.sac import GaussianPolicy, TwinQ, _sample_action
+
+
+@dataclasses.dataclass
+class CQLConfig:
+    input_path: str = ""           # offline dataset (offline.write_sample_batches)
+    env: str = "Pendulum-v1"       # for action bounds / eval
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005
+    batch_size: int = 256
+    cql_alpha: float = 1.0         # conservative penalty weight
+    cql_num_actions: int = 4       # sampled actions for the logsumexp
+    fixed_alpha: float = 0.2       # SAC temperature (fixed for offline)
+    hidden: tuple = (128, 128)
+    seed: int = 0
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.scale = float(probe.action_high)
+        buf = offline.load_replay_buffer(config.input_path)
+        if len(buf) < config.batch_size:
+            raise ValueError(
+                f"offline dataset has {len(buf)} transitions < batch_size"
+            )
+        self.buffer: ReplayBuffer = buf
+        self.policy = GaussianPolicy(probe.action_size, tuple(config.hidden))
+        self.qnet = TwinQ(tuple(config.hidden))
+        rng = jax.random.PRNGKey(config.seed)
+        obs0 = jnp.zeros((1, probe.observation_size), jnp.float32)
+        act0 = jnp.zeros((1, probe.action_size), jnp.float32)
+        self.pi_params = self.policy.init(rng, obs0)["params"]
+        self.q_params = self.qnet.init(rng, obs0, act0)["params"]
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.pi_opt = optax.adam(config.lr)
+        self.q_opt = optax.adam(config.lr)
+        self.pi_opt_state = self.pi_opt.init(self.pi_params)
+        self.q_opt_state = self.q_opt.init(self.q_params)
+        self._rng = jax.random.PRNGKey(config.seed + 13)
+        self._iteration = 0
+        self._updates = 0
+        self._update = self._build_update()
+
+    def _build_update(self):
+        policy, qnet = self.policy, self.qnet
+        cfg = self.config
+        scale = self.scale
+
+        def q_batched(qp, obs, acts):
+            """Q over [B, N, A] candidate actions -> [B, N] (min of twins)."""
+            b, n, a = acts.shape
+            obs_rep = jnp.repeat(obs[:, None, :], n, axis=1).reshape(b * n, -1)
+            q1, q2 = qnet.apply({"params": qp}, obs_rep, acts.reshape(b * n, a))
+            return jnp.minimum(q1, q2).reshape(b, n)
+
+        def update(pi_p, q_p, q_t, pi_os, q_os, batch, rng):
+            alpha = jnp.asarray(cfg.fixed_alpha)
+            r1, r2, r3, r4 = jax.random.split(rng, 4)
+            b = batch["obs"].shape[0]
+            a_dim = batch["actions"].shape[-1]
+
+            # -- SAC critic target -----------------------------------------
+            next_a, next_logp = _sample_action(
+                policy, pi_p, batch["next_obs"], r1, scale
+            )
+            tq1, tq2 = qnet.apply({"params": q_t}, batch["next_obs"], next_a)
+            target_q = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * (
+                jnp.minimum(tq1, tq2) - alpha * next_logp
+            )
+            target_q = jax.lax.stop_gradient(target_q)
+
+            # candidate actions for the conservative penalty: uniform random
+            # + current-policy samples (cql_torch_policy.py's action set)
+            rand_a = jax.random.uniform(
+                r2, (b, cfg.cql_num_actions, a_dim), minval=-scale, maxval=scale
+            )
+            pol_a, _ = _sample_action(
+                policy, pi_p,
+                jnp.repeat(batch["obs"], cfg.cql_num_actions, axis=0),
+                r3, scale,
+            )
+            pol_a = pol_a.reshape(b, cfg.cql_num_actions, a_dim)
+
+            def q_loss_fn(qp):
+                q1, q2 = qnet.apply({"params": qp}, batch["obs"], batch["actions"])
+                bellman = ((q1 - target_q) ** 2 + (q2 - target_q) ** 2).mean()
+                # conservative term: logsumexp over OOD actions minus the
+                # dataset action's Q — penalizes optimistic extrapolation
+                cand = jnp.concatenate([rand_a, pol_a], axis=1)  # [B, 2N, A]
+                q_ood = q_batched(qp, batch["obs"], cand)
+                penalty = (
+                    jax.nn.logsumexp(q_ood, axis=1) - jnp.minimum(q1, q2)
+                ).mean()
+                return bellman + cfg.cql_alpha * penalty, (bellman, penalty)
+
+            (q_loss, (bellman, penalty)), q_grads = jax.value_and_grad(
+                q_loss_fn, has_aux=True
+            )(q_p)
+            q_upd, q_os = self.q_opt.update(q_grads, q_os)
+            q_p = optax.apply_updates(q_p, q_upd)
+
+            # -- actor (standard SAC objective on dataset states) ----------
+            def pi_loss_fn(pp):
+                a, logp = _sample_action(policy, pp, batch["obs"], r4, scale)
+                q1, q2 = qnet.apply({"params": q_p}, batch["obs"], a)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean()
+
+            pi_loss, pi_grads = jax.value_and_grad(pi_loss_fn)(pi_p)
+            pi_upd, pi_os = self.pi_opt.update(pi_grads, pi_os)
+            pi_p = optax.apply_updates(pi_p, pi_upd)
+
+            q_t = jax.tree.map(
+                lambda t, o: (1 - cfg.tau) * t + cfg.tau * o, q_t, q_p
+            )
+            metrics = {
+                "q_loss": q_loss,
+                "bellman_loss": bellman,
+                "cql_penalty": penalty,
+                "pi_loss": pi_loss,
+            }
+            return pi_p, q_p, q_t, pi_os, q_os, metrics
+
+        return jax.jit(update)
+
+    def train(self, num_updates: int = 64) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        metrics: Dict[str, Any] = {}
+        for _ in range(num_updates):
+            batch = self.buffer.sample(self.config.batch_size)
+            self._rng, sub = jax.random.split(self._rng)
+            (
+                self.pi_params, self.q_params, self.q_target,
+                self.pi_opt_state, self.q_opt_state, metrics,
+            ) = self._update(
+                self.pi_params, self.q_params, self.q_target,
+                self.pi_opt_state, self.q_opt_state,
+                {k: jnp.asarray(v) for k, v in batch.items()},
+                sub,
+            )
+            self._updates += 1
+        self._iteration += 1
+        out = {
+            "training_iteration": self._iteration,
+            "num_updates": self._updates,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    def evaluate(self, episodes: int = 4, seed: int = 0) -> float:
+        """Mean-action rollout return of the learned policy."""
+        policy, params, scale = self.policy, self.pi_params, self.scale
+        act = jax.jit(
+            lambda o: jnp.tanh(policy.apply({"params": params}, o[None])[0][0])
+            * scale
+        )
+        total = 0.0
+        for ep in range(episodes):
+            env = make_env(self.config.env)
+            obs, _ = env.reset(seed=seed + ep)
+            done = False
+            while not done:
+                obs, r, term, trunc, _ = env.step(
+                    np.asarray(act(jnp.asarray(obs, jnp.float32)))
+                )
+                total += r
+                done = term or trunc
+        return total / episodes

@@ -1,0 +1,165 @@
+"""ES: evolution strategies (OpenAI-ES) — gradient-free policy search.
+
+Reference surface: rllib/algorithms/es/ (es.py: perturbation sampling with
+shared noise table, rank-normalized fitness, mirrored sampling; rollout
+workers evaluate perturbed policies). TPU-framework shape: perturbations
+are generated from SEEDS (an int crosses the wire, not a parameter vector
+— the reference's shared-noise-table trick in spirit), episode evaluation
+fans out over CPU rollout actors, and the update is a single weighted sum
+of perturbations applied driver-side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.rl_module import DiscretePolicyModule
+
+
+def _flatten_params(params) -> Tuple[np.ndarray, Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    flat = np.concatenate([np.asarray(x).ravel() for x in leaves])
+    shapes = [np.asarray(x).shape for x in leaves]
+    return flat, (treedef, shapes)
+
+
+def _unflatten_params(flat: np.ndarray, spec) -> Any:
+    treedef, shapes = spec
+    leaves, pos = [], 0
+    for shp in shapes:
+        n = int(np.prod(shp)) if shp else 1
+        leaves.append(flat[pos : pos + n].reshape(shp).astype(np.float32))
+        pos += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@ray_tpu.remote
+class ESEvalWorker:
+    """Evaluates perturbed policies: receives (base_version, seed, sign),
+    regenerates the perturbation locally from the seed, runs one episode."""
+
+    def __init__(self, env_name: str, hidden: Tuple[int, ...], seed: int):
+        self.env_name = env_name
+        probe = make_env(env_name)
+        self.net = DiscretePolicyModule(probe.num_actions, tuple(hidden))
+        params = self.net.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, probe.observation_size), jnp.float32),
+        )["params"]
+        self.flat, self.spec = _flatten_params(params)
+        self._act = jax.jit(
+            lambda p, o: jnp.argmax(self.net.apply({"params": p}, o[None])[0], -1)[0]
+        )
+        self._episode_seed = seed
+
+    def set_flat(self, flat: np.ndarray) -> bool:
+        self.flat = np.asarray(flat, np.float64)
+        return True
+
+    def evaluate(self, noise_seed: int, sign: float, sigma: float,
+                 episodes: int = 1) -> float:
+        rng = np.random.default_rng(noise_seed)
+        eps = rng.standard_normal(self.flat.shape[0])
+        params = _unflatten_params(self.flat + sign * sigma * eps, self.spec)
+        total = 0.0
+        for ep in range(episodes):
+            env = make_env(self.env_name)
+            obs, _ = env.reset(seed=self._episode_seed + noise_seed + ep)
+            done = False
+            while not done:
+                a = int(self._act(params, jnp.asarray(obs, jnp.float32)))
+                obs, r, term, trunc, _ = env.step(a)
+                total += r
+                done = term or trunc
+        return total / episodes
+
+
+@dataclasses.dataclass
+class ESConfig:
+    env: str = "CartPole-v1"
+    num_workers: int = 4
+    population: int = 16       # perturbation PAIRS per iteration (mirrored)
+    sigma: float = 0.05
+    lr: float = 0.05
+    episodes_per_eval: int = 1
+    hidden: tuple = (32, 32)
+    seed: int = 0
+
+    def build(self) -> "ES":
+        return ES(self)
+
+
+class ES:
+    def __init__(self, config: ESConfig):
+        self.config = config
+        probe = make_env(config.env)
+        net = DiscretePolicyModule(probe.num_actions, tuple(config.hidden))
+        params = net.init(
+            jax.random.PRNGKey(config.seed),
+            jnp.zeros((1, probe.observation_size), jnp.float32),
+        )["params"]
+        self.flat, self.spec = _flatten_params(params)
+        self.flat = self.flat.astype(np.float64)
+        self.workers = [
+            ESEvalWorker.remote(config.env, tuple(config.hidden),
+                                config.seed + 7919 * i)
+            for i in range(config.num_workers)
+        ]
+        self._rng = np.random.default_rng(config.seed)
+        self._iteration = 0
+        self._episodes = 0
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        cfg = self.config
+        ray_tpu.get(
+            [w.set_flat.remote(self.flat) for w in self.workers], timeout=120
+        )
+        seeds = [int(s) for s in self._rng.integers(0, 2**31, cfg.population)]
+        # mirrored sampling: each seed evaluated at +sigma and -sigma
+        refs = []
+        jobs = [(s, sign) for s in seeds for sign in (+1.0, -1.0)]
+        for i, (s, sign) in enumerate(jobs):
+            w = self.workers[i % len(self.workers)]
+            refs.append(w.evaluate.remote(s, sign, cfg.sigma, cfg.episodes_per_eval))
+        fitness = np.array(ray_tpu.get(refs, timeout=600), np.float64)
+        self._episodes += len(jobs) * cfg.episodes_per_eval
+        # rank normalization (reference: es.py compute_centered_ranks)
+        all_f = fitness
+        ranks = np.empty_like(all_f)
+        ranks[np.argsort(all_f)] = np.arange(len(all_f))
+        centered = (ranks / (len(all_f) - 1) - 0.5).reshape(-1, 2)
+        weights = centered[:, 0] - centered[:, 1]  # f(+) rank minus f(-) rank
+        grad = np.zeros_like(self.flat)
+        for s, wgt in zip(seeds, weights):
+            eps = np.random.default_rng(s).standard_normal(self.flat.shape[0])
+            grad += wgt * eps
+        grad /= cfg.population * cfg.sigma
+        self.flat += cfg.lr * grad
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episodes_total": self._episodes,
+            "episode_return_mean": float(fitness.mean()),
+            "episode_return_max": float(fitness.max()),
+            "grad_norm": float(np.linalg.norm(grad)),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def get_flat_weights(self) -> np.ndarray:
+        return self.flat.copy()
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

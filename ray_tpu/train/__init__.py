@@ -4,6 +4,7 @@
 """
 
 from ray_tpu.train.backend_executor import BackendExecutor, JaxConfig, TrainingFailedError
+from ray_tpu.train.batch_predictor import BatchPredictor, Predictor
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.config import (
@@ -27,6 +28,8 @@ from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 
 __all__ = [
+    "BatchPredictor",
+    "Predictor",
     "BackendExecutor",
     "BaseTrainer",
     "Checkpoint",

@@ -1,0 +1,106 @@
+"""BatchPredictor: checkpoint -> parallel batch inference over a Dataset.
+
+Reference surface: python/ray/train/batch_predictor.py (BatchPredictor
+.from_checkpoint / .predict running a Predictor on an actor pool via
+Dataset.map_batches) and python/ray/train/predictor.py (the Predictor ABC).
+TPU-first shape: the predictor's model loads ONCE per pool actor (weights
+come out of the checkpoint through the object store, not per-batch), and
+predictions run as jitted functions over numpy-dict batches — bucketed
+static shapes are the caller's choice via batch_size.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Type
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class Predictor:
+    """Per-actor inference wrapper (reference: train/predictor.py).
+
+    Subclasses implement ``__init__(checkpoint, **kwargs)`` (load the model
+    once) and ``predict_batch(batch) -> batch``.
+    """
+
+    def __init__(self, checkpoint: Checkpoint, **kwargs: Any):
+        self.checkpoint = checkpoint
+
+    def predict_batch(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        return cls(checkpoint, **kwargs)
+
+
+class BatchPredictor:
+    """Distributed batch inference: one Predictor per pool actor.
+
+    ``predict`` maps the dataset through an actor pool; each actor
+    constructs the predictor from the (object-store-shipped) checkpoint
+    exactly once and reuses it for every batch it serves.
+    """
+
+    def __init__(
+        self,
+        checkpoint: Checkpoint,
+        predictor_cls: Type[Predictor],
+        **predictor_kwargs: Any,
+    ):
+        self.checkpoint = checkpoint
+        self.predictor_cls = predictor_cls
+        self.predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(
+        cls, checkpoint: Checkpoint, predictor_cls: Type[Predictor], **kw
+    ) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **kw)
+
+    def predict(
+        self,
+        dataset,
+        *,
+        batch_size: Optional[int] = 1024,
+        num_actors: int = 2,
+        max_tasks_in_flight_per_actor: int = 2,
+        feature_columns: Optional[list] = None,
+        keep_columns: Optional[list] = None,
+    ):
+        """Run inference over every batch; returns a Dataset of predictions.
+
+        ``feature_columns`` restricts the predictor's input view;
+        ``keep_columns`` carries passthrough columns (e.g. ids) into the
+        output alongside the predictions."""
+        from ray_tpu.data.dataset import ActorPoolStrategy
+
+        ckpt = self.checkpoint
+        predictor_cls = self.predictor_cls
+        predictor_kwargs = self.predictor_kwargs
+        features = list(feature_columns) if feature_columns else None
+        keep = list(keep_columns) if keep_columns else []
+
+        def _make_fn():
+            p = predictor_cls.from_checkpoint(ckpt, **predictor_kwargs)
+
+            def _predict(batch, **_):
+                view = (
+                    {k: batch[k] for k in features} if features else dict(batch)
+                )
+                out = p.predict_batch(view)
+                for k in keep:
+                    out.setdefault(k, batch[k])
+                return out
+
+            return _predict
+
+        return dataset.map_batches(
+            None,
+            batch_size=batch_size,
+            compute=ActorPoolStrategy(
+                size=num_actors,
+                max_tasks_in_flight_per_actor=max_tasks_in_flight_per_actor,
+            ),
+            fn_constructor=_make_fn,
+        )

@@ -321,3 +321,39 @@ def test_north_star_pp_fsdp_tp_gang_failure_resume(ray_start_regular, tmp_path):
     assert _os.path.exists(marker), "the injected kill never fired"
     restored = result.checkpoint.to_dict()
     assert restored["step"] == 3
+
+
+def test_batch_predictor(ray_start_regular, tmp_path):
+    """Checkpoint -> BatchPredictor.predict over a Dataset via an actor
+    pool; model loads once per actor (reference: train/batch_predictor.py)."""
+    import numpy as np
+
+    from ray_tpu import data as rd
+    from ray_tpu.train import BatchPredictor, Predictor
+
+    class LinearPredictor(Predictor):
+        def __init__(self, checkpoint, scale=1.0):
+            super().__init__(checkpoint)
+            payload = checkpoint.to_dict()
+            self.w = payload["w"]
+            self.b = payload["b"]
+            self.scale = scale
+            self.loads = payload  # constructed once per actor
+
+        def predict_batch(self, batch):
+            x = batch["x"].astype(np.float64)
+            return {"pred": (x * self.w + self.b) * self.scale}
+
+    ck = Checkpoint.from_dict({"w": 3.0, "b": 1.0})
+    predictor = BatchPredictor.from_checkpoint(ck, LinearPredictor, scale=2.0)
+    ds = rd.range(1000, parallelism=4).map_batches(
+        lambda b, **_: {"x": b["id"], "key": b["id"]}
+    )
+    out = predictor.predict(
+        ds, batch_size=100, num_actors=2,
+        feature_columns=["x"], keep_columns=["key"],
+    )
+    rows = out.take(1000)
+    assert len(rows) == 1000
+    for r in rows[:10]:
+        assert r["pred"] == (r["key"] * 3.0 + 1.0) * 2.0
