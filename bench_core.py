@@ -200,7 +200,7 @@ def main():
 
     # archive as a round artifact (reference archives its microbenchmark
     # results under release/release_logs/<version>/microbenchmark.json)
-    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r03.json")
+    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r04.json")
     payload = {
         "results": {k: round(v, 2) for k, v in results.items()},
         "vs_baseline": {

@@ -32,6 +32,7 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>ray_tpu dashboard <span id="updated"></span></h1>
 <div id="cluster"></div>
+<h2>History (30 min)</h2><div id="charts"></div>
 <h2>Nodes</h2><div id="nodes"></div>
 <h2>Actors</h2><div id="actors"></div>
 <h2>Jobs</h2><div id="jobs"></div>
@@ -53,12 +54,31 @@ function table(rows, cols){
   }
   return h+'</table>';
 }
+function spark(hist, key, label, color){
+  if(!hist.length) return '';
+  const vals = hist.map(h=>h[key]||0);
+  const max = Math.max(...vals, 1), w = 240, h = 48;
+  const pts = vals.map((v,i)=>
+    `${(i/(vals.length-1||1)*w).toFixed(1)},${(h - v/max*h).toFixed(1)}`).join(' ');
+  return `<span style="display:inline-block;margin-right:1.2rem">
+    <div style="font-size:.75rem;color:#555">${label}
+      (now ${vals[vals.length-1]}, max ${max})</div>
+    <svg width="${w}" height="${h}" style="background:#fff;border:1px solid #ddd">
+      <polyline fill="none" stroke="${color}" stroke-width="1.5" points="${pts}"/>
+    </svg></span>`;
+}
 async function refresh(){
   const get = async p => (await fetch(p)).json();
   try{
-    const [cluster,nodes,actors,jobs,summary,pgs] = await Promise.all([
+    const [cluster,nodes,actors,jobs,summary,pgs,hist] = await Promise.all([
       get('/api/cluster'), get('/api/nodes'), get('/api/actors'),
-      get('/api/jobs'), get('/api/summary'), get('/api/placement_groups')]);
+      get('/api/jobs'), get('/api/summary'), get('/api/placement_groups'),
+      get('/api/metrics_history')]);
+    document.getElementById('charts').innerHTML =
+      spark(hist,'cpu_used','CPU in use','#2563eb') +
+      spark(hist,'running_tasks','running tasks','#0a7d2c') +
+      spark(hist,'live_actors','live actors','#9333ea') +
+      spark(hist,'alive_nodes','alive nodes','#c0232c');
     document.getElementById('cluster').innerHTML = table([cluster]);
     document.getElementById('nodes').innerHTML = table(nodes,
       ['node_id','address','alive','resources','available','demand']);
@@ -128,15 +148,62 @@ class DashboardServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                try:
+                    status, body = outer._route_post(self.path, raw)
+                except Exception as e:  # noqa: BLE001
+                    status, body = 500, json.dumps({"error": str(e)}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+        # metrics timeseries: sample cluster-level gauges into a ring buffer
+        # (reference: dashboard/modules/metrics/ ships Grafana dashboards;
+        # here the history endpoint + inline charts fill that role).
+        # Initialized BEFORE the http thread starts: a poller already
+        # hammering the well-known port must not race construction.
+        import collections
+
+        self._history: "collections.deque" = collections.deque(maxlen=360)
+        self._stopped = threading.Event()
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="dashboard", daemon=True
         )
         self._thread.start()
+        self._sampler = threading.Thread(
+            target=self._sample_loop, name="dashboard-metrics", daemon=True
+        )
+        self._sampler.start()
 
     @property
     def address(self) -> Tuple[str, int]:
         return self._httpd.server_address
+
+    def _route_post(self, path: str, raw: bytes):
+        """POST endpoints. /api/workflows/events is the HTTP event provider
+        (reference: workflow/http_event_provider.py): external systems
+        deliver {"key": ..., "payload": ...} and any workflow step waiting
+        on that key via KVEventListener resolves."""
+        import pickle as _pickle
+
+        if path.split("?", 1)[0] == "/api/workflows/events":
+            body = json.loads(raw or b"{}")
+            key = body.get("key")
+            if not key or not isinstance(key, str):
+                return 400, b'{"error": "missing event key"}'
+            from ray_tpu.workflow.events import _EVENT_NS
+
+            self._state._gcs_call(
+                "kv_put",
+                (_EVENT_NS, key, _pickle.dumps(body.get("payload")), True),
+                address=self.gcs_address,
+            )
+            return 200, b'{"ok": true}'
+        return 404, b'{"error": "not found"}'
 
     def _route(self, path: str):
         a = self.gcs_address
@@ -160,11 +227,22 @@ class DashboardServer:
             "/api/summary": lambda: s.summarize_tasks(address=a),
             "/api/cluster": lambda: self._cluster_overview(),
         }
-        if path.split("?", 1)[0] == "/api/profile":
+        base, _, query = path.partition("?")
+        if base == "/api/metrics_history":
+            return (
+                json.dumps(list(self._history)).encode(),
+                "application/json",
+            )
+        if base == "/api/task":
+            return (
+                json.dumps(_to_jsonable(self._task_detail(query))).encode(),
+                "application/json",
+            )
+        if base == "/api/profile":
             # /api/profile?actor=<hex>&duration=2 -> folded stacks
             from urllib.parse import parse_qs
 
-            q = parse_qs(path.split("?", 1)[1] if "?" in path else "")
+            q = parse_qs(query)
             actor = (q.get("actor") or [""])[0]
             duration = float((q.get("duration") or ["2.0"])[0])
             prof = s.profile_actor(
@@ -174,13 +252,70 @@ class DashboardServer:
                 json.dumps(_to_jsonable(prof)).encode(),
                 "application/json",
             )
-        fn = routes.get(path.split("?", 1)[0])
+        fn = routes.get(base)
         if fn is None:
             return None, ""
         return (
             json.dumps(_to_jsonable(fn())).encode(),
             "application/json",
         )
+
+    def _sample_loop(self, period_s: float = 5.0):
+        import time as _time
+
+        while not self._stopped.wait(period_s):
+            try:
+                ov = self._cluster_overview()
+                summary = self._state.summarize_tasks(address=self.gcs_address)
+                running = sum(s.get("RUNNING", 0) for s in summary.values())
+                finished = sum(s.get("FINISHED", 0) for s in summary.values())
+                actors = len(
+                    [
+                        a
+                        for a in self._state.list_actors(address=self.gcs_address)
+                        if a.get("state") in ("ALIVE", "RESTARTING")
+                    ]
+                )
+                cpu_total = ov["total_resources"].get("CPU", 0.0)
+                cpu_avail = ov["available_resources"].get("CPU", 0.0)
+                self._history.append(
+                    {
+                        "ts": _time.time(),
+                        "alive_nodes": ov["alive_nodes"],
+                        "cpu_used": cpu_total - cpu_avail,
+                        "cpu_total": cpu_total,
+                        "running_tasks": running,
+                        "finished_tasks": finished,
+                        "live_actors": actors,
+                    }
+                )
+            except Exception:
+                pass  # cluster mid-teardown: skip the tick
+
+    def _task_detail(self, query: str):
+        """Per-task drill-down (reference: dashboard state API task page):
+        full lifecycle events + the task's latest state row."""
+        from urllib.parse import parse_qs
+
+        tid = (parse_qs(query).get("id") or [""])[0]
+        if not tid:
+            return {"error": "missing ?id=<task id hex>"}
+        events = [
+            e
+            for e in self._state._gcs_call(
+                "get_task_events", address=self.gcs_address
+            )
+            if e["task_id"].startswith(tid)
+        ]
+        rows = [
+            t
+            for t in self._state.list_tasks(address=self.gcs_address, detail=True)
+            if t["task_id"].startswith(tid)
+        ]
+        return {
+            "task": rows[0] if rows else None,
+            "events": sorted(events, key=lambda e: e["ts"]),
+        }
 
     def _cluster_overview(self):
         nodes = self._state.list_nodes(address=self.gcs_address)
@@ -201,5 +336,6 @@ class DashboardServer:
         }
 
     def stop(self):
+        self._stopped.set()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -9,6 +9,14 @@ learner actors via the host collective layer.
 """
 
 from ray_tpu.rl.a2c import A2C, A2CConfig, A2CLearner
+from ray_tpu.rl.catalog import (
+    AttentionEncoder,
+    CatalogPolicy,
+    LSTMEncoder,
+    MLPEncoder,
+    ModelConfig,
+    get_model,
+)
 from ray_tpu.rl.algorithm import PPO, PPOConfig
 from ray_tpu.rl.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rl.cql import CQL, CQLConfig
@@ -44,6 +52,12 @@ from ray_tpu.rl.sample_batch import SampleBatch, compute_gae
 
 __all__ = [
     "A2C",
+    "AttentionEncoder",
+    "CatalogPolicy",
+    "LSTMEncoder",
+    "MLPEncoder",
+    "ModelConfig",
+    "get_model",
     "A2CConfig",
     "A2CLearner",
     "APPO",

@@ -35,6 +35,9 @@ import ray_tpu
 __all__ = [
     "StepFunction",
     "DagNode",
+    "EventListener",
+    "KVEventListener",
+    "TimerListener",
     "step",
     "run",
     "resume",
@@ -42,6 +45,8 @@ __all__ = [
     "get_output",
     "list_all",
     "delete",
+    "send_event",
+    "wait_for_event",
 ]
 
 _DEFAULT_STORAGE = os.environ.get(
@@ -279,3 +284,13 @@ def list_all(*, storage: Optional[str] = None) -> List[Tuple[str, str]]:
 def delete(workflow_id: str, *, storage: Optional[str] = None):
     shutil.rmtree(os.path.join(storage or _DEFAULT_STORAGE, workflow_id),
                   ignore_errors=True)
+
+
+# events build on `step` above (imported at the bottom to avoid a cycle)
+from ray_tpu.workflow.events import (  # noqa: E402
+    EventListener,
+    KVEventListener,
+    TimerListener,
+    send_event,
+    wait_for_event,
+)

@@ -1,0 +1,110 @@
+"""Workflow events: steps that WAIT on external signals, exactly-once.
+
+Reference surface: python/ray/workflow/event_listener.py (EventListener
+ABC + TimerListener) and python/ray/workflow/http_event_provider.py
+(external systems deliver events over HTTP; workflows block on them).
+TPU-framework shape: the rendezvous is the GCS KV (cluster-durable,
+already replicated into GCS persistence), `send_event` is callable from
+any process or over the dashboard's HTTP API, and `wait_for_event`
+returns a normal DAG node — so the received event value checkpoints
+exactly-once with the step machinery: a workflow that crashes after the
+event arrived replays the checkpoint on resume instead of waiting again.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Callable, Optional
+
+_EVENT_NS = "workflow_events"
+
+
+class EventListener:
+    """Poll-based external-event source (reference: event_listener.py:
+    ``poll_for_event`` blocks until the event is available)."""
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires once wall-clock time reaches ``fire_at`` (unix seconds)."""
+
+    def __init__(self, fire_at: float):
+        self.fire_at = float(fire_at)
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        delay = self.fire_at - time.time()
+        if timeout is not None and delay > timeout:
+            raise TimeoutError(f"timer fires in {delay:.1f}s > timeout")
+        if delay > 0:
+            time.sleep(delay)
+        return self.fire_at
+
+
+class KVEventListener(EventListener):
+    """Waits for ``send_event(key, payload)`` from anywhere in (or outside)
+    the cluster — the HTTP event provider's delivery target.
+
+    ``consume=True`` (default) deletes the KV entry once received: keys are
+    one-shot, so a later workflow reusing the name waits for a FRESH event
+    instead of resolving on a stale payload, and consumed events don't
+    accumulate in GCS persistence. The workflow step checkpoint preserves
+    exactly-once for THIS workflow regardless (resume replays the
+    checkpointed value, never re-polls)."""
+
+    def __init__(self, key: str, poll_interval_s: float = 0.2,
+                 consume: bool = True):
+        self.key = key
+        self.poll_interval_s = poll_interval_s
+        self.consume = consume
+
+    def poll_for_event(self, timeout: Optional[float] = None) -> Any:
+        from ray_tpu._private.worker import get_global_worker
+
+        gcs = get_global_worker().core.gcs
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            raw = gcs.call("kv_get", (_EVENT_NS, self.key))
+            if raw is not None:
+                if self.consume:
+                    gcs.call("kv_del", (_EVENT_NS, self.key))
+                return pickle.loads(raw)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"no event on {self.key!r} within {timeout}s")
+            time.sleep(self.poll_interval_s)
+
+
+def send_event(key: str, payload: Any = None) -> None:
+    """Deliver an event: every current or future listener on ``key`` sees it."""
+    from ray_tpu._private.worker import get_global_worker
+
+    gcs = get_global_worker().core.gcs
+    gcs.call("kv_put", (_EVENT_NS, key, pickle.dumps(payload), True))
+
+
+def wait_for_event(
+    event_listener: Any,
+    *listener_args: Any,
+    name: Optional[str] = None,
+    **listener_kwargs: Any,
+):
+    """A DAG node that resolves when the listener's event arrives.
+
+    Accepts an EventListener INSTANCE or a listener class plus constructor
+    args (the reference's ``workflow.wait_for_event(Listener, *args)``
+    shape). The event value is persisted by the step checkpoint, so resume
+    never re-waits for an already-received event (exactly-once)."""
+    from ray_tpu.workflow import step
+
+    def _wait():
+        listener = (
+            event_listener
+            if isinstance(event_listener, EventListener)
+            else event_listener(*listener_args, **listener_kwargs)
+        )
+        return listener.poll_for_event()
+
+    _wait.__name__ = name or "wait_for_event"
+    return step(_wait).bind()

@@ -125,3 +125,39 @@ def test_dashboard_unknown_route_404(dashboard_cluster):
         assert False, "expected 404"
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_metrics_history_and_task_drilldown(dashboard_cluster):
+    """r4 depth: the sampler ring buffer serves /api/metrics_history and
+    /api/task?id= gives a per-task event drill-down (reference:
+    dashboard/modules/metrics + the task state page)."""
+    import json as _json
+    import time as _t
+    import urllib.request
+
+    base = dashboard_cluster
+
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)], timeout=60)
+    _t.sleep(6.5)  # one sampler tick
+
+    hist = _json.loads(
+        urllib.request.urlopen(f"{base}/api/metrics_history", timeout=10).read()
+    )
+    assert hist and {"ts", "cpu_used", "running_tasks", "live_actors"} <= set(hist[0])
+
+    tasks = _json.loads(
+        urllib.request.urlopen(f"{base}/api/tasks", timeout=10).read()
+    )
+    target = next(t for t in tasks if t["name"] == "traced")
+    detail = _json.loads(
+        urllib.request.urlopen(
+            f"{base}/api/task?id={target['task_id']}", timeout=10
+        ).read()
+    )
+    assert detail["task"]["task_id"] == target["task_id"]
+    states = [e["state"] for e in detail["events"]]
+    assert "FINISHED" in states

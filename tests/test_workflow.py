@@ -5,6 +5,7 @@ after killing it and assert completed steps don't re-execute)
 """
 
 import os
+import time
 
 import pytest
 
@@ -121,3 +122,75 @@ def test_delete(ray_start_regular, tmp_path):
     workflow.run(one.bind(), workflow_id="w_del", storage=str(tmp_path))
     workflow.delete("w_del", storage=str(tmp_path))
     assert workflow.list_all(storage=str(tmp_path)) == []
+
+
+def test_workflow_timer_event(ray_start_regular, tmp_path):
+    """A step that waits on a TimerListener resolves once the deadline
+    passes and its event value checkpoints (reference: event_listener.py)."""
+    import time as _t
+
+    from ray_tpu import workflow
+    from ray_tpu.workflow import TimerListener, wait_for_event
+
+    fire_at = _t.time() + 0.5
+
+    @workflow.step
+    def after(ts):
+        return ("fired", ts)
+
+    dag = after.bind(wait_for_event(TimerListener, fire_at))
+    out = workflow.run(dag, workflow_id="timer-wf", storage=str(tmp_path))
+    assert out[0] == "fired" and abs(out[1] - fire_at) < 1e-6
+
+
+def test_workflow_kv_event_and_http_provider(ray_start_regular, tmp_path):
+    """A workflow blocks on a KV event; an external HTTP POST through the
+    dashboard delivers it (reference: http_event_provider.py). The received
+    event is checkpointed: resume returns it without re-waiting."""
+    import json
+    import threading
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import workflow
+    from ray_tpu.dashboard import DashboardServer as Dashboard
+    from ray_tpu.workflow import KVEventListener, wait_for_event
+
+    from ray_tpu._private.worker import global_worker
+    gcs_addr = "%s:%d" % global_worker.core.gcs.address
+    dash = Dashboard(gcs_addr, port=0)
+    try:
+        @workflow.step
+        def use(ev):
+            return {"got": ev}
+
+        dag = use.bind(wait_for_event(KVEventListener, "approval-1"))
+        result_box = {}
+
+        def run_wf():
+            result_box["out"] = workflow.run(
+                dag, workflow_id="ev-wf", storage=str(tmp_path)
+            )
+
+        t = threading.Thread(target=run_wf, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert t.is_alive(), "workflow should be blocked on the event"
+        host, port = dash.address
+        req = urllib.request.Request(
+            f"http://{host}:{port}/api/workflows/events",
+            data=json.dumps({"key": "approval-1", "payload": {"user": "alice"}}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["ok"] is True
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert result_box["out"] == {"got": {"user": "alice"}}
+        # exactly-once: resume replays the checkpointed event
+        assert workflow.resume("ev-wf", storage=str(tmp_path)) == {
+            "got": {"user": "alice"}
+        }
+    finally:
+        dash.stop()
