@@ -197,11 +197,15 @@ class DashboardServer:
                 return 400, b'{"error": "missing event key"}'
             from ray_tpu.workflow.events import _EVENT_NS
 
-            self._state._gcs_call(
+            delivered = self._state._gcs_call(
                 "kv_put",
-                (_EVENT_NS, key, _pickle.dumps(body.get("payload")), True),
+                (_EVENT_NS, key, _pickle.dumps(body.get("payload")), False),
                 address=self.gcs_address,
             )
+            if not delivered:
+                # single-slot mailbox still holds an un-consumed event:
+                # reject rather than silently replacing it
+                return 409, b'{"error": "event slot full (unconsumed)"}'
             return 200, b'{"ok": true}'
         return 404, b'{"error": "not found"}'
 

@@ -19,6 +19,9 @@ from ray_tpu.data.read_api import (
     range,
     read_csv,
     read_json,
+    read_binary_files,
+    read_numpy,
+    read_text,
     read_parquet,
 )
 
@@ -39,7 +42,10 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_binary_files",
     "read_csv",
     "read_json",
+    "read_numpy",
+    "read_text",
     "read_parquet",
 ]

@@ -248,6 +248,11 @@ def _groupby_agg_task(key, aggs, *parts):
 
 
 @ray_tpu.remote
+def _unique_block_task(blk, column):
+    return set(blk.column(column).to_pylist())
+
+
+@ray_tpu.remote
 def _write_block_task(blk, path, fmt):
     if fmt == "parquet":
         import pyarrow.parquet as pq
@@ -835,6 +840,18 @@ class Dataset:
             out.extend(B.block_rows(ray_tpu.get(ref)))
         return out
 
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: dataset.py unique).
+        Per-block distincts compute remotely; only the (small) value sets
+        travel to the driver — the full blocks never do."""
+        sets = ray_tpu.get(
+            [_unique_block_task.remote(ref, column) for ref in self._block_refs]
+        )
+        seen: set = set()
+        for s in sets:
+            seen.update(s)
+        return sorted(seen, key=lambda v: (v is None, v))
+
     def to_pandas(self):
         import pandas as pd
 
@@ -931,3 +948,11 @@ class GroupedDataset:
 
     def max(self, on: str) -> Dataset:
         return self._agg({f"max({on})": (on, "max")})
+
+    def std(self, on: str) -> Dataset:
+        return self._agg({f"std({on})": (on, "std")})
+
+    def aggregate(self, **aggs: Tuple[str, str]) -> Dataset:
+        """Multiple aggregations at once: ``aggregate(total=("x", "sum"),
+        avg=("x", "mean"))`` (reference: grouped_data.py aggregate)."""
+        return self._agg({name: spec for name, spec in aggs.items()})

@@ -180,6 +180,69 @@ def read_csv(paths, *, parallelism: int = DEFAULT_PARALLELISM, **read_options) -
     return Dataset([p[0] for p in pairs], [p[1] for p in pairs], [("read_csv", 0.0)])
 
 
+@ray_tpu.remote
+def _read_text_task(path, encoding, drop_empty):
+    with open(path, "r", encoding=encoding) as f:
+        lines = f.read().splitlines()
+    if drop_empty:
+        lines = [ln for ln in lines if ln.strip()]
+    return pa.table({"text": lines}), None
+
+
+def read_text(paths, *, parallelism: int = DEFAULT_PARALLELISM,
+              encoding: str = "utf-8", drop_empty_lines: bool = True) -> Dataset:
+    """One row per line of text (reference: read_api.py read_text)."""
+    files = _expand_paths(paths)
+    pairs = [
+        _read_text_task.options(num_returns=2).remote(
+            p, encoding, drop_empty_lines
+        )
+        for p in files
+    ]
+    return Dataset([b for b, _ in pairs], [m for _, m in pairs],
+                   [("read_text", 0.0)])
+
+
+@ray_tpu.remote
+def _read_numpy_task(path):
+    arr = np.load(path, allow_pickle=False)
+    if isinstance(arr, np.lib.npyio.NpzFile):
+        return B.block_from_batch({k: arr[k] for k in arr.files}), None
+    return B.block_from_batch({"data": arr}), None
+
+
+def read_numpy(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """.npy / .npz files as tensor columns (reference: read_api.py
+    read_numpy; tensor shapes survive via the block tensor extension)."""
+    files = _expand_paths(paths)
+    pairs = [_read_numpy_task.options(num_returns=2).remote(p) for p in files]
+    return Dataset([b for b, _ in pairs], [m for _, m in pairs],
+                   [("read_numpy", 0.0)])
+
+
+@ray_tpu.remote
+def _read_binary_task(path, include_paths):
+    with open(path, "rb") as f:
+        data = f.read()
+    cols = {"bytes": [data]}
+    if include_paths:
+        cols["path"] = [path]
+    return pa.table(cols), None
+
+
+def read_binary_files(paths, *, parallelism: int = DEFAULT_PARALLELISM,
+                      include_paths: bool = False) -> Dataset:
+    """One row per file with its raw bytes (reference: read_api.py
+    read_binary_files)."""
+    files = _expand_paths(paths)
+    pairs = [
+        _read_binary_task.options(num_returns=2).remote(p, include_paths)
+        for p in files
+    ]
+    return Dataset([b for b, _ in pairs], [m for _, m in pairs],
+                   [("read_binary_files", 0.0)])
+
+
 def read_json(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
     files = _expand_paths(paths)
     pairs = [_read_json_task.options(num_returns=2).remote(p) for p in files]

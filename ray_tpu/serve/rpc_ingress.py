@@ -1,0 +1,113 @@
+"""RPC ingress: serve deployments over the framework's binary RPC plane.
+
+Reference surface: the reference's gRPC ingress (serve/_private/grpc_util.py
++ the gRPC proxy RFC) next to its HTTP proxy. This framework's framed RPC
+(wire v3: out-of-band buffers, session-token auth) IS its gRPC equivalent,
+so the binary ingress is an RpcServer routing ``call``/``stream`` to
+DeploymentHandles — numpy payloads ride the wire raw (no JSON, no base64),
+which is what a model-serving data plane needs.
+
+Client side: :class:`ServeRpcClient` — connect, ``call(app, payload)``,
+``stream(app, payload)`` (a generator). Auth follows the session token like
+every other control-plane client.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu.serve.handle import DeploymentHandle
+
+logger = logging.getLogger(__name__)
+
+
+class RpcIngress:
+    """Binary ingress actor-side server (runs in the driver/serve process).
+
+    Handlers run on the RPC dispatch pool; each request resolves through the
+    same DeploymentHandle router (power-of-two replica choice, replica-death
+    retry) as HTTP requests."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = RpcServer("serve-rpc-ingress", host=host, port=port)
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._lock = threading.Lock()
+        self._server.register("serve_call", self._handle_call)
+        self._server.register("serve_stream", self._handle_stream)
+        self._server.register("serve_routes", self._handle_routes)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.address
+
+    def _handle(self, app: str) -> DeploymentHandle:
+        with self._lock:
+            h = self._handles.get(app)
+            if h is None:
+                h = self._handles[app] = DeploymentHandle(app)
+            return h
+
+    def _handle_routes(self, conn, payload) -> list:
+        from ray_tpu import serve as _serve
+
+        try:
+            return sorted(_serve.status())
+        except Exception:
+            return []
+
+    def _handle_call(self, conn, payload) -> Any:
+        app, body = payload
+        import ray_tpu
+
+        for attempt in range(4):
+            response = self._handle(app).remote(body)
+            try:
+                return response.result(timeout=60.0)
+            except ray_tpu.ActorDiedError:
+                # replica churn (redeploy, scale-down): refresh and retry,
+                # matching the HTTP proxy's behavior
+                if attempt == 3:
+                    raise
+                self._handle(app)._refresh(force=True)
+
+    def _handle_stream(self, conn, payload) -> list:
+        """Streaming calls: resolves the generator's items and returns them
+        as a list of values (the binary plane has no chunked encoding; for
+        incremental consumption use the HTTP NDJSON ingress)."""
+        import ray_tpu
+        from ray_tpu._private.ids import ObjectRefGenerator
+
+        app, body = payload
+        response = self._handle(app).stream(body)
+        value = response.result(timeout=60.0)
+        if isinstance(value, ObjectRefGenerator):
+            return [ray_tpu.get(r, timeout=60.0) for r in value]
+        return list(value) if isinstance(value, (list, tuple)) else [value]
+
+    def stop(self):
+        self._server.stop()
+
+
+class ServeRpcClient:
+    """Client for :class:`RpcIngress` (binary plane, token-authenticated)."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self._client = RpcClient(tuple(address))
+
+    def call(self, app: str, payload: Any = None, timeout: float = 60.0) -> Any:
+        return self._client.call("serve_call", (app, payload), timeout=timeout)
+
+    def stream(self, app: str, payload: Any = None,
+               timeout: float = 120.0) -> Iterator[Any]:
+        for item in self._client.call("serve_stream", (app, payload),
+                                      timeout=timeout):
+            yield item
+
+    def routes(self, timeout: float = 30.0) -> list:
+        return self._client.call("serve_routes", None, timeout=timeout)
+
+    def close(self):
+        self._client.close()

@@ -45,6 +45,7 @@ __all__ = [
     "get_output",
     "list_all",
     "delete",
+    "consume_event",
     "send_event",
     "wait_for_event",
 ]
@@ -291,6 +292,7 @@ from ray_tpu.workflow.events import (  # noqa: E402
     EventListener,
     KVEventListener,
     TimerListener,
+    consume_event,
     send_event,
     wait_for_event,
 )

@@ -44,21 +44,19 @@ class TimerListener(EventListener):
 
 
 class KVEventListener(EventListener):
-    """Waits for ``send_event(key, payload)`` from anywhere in (or outside)
-    the cluster — the HTTP event provider's delivery target.
+    """Waits for ``send_event(key, payload)`` — a SINGLE-SLOT mailbox per
+    key, the HTTP event provider's delivery target.
 
-    ``consume=True`` (default) deletes the KV entry once received: keys are
-    one-shot, so a later workflow reusing the name waits for a FRESH event
-    instead of resolving on a stale payload, and consumed events don't
-    accumulate in GCS persistence. The workflow step checkpoint preserves
-    exactly-once for THIS workflow regardless (resume replays the
-    checkpointed value, never re-polls)."""
+    The listener never deletes the key itself: consumption happens in a
+    SEPARATE workflow step AFTER the received value has checkpointed
+    (see ``wait_for_event``), so a crash between receipt and checkpoint
+    re-polls and finds the event still present — exactly-once survives
+    worker and driver failures. Senders use ``overwrite=False``: a second
+    event on an un-consumed key is REJECTED (never silently dropped)."""
 
-    def __init__(self, key: str, poll_interval_s: float = 0.2,
-                 consume: bool = True):
+    def __init__(self, key: str, poll_interval_s: float = 0.2):
         self.key = key
         self.poll_interval_s = poll_interval_s
-        self.consume = consume
 
     def poll_for_event(self, timeout: Optional[float] = None) -> Any:
         from ray_tpu._private.worker import get_global_worker
@@ -68,20 +66,29 @@ class KVEventListener(EventListener):
         while True:
             raw = gcs.call("kv_get", (_EVENT_NS, self.key))
             if raw is not None:
-                if self.consume:
-                    gcs.call("kv_del", (_EVENT_NS, self.key))
                 return pickle.loads(raw)
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"no event on {self.key!r} within {timeout}s")
             time.sleep(self.poll_interval_s)
 
 
-def send_event(key: str, payload: Any = None) -> None:
-    """Deliver an event: every current or future listener on ``key`` sees it."""
+def consume_event(key: str) -> bool:
+    """Free a key's mailbox slot (idempotent; safe to re-run on resume)."""
     from ray_tpu._private.worker import get_global_worker
 
     gcs = get_global_worker().core.gcs
-    gcs.call("kv_put", (_EVENT_NS, key, pickle.dumps(payload), True))
+    return bool(gcs.call("kv_del", (_EVENT_NS, key)))
+
+
+def send_event(key: str, payload: Any = None) -> bool:
+    """Deliver an event into ``key``'s mailbox slot. Returns False (rather
+    than silently replacing an un-consumed event) when the slot is full."""
+    from ray_tpu._private.worker import get_global_worker
+
+    gcs = get_global_worker().core.gcs
+    return bool(
+        gcs.call("kv_put", (_EVENT_NS, key, pickle.dumps(payload), False))
+    )
 
 
 def wait_for_event(
@@ -94,8 +101,11 @@ def wait_for_event(
 
     Accepts an EventListener INSTANCE or a listener class plus constructor
     args (the reference's ``workflow.wait_for_event(Listener, *args)``
-    shape). The event value is persisted by the step checkpoint, so resume
-    never re-waits for an already-received event (exactly-once)."""
+    shape). Two chained steps: the WAIT step's received value checkpoints
+    first; only then does the CONSUME step free the KV mailbox slot — a
+    crash at any point either re-polls (event still present) or re-runs
+    the idempotent delete, so the event is neither lost nor doubly waited
+    (exactly-once)."""
     from ray_tpu.workflow import step
 
     def _wait():
@@ -107,4 +117,21 @@ def wait_for_event(
         return listener.poll_for_event()
 
     _wait.__name__ = name or "wait_for_event"
-    return step(_wait).bind()
+    wait_node = step(_wait).bind()
+    if isinstance(event_listener, KVEventListener) or (
+        isinstance(event_listener, type)
+        and issubclass(event_listener, KVEventListener)
+    ):
+        key = (
+            event_listener.key
+            if isinstance(event_listener, KVEventListener)
+            else (listener_args[0] if listener_args else listener_kwargs["key"])
+        )
+
+        def _consume(event):
+            consume_event(key)
+            return event
+
+        _consume.__name__ = f"consume_event[{key}]"
+        return step(_consume).bind(wait_node)
+    return wait_node

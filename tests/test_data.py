@@ -291,3 +291,34 @@ def test_join_right_with_one_sided_partitions(ray_start_regular):
     assert rj[0]["a"] == 2 and rj[0]["b"] == 4
     unmatched = [r for r in rj if r["k"] > 2]
     assert all(r["a"] is None or r["a"] != r["a"] for r in unmatched)
+
+
+def test_read_text_numpy_binary(ray_start_regular, tmp_path):
+    """r4 datasource breadth (reference: read_api.py read_text/read_numpy/
+    read_binary_files)."""
+    (tmp_path / "a.txt").write_text("hello\nworld\n\n")
+    (tmp_path / "b.txt").write_text("third\n")
+    ds = rd.read_text([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
+    assert sorted(r["text"] for r in ds.take(10)) == ["hello", "third", "world"]
+
+    np.save(tmp_path / "arr.npy", np.arange(12, dtype=np.float32).reshape(4, 3))
+    nds = rd.read_numpy(str(tmp_path / "arr.npy"))
+    batch = next(iter(nds.iter_batches(batch_size=4, batch_format="numpy")))
+    assert batch["data"].shape == (4, 3)
+
+    (tmp_path / "blob.bin").write_bytes(b"\x00\x01\x02")
+    bds = rd.read_binary_files(str(tmp_path / "blob.bin"), include_paths=True)
+    row = bds.take(1)[0]
+    assert row["bytes"] == b"\x00\x01\x02" and row["path"].endswith("blob.bin")
+
+
+def test_groupby_std_aggregate_and_unique(ray_start_regular):
+    ds = rd.from_items(
+        [{"k": i % 2, "v": float(i)} for i in range(10)], parallelism=3
+    )
+    out = {r["k"]: r for r in ds.groupby("k").aggregate(
+        total=("v", "sum"), spread=("v", "std")).take(10)}
+    assert out[0]["total"] == 0 + 2 + 4 + 6 + 8
+    assert out[1]["total"] == 1 + 3 + 5 + 7 + 9
+    assert out[0]["spread"] > 0
+    assert ds.unique("k") == [0, 1]
