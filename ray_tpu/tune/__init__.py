@@ -28,6 +28,7 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.progress import ProgressReporter
 from ray_tpu.tune.tuner import (
     ResultGrid,
     Trial,
@@ -52,6 +53,7 @@ __all__ = [
     "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "ProgressReporter",
     "Searcher",
     "ResultGrid",
     "Trial",

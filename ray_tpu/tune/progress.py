@@ -1,0 +1,81 @@
+"""Console progress reporting for Tuner.fit.
+
+Reference surface: python/ray/tune/progress_reporter.py (CLIReporter: a
+throttled status table of trials — status, iterations, the objective
+metric — printed as the experiment runs). Kept dependency-free: aligned
+plain-text, emitted through the tune logger so drivers capture it like any
+other log line.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List, Optional
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+class ProgressReporter:
+    """Throttled trial-status table (reference: CLIReporter).
+
+    ``report(trials, metric)`` prints at most once per ``max_report_freq``
+    seconds unless ``force=True`` (the final table always prints)."""
+
+    def __init__(self, *, max_report_freq: float = 5.0,
+                 max_progress_rows: int = 20):
+        self.max_report_freq = max_report_freq
+        self.max_progress_rows = max_progress_rows
+        self._last = 0.0
+
+    def should_report(self, force: bool = False) -> bool:
+        now = time.monotonic()
+        if force or now - self._last >= self.max_report_freq:
+            self._last = now
+            return True
+        return False
+
+    def report(self, trials: List[Any], metric: Optional[str],
+               force: bool = False) -> None:
+        if not self.should_report(force):
+            return
+        by_status: dict = {}
+        for t in trials:
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+        header = " | ".join(f"{k}: {v}" for k, v in sorted(by_status.items()))
+        # live trials first (the reference CLIReporter prioritizes them):
+        # a 100-trial sweep must show what's RUNNING, not the first 20
+        # long-terminated rows forever
+        order = {"RUNNING": 0, "PENDING": 1, "PAUSED": 2}
+        visible = sorted(
+            trials, key=lambda t: order.get(t.status, 3)
+        )[: self.max_progress_rows]
+        rows = []
+        for t in visible:
+            last = t.last_result or {}
+            rows.append(
+                (
+                    t.trial_id[-18:],
+                    t.status,
+                    str(last.get("training_iteration", "-")),
+                    _fmt(last.get(metric)) if metric else "-",
+                )
+            )
+        widths = [
+            max(len(r[i]) for r in rows + [("trial", "status", "iter", metric or "metric")])
+            for i in range(4)
+        ]
+        lines = [f"== tune progress ({header}) =="]
+        cols = ("trial", "status", "iter", metric or "metric")
+        lines.append("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+        for r in rows:
+            lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+        if len(trials) > self.max_progress_rows:
+            lines.append(f"... and {len(trials) - self.max_progress_rows} more trials")
+        logger.info("%s", "\n".join(lines))
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.5g}"
+    return "-" if v is None else str(v)

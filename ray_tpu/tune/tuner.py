@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.progress import ProgressReporter
 from ray_tpu.train.checkpoint_manager import CheckpointManager
 from ray_tpu.train.config import CheckpointConfig, RunConfig
 from ray_tpu.train.result import Result
@@ -45,6 +46,9 @@ class TuneConfig:
     search_alg: Optional[search_mod.Searcher] = None
     trial_resources: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
+    # None -> a default throttled CLI-style reporter; pass a configured
+    # ProgressReporter to tune cadence/row count, or False to silence
+    progress_reporter: Any = None
 
 
 @dataclasses.dataclass
@@ -218,6 +222,11 @@ class Tuner:
         searcher = cfgs.search_alg
         if searcher is not None:
             searcher.set_search_properties(cfgs.metric, cfgs.mode)
+        reporter = (
+            None
+            if cfgs.progress_reporter is False
+            else (cfgs.progress_reporter or ProgressReporter())
+        )
         fn = self._resolve_trainable()
         exp_dir = self.experiment_dir
         exp_name = self.run_config.name or os.path.basename(exp_dir)
@@ -440,6 +449,8 @@ class Tuner:
                     break
                 time.sleep(0.05)
                 continue
+            if reporter is not None:
+                reporter.report(trials, cfgs.metric)
             refs = [run_refs[t.trial_id] for t in running]
             done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0.25)
             done_set = set(done)
@@ -464,6 +475,8 @@ class Tuner:
                     _exploit(trial)
             _drain_scheduler()
 
+        if reporter is not None:
+            reporter.report(trials, cfgs.metric, force=True)
         self._save_state(trials)
 
         def _trial_checkpoint(t: Trial):

@@ -359,3 +359,28 @@ def test_tpe_with_tuner(ray_start_regular, tmp_path):
     assert len(grid) == 10
     assert not grid.errors
     assert grid.get_best_result().metrics["score"] > -0.05
+
+
+def test_progress_reporter_table(ray_start_regular, tmp_path, caplog):
+    """CLI-style throttled progress table through the tune logger
+    (reference: tune/progress_reporter.py CLIReporter)."""
+    import logging
+
+    from ray_tpu.tune.progress import ProgressReporter
+
+    def objective(config):
+        tune.report({"score": config["x"]})
+
+    with caplog.at_level(logging.INFO, logger="ray_tpu.tune"):
+        grid = Tuner(
+            objective,
+            param_space={"x": tune.grid_search([1.0, 2.0])},
+            tune_config=TuneConfig(
+                metric="score", mode="max",
+                progress_reporter=ProgressReporter(max_report_freq=0.0),
+            ),
+            run_config=ray_tpu.train.RunConfig(name="pr", storage_path=str(tmp_path)),
+        ).fit()
+    assert not grid.errors
+    text = "\n".join(r.message for r in caplog.records)
+    assert "tune progress" in text and "TERMINATED" in text and "score" in text
