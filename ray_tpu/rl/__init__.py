@@ -31,7 +31,7 @@ from ray_tpu.rl.connectors import (
     MeanStdFilter,
     UnsquashActions,
 )
-from ray_tpu.rl.td3 import TD3, TD3Config, TD3RolloutWorker
+from ray_tpu.rl.td3 import DDPG, DDPGConfig, TD3, TD3Config, TD3RolloutWorker
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNLearner, DQNRolloutWorker, QNetwork
 from ray_tpu.rl.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rl.impala import Impala, ImpalaConfig, ImpalaLearner, vtrace
@@ -65,6 +65,8 @@ __all__ = [
     "APPOLearner",
     "CQL",
     "CQLConfig",
+    "DDPG",
+    "DDPGConfig",
     "ES",
     "ESConfig",
     "ESEvalWorker",

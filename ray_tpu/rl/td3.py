@@ -286,3 +286,23 @@ class TD3:
                 ray_tpu.kill(w)
             except Exception:
                 pass
+
+
+@dataclasses.dataclass
+class DDPGConfig(TD3Config):
+    """DDPG (reference: rllib/algorithms/ddpg/) — the deterministic
+    policy-gradient ancestor of TD3: no delayed actor, no target-policy
+    smoothing. Twin critics are kept (strictly better, same machinery);
+    the three TD3 additions are disabled so the update IS Lillicrap et
+    al.'s algorithm."""
+
+    policy_delay: int = 1
+    target_noise: float = 0.0
+    target_noise_clip: float = 0.0
+
+    def build(self) -> "DDPG":
+        return DDPG(self)
+
+
+class DDPG(TD3):
+    pass

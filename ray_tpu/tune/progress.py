@@ -26,7 +26,10 @@ class ProgressReporter:
                  max_progress_rows: int = 20):
         self.max_report_freq = max_report_freq
         self.max_progress_rows = max_progress_rows
-        self._last = 0.0
+        # -inf: the FIRST report always fires (monotonic's epoch is
+        # arbitrary, and a reporter reused across fits must not swallow
+        # the next run's opening table)
+        self._last = float("-inf")
 
     def should_report(self, force: bool = False) -> bool:
         now = time.monotonic()
