@@ -226,6 +226,9 @@ class Raylet:
         self.store = object_store.PlasmaStore(
             session_dir, capacity=store_capacity, name=node_name
         )
+        # same-process workers (the head-node driver, in-process test
+        # clusters) bypass the RPC hop for store metadata ops
+        object_store.register_local_store(self.server.address, self.store)
         if resources is None:
             resources = {"CPU": float(os.cpu_count() or 1)}
         resources.setdefault("node", 1.0)
@@ -1233,6 +1236,7 @@ class Raylet:
                     pass
 
     def stop(self, unregister: bool = True):
+        object_store.unregister_local_store(self.server.address)
         if unregister:
             try:
                 self.gcs.call("unregister_node", self.node_id, timeout=5.0)

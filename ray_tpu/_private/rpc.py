@@ -988,6 +988,7 @@ class RpcClient:
         address: Tuple[str, int],
         on_notify: Optional[Callable[[str, Any], None]] = None,
         connect_timeout: Optional[float] = None,
+        inline_notify: bool = False,
     ):
         timeout = connect_timeout or GlobalConfig.rpc_connect_timeout_s
         deadline = time.monotonic() + timeout
@@ -1009,6 +1010,11 @@ class RpcClient:
         self._pending_lock = threading.Lock()
         self._ids = itertools.count(1)
         self._on_notify = on_notify
+        # inline notifies run ON the poller thread, in exact frame-arrival
+        # order relative to responses on this connection — required by
+        # consumers that sequence streamed item frames against a terminal
+        # response (batched task pushes). Handlers must be non-blocking.
+        self._inline_notify = inline_notify
         self._closed = threading.Event()
         self._frames = _FrameBuffer()
         self._notify_q: deque = deque()
@@ -1032,7 +1038,13 @@ class RpcClient:
             raise ConnectionLost(str(exc))
         if kind == NOTIFY:
             if self._on_notify is not None:
-                self._enqueue_notify(method, payload)
+                if self._inline_notify:
+                    try:
+                        self._on_notify(method, payload)
+                    except Exception:
+                        pass  # a bad handler must not kill the connection
+                else:
+                    self._enqueue_notify(method, payload)
             return
         with self._pending_lock:
             slot = self._pending.pop(msg_id, None)

@@ -108,6 +108,23 @@ class _Pickler(cloudpickle.Pickler):
         return super().reducer_override(obj)
 
 
+#: exact types that plain C pickle handles and that cannot CONTAIN a jax
+#: array or a closure — the fast path skips cloudpickle's per-object
+#: reducer_override (~30us/object on small values, half the cost of a small
+#: put). Exact-type check: a user SUBCLASS (e.g. ``class Label(str)`` in
+#: __main__) needs cloudpickle's serialize-by-value to exist on workers.
+_FAST_TYPES = frozenset({bytes, str, int, float, bool, type(None), bytearray})
+
+
+def _is_fast(obj: Any) -> bool:
+    import numpy as _np
+
+    t = type(obj)
+    return t in _FAST_TYPES or (
+        t is _np.ndarray and not obj.dtype.hasobject
+    )
+
+
 def serialize(obj: Any, *, is_exception: bool = False) -> SerializedObject:
     import io as _io
 
@@ -120,6 +137,11 @@ def serialize(obj: Any, *, is_exception: bool = False) -> SerializedObject:
         buffers.append(view)
         return False
 
+    if _is_fast(obj):
+        meta = pickle.dumps(obj, protocol=5, buffer_callback=callback)
+        return SerializedObject(
+            meta, buffers, FLAG_EXCEPTION if is_exception else 0
+        )
     f = _io.BytesIO()
     _Pickler(f, protocol=5, buffer_callback=callback).dump(obj)
     return SerializedObject(f.getvalue(), buffers, FLAG_EXCEPTION if is_exception else 0)

@@ -92,6 +92,9 @@ class TaskExecutor:
         self.server = server
         self._actors: Dict[ActorID, _ActorState] = {}
         self._actors_lock = threading.Lock()
+        # wire-spec templates registered by owners (bounded by the number of
+        # distinct RemoteFunction+options objects across connected drivers)
+        self._tmpls: Dict[bytes, Dict[str, Any]] = {}
         server.register("push_task", self.rpc_push_task, inline=True)
         server.register("push_task_batch", self.rpc_push_task_batch, inline=True)
         server.register("create_actor", self.rpc_create_actor)
@@ -262,6 +265,11 @@ class TaskExecutor:
     def rpc_push_task(self, conn: ServerConn, spec: Dict[str, Any]):
         """Inline handler: must not block. Routes to the actor's ordered
         queue or the dispatch pool and returns a Deferred reply."""
+        if "task_id" not in spec:  # template-diff form: {"t": ..., "tmpls": ...}
+            tmpls = spec.get("tmpls")
+            if tmpls:
+                self._tmpls.update(tmpls)
+            spec = self._expand_spec(spec["t"])
         d = Deferred()
         if spec.get("actor_id") is not None and spec.get("method") is not None:
             with self._actors_lock:
@@ -299,30 +307,80 @@ class TaskExecutor:
             )
         return d
 
-    def rpc_push_task_batch(self, conn: ServerConn, specs):
+    #: defaults for spec fields a template-diff frame may omit when empty
+    _SPEC_DEFAULTS = {
+        "deps": (),
+        "nested": (),
+        "locations": None,
+        "trace": None,
+        "retries_left": 0,
+        "resubmits_left": 0,
+    }
+
+    def rpc_push_task_batch(self, conn: ServerConn, payload):
         """Inline handler: a pipelined batch of NORMAL tasks from one owner.
         Executed sequentially on one pool thread — the point is amortizing
         per-task wire/dispatch overhead (one frame, one pickle header, one
         callback each way per batch instead of per task), the single-core
         analogue of the reference's pipelined task pushes
-        (direct_task_transport.cc:234 PushNormalTask back-to-back)."""
+        (direct_task_transport.cc:234 PushNormalTask back-to-back).
+
+        Payload: ``{"bid", "tmpls": {id: static-fields}|None, "tasks":
+        [(tmpl_id|None, diff-or-full-spec), ...]}``. Template definitions
+        arrive on the connection that first uses them; registration here on
+        the read loop (inline) guarantees a template always lands before
+        any frame referencing it is dispatched."""
+        tmpls = payload.get("tmpls")
+        if tmpls:
+            self._tmpls.update(tmpls)
         d = Deferred()
-        self.server._pool.submit(self._run_batch, d, specs)
+        self.server._pool.submit(
+            self._run_batch, d, conn, payload["bid"], payload["tasks"]
+        )
         return d
 
-    def _run_batch(self, d: Deferred, specs):
+    def _expand_spec(self, task):
+        tmpl_id, diff = task
+        if tmpl_id is None:
+            return diff
+        spec = dict(self._SPEC_DEFAULTS)
+        spec.update(self._tmpls[tmpl_id])
+        spec.update(diff)
+        return spec
+
+    def _run_batch(self, d: Deferred, conn: ServerConn, bid: int, tasks):
         from ray_tpu._private.rpc import _wire_safe_exc
 
+        # Batches that run long stream each reply the moment its task
+        # finishes (NOTIFY rides the same socket, so item frames always
+        # precede the terminal response): dependents unblock early and
+        # completed work is acked before a potential worker death (ADVICE
+        # r4 medium). Sub-threshold batches (microtask floods, where the
+        # terminal reply is imminent anyway) skip the per-item frames —
+        # streaming every noop costs ~25us/task on a 1-core host. The
+        # terminal reply carries results only for unstreamed items.
         replies = []
-        for spec in specs:
+        stream = False
+        t0 = time.monotonic() if len(tasks) > 1 else None
+        for i, task in enumerate(tasks):
             try:
-                replies.append(self._execute_normal_task(spec))
+                reply = self._execute_normal_task(self._expand_spec(task))
             except Exception as e:  # noqa: BLE001
                 # these ride inside a RESPONSE frame, which skips the
                 # server-side ERROR downcast: apply it here or one bad
                 # exception tears down the owner's whole connection
-                replies.append(_wire_safe_exc(e))
-        d.resolve(replies)
+                reply = _wire_safe_exc(e)
+            if not stream and t0 is not None and time.monotonic() - t0 > 0.005:
+                stream = True
+            if stream:
+                try:
+                    conn.notify("batch_item", (bid, i, reply))
+                    replies.append(None)
+                    continue
+                except Exception:  # conn dying: terminal path reports it
+                    pass
+            replies.append(reply)
+        d.resolve({"bid": bid, "replies": replies})
 
     def _resolve_with(self, d: Deferred, fn, spec):
         try:

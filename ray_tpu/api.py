@@ -135,7 +135,20 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self._options = options or {}
+        # submit plan cached per CoreWorker: the spec's static fields are
+        # registered as a wire template ONCE, so each .remote() builds only
+        # the varying fields and the wire carries a template id, not the
+        # full spec (the reference's analogue is the cached serialized
+        # function descriptor in the task submitter)
+        self._plan = None
         functools.update_wrapper(self, fn)
+
+    def __getstate__(self):
+        # the submit plan holds the CoreWorker (unpicklable, and meaningless
+        # in another process): ship only fn + options
+        state = dict(self.__dict__)
+        state["_plan"] = None
+        return state
 
     def options(self, **opts) -> "RemoteFunction":
         _check_options(opts)
@@ -145,8 +158,33 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         core = worker_mod.get_global_worker().core
+        plan = self._plan
+        if plan is not None and plan[0] is core:
+            _core, num_returns, template = plan
+            refs = core.submit_task(
+                self._fn, args, kwargs, num_returns=num_returns, template=template
+            )
+            return (
+                refs[0] if num_returns == 1 or num_returns == "dynamic" else refs
+            )
         num_returns = self._options.get("num_returns", 1)
         node_id, soft = _scheduling_node_from_options(self._options)
+        env = _resolved_runtime_env(self._options)
+        template = None
+        if not env and hasattr(core, "build_template"):
+            # runtime_env resolution can upload driver-local paths whose
+            # contents may change between calls: only env-free plans build
+            # a reusable wire template (cached per CoreWorker)
+            template = core.build_template(
+                self._fn,
+                num_returns=num_returns,
+                resources=_resources_from_options(self._options, default_cpu=1.0),
+                max_retries=self._options.get("max_retries"),
+                name=self._options.get("name") or self._fn.__name__,
+                scheduling_node=node_id,
+                scheduling_soft=soft,
+            )
+            self._plan = (core, num_returns, template)
         refs = core.submit_task(
             self._fn,
             args,
@@ -157,7 +195,8 @@ class RemoteFunction:
             name=self._options.get("name") or self._fn.__name__,
             scheduling_node=node_id,
             scheduling_soft=soft,
-            runtime_env=_resolved_runtime_env(self._options),
+            runtime_env=env,
+            template=template,
         )
         # "dynamic" has one static return: the ObjectRefGenerator
         return refs[0] if num_returns == 1 or num_returns == "dynamic" else refs
