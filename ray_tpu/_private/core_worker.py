@@ -934,7 +934,12 @@ class CoreWorker:
             "scheduling_soft": scheduling_soft,
             "runtime_env": runtime_env,
         }
-        return self.new_template(fields), fields
+        # "name" stays OUT of the wire template: per-task display names
+        # (``f.options(name=f"work-{i}")``) would otherwise mint a template
+        # per call and grow every registry O(N calls); the name rides the
+        # per-task diff instead (~15 bytes)
+        wire_fields = {k: v for k, v in fields.items() if k != "name"}
+        return self.new_template(wire_fields), fields
 
     def submit_task(
         self,
@@ -1232,10 +1237,10 @@ class CoreWorker:
             tmpl_out[tid] = tmpl
             sent.add(tid)
         diff = {"task_id": spec["task_id"], "args": spec["args"]}
-        # counters ride the diff only when the template doesn't pin them
-        # (normal tasks decrement retries across pushes; actor templates
-        # carry retries_left=0 statically and ship seq_no per call)
-        for k in ("retries_left", "resubmits_left", "seq_no"):
+        # these ride the diff only when the template doesn't pin them
+        # (normal tasks decrement retries across pushes and carry per-task
+        # names; actor templates pin retries_left=0/name and ship seq_no)
+        for k in ("retries_left", "resubmits_left", "seq_no", "name"):
             if k in spec and k not in tmpl:
                 diff[k] = spec[k]
         for k in ("deps", "nested", "locations", "trace"):

@@ -610,17 +610,18 @@ class PlasmaStore:
     def read_view(
         self, object_id: ObjectID, offset: int, length: int
     ) -> Optional[memoryview]:
-        """Zero-copy chunk view for the transfer plane. ONLY safe when the
-        caller guarantees the entry stays resident until the view is
-        consumed (the puller pins the object for the whole pull); spilled
-        entries fall back to the copying read."""
+        """Zero-copy chunk view for the transfer plane. The zero-copy path
+        is served ONLY when the entry is actually pinned (the puller pins
+        via store_get for the whole pull) — the invariant is enforced here,
+        not assumed: a peer that lost its pin (bug, retry after release,
+        protocol drift) gets a copy instead of a live view that eviction
+        could concurrently reuse (ADVICE r4). Spilled entries use the
+        copying read too."""
         with self._cv:
             e = self._entries.get(object_id)
             if e is None or not e.sealed:
                 return None
-            if not e.resident:
-                pass  # fall through to the copying read below
-            else:
+            if e.resident and e.pin_count > 0:
                 length = min(length, e.size - offset)
                 base = e.offset
                 return self._view[base + offset : base + offset + length]

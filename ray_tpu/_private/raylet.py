@@ -44,9 +44,50 @@ class ForkedProc:
     def __init__(self, pid: int):
         self.pid = pid
         self.returncode: Optional[int] = None
+        # pidfd (linux 5.3+): race-free liveness + signaling. The template
+        # is the child's parent and reaps it promptly, so the PID can be
+        # recycled while this raylet still tracks it — kill(pid, 0) against
+        # a recycled PID reports an unrelated process as "our worker", and
+        # signals would hit that stranger (ADVICE r4). A pidfd pins the
+        # kernel's process identity: it polls readable exactly when OUR
+        # child exits, regardless of reaping or PID reuse.
+        self._pidfd: Optional[int] = None
+        try:
+            self._pidfd = os.pidfd_open(pid)
+        except (AttributeError, OSError):
+            # already exited+reaped (dead) or pre-5.3 kernel (fall back)
+            try:
+                os.kill(pid, 0)
+            except (ProcessLookupError, PermissionError):
+                self.returncode = -1
+
+    def __del__(self):
+        if self._pidfd is not None:
+            try:
+                os.close(self._pidfd)
+            except OSError:
+                pass
 
     def poll(self) -> Optional[int]:
         if self.returncode is not None:
+            return self.returncode
+        if self._pidfd is not None:
+            import select as _select
+
+            try:
+                # poll(), not select(): a pidfd numbered >= FD_SETSIZE
+                # (plenty of sockets on a busy raylet) makes select raise
+                # ValueError and would kill the monitor loop
+                p = _select.poll()
+                p.register(self._pidfd, _select.POLLIN)
+                ready = p.poll(0)
+            except (OSError, ValueError):
+                ready = [(self._pidfd, 0)]
+            if ready:
+                # exit status is unobservable (the template is the parent
+                # and already reaped it); crash detail lives in the worker
+                # log, -1 just marks "gone"
+                self.returncode = -1
             return self.returncode
         try:
             os.kill(self.pid, 0)
@@ -55,23 +96,28 @@ class ForkedProc:
             self.returncode = -1
             return self.returncode
 
-    def terminate(self):
-        try:
-            os.kill(self.pid, 15)
-        except (ProcessLookupError, PermissionError):
-            pass
+    def _signal(self, sig: int):
+        if self._pidfd is not None:
+            import signal as _signal_mod
 
-    def kill(self):
-        try:
-            os.kill(self.pid, 9)
-        except (ProcessLookupError, PermissionError):
-            pass
-
-    def send_signal(self, sig: int):
+            try:
+                _signal_mod.pidfd_send_signal(self._pidfd, sig)
+            except (AttributeError, ProcessLookupError, OSError):
+                pass
+            return
         try:
             os.kill(self.pid, sig)
         except (ProcessLookupError, PermissionError):
             pass
+
+    def terminate(self):
+        self._signal(15)
+
+    def kill(self):
+        self._signal(9)
+
+    def send_signal(self, sig: int):
+        self._signal(sig)
 
     def wait(self, timeout: Optional[float] = None) -> int:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -150,6 +196,12 @@ class ForkServer:
             try:
                 c = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
                 c.connect(self._sock_path)
+                # a wedged template (mid-fork signal, partial write) must
+                # surface as an exception, not block every future spawn on
+                # the node behind self._lock forever (ADVICE r4): timed out
+                # requests mark this instance dead and the Popen fallback +
+                # ForkServer.get() replacement take over
+                c.settimeout(15.0)
                 self._conn = c
                 break
             except OSError:
@@ -167,14 +219,27 @@ class ForkServer:
         cwd: Optional[str],
         sys_path: List[str],
     ) -> ForkedProc:
+        import socket as _socket
+
         from ray_tpu._private.worker_forkserver import _read_msg, _send_msg
 
         with self._lock:
-            _send_msg(
-                self._conn,
-                {"env": env, "log_path": log_path, "cwd": cwd, "sys_path": sys_path},
-            )
-            reply = _read_msg(self._conn)
+            try:
+                _send_msg(
+                    self._conn,
+                    {"env": env, "log_path": log_path, "cwd": cwd, "sys_path": sys_path},
+                )
+                reply = _read_msg(self._conn)
+            except (_socket.timeout, OSError) as e:
+                # template wedged or died: kill this instance so alive() is
+                # False (ForkServer.get stands up a replacement) and let the
+                # caller's Popen fallback handle THIS spawn
+                conn, self._conn = self._conn, None
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                raise RuntimeError(f"fork-server request failed: {e}") from e
         if not reply or "pid" not in reply:
             raise RuntimeError("fork-server did not return a pid")
         return ForkedProc(reply["pid"])
@@ -184,7 +249,8 @@ class ForkServer:
             from ray_tpu._private.worker_forkserver import _send_msg
 
             with self._lock:
-                _send_msg(self._conn, {"op": "shutdown"})
+                if self._conn is not None:
+                    _send_msg(self._conn, {"op": "shutdown"})
         except OSError:
             pass
         try:

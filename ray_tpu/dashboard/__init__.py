@@ -149,6 +149,23 @@ class DashboardServer:
                 self.wfile.write(body)
 
             def do_POST(self):
+                # mutating endpoints are session-token gated like every
+                # RPC-plane mutation (ADVICE r4: an unauthenticated POST
+                # could fire/squat workflow event mailboxes on any reachable
+                # bind). GET endpoints stay open (read-only views).
+                from ray_tpu._private import rpc as _rpc
+
+                token = _rpc.session_token()
+                if token is not None:
+                    import hmac as _hmac
+
+                    presented = self.headers.get("X-RayTpu-Token") or ""
+                    if not _hmac.compare_digest(presented, token):
+                        self.send_response(403)
+                        self.send_header("Content-Type", "application/json")
+                        self.end_headers()
+                        self.wfile.write(b'{"error": "authentication required"}')
+                        return
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
                 try:

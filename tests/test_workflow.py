@@ -177,10 +177,27 @@ def test_workflow_kv_event_and_http_provider(ray_start_regular, tmp_path):
         time.sleep(0.5)
         assert t.is_alive(), "workflow should be blocked on the event"
         host, port = dash.address
+        from ray_tpu._private import rpc as rpc_mod
+
+        # unauthenticated POST must be refused when the session has a token
+        if rpc_mod.session_token() is not None:
+            bad = urllib.request.Request(
+                f"http://{host}:{port}/api/workflows/events",
+                data=b"{}", headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(bad, timeout=10)
+                assert False, "unauthenticated POST should 403"
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+        headers = {"Content-Type": "application/json"}
+        if rpc_mod.session_token() is not None:
+            headers["X-RayTpu-Token"] = rpc_mod.session_token()
         req = urllib.request.Request(
             f"http://{host}:{port}/api/workflows/events",
             data=json.dumps({"key": "approval-1", "payload": {"user": "alice"}}).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=10) as resp:
