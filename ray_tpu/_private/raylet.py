@@ -233,10 +233,17 @@ class ForkServer:
             except (_socket.timeout, OSError) as e:
                 # template wedged or died: kill this instance so alive() is
                 # False (ForkServer.get stands up a replacement) and let the
-                # caller's Popen fallback handle THIS spawn
+                # caller's Popen fallback handle THIS spawn. The template
+                # PROCESS is killed too — a timed-out request cannot be
+                # cancelled, so a merely-slow template could otherwise still
+                # complete the fork late and leak an orphan worker.
                 conn, self._conn = self._conn, None
                 try:
                     conn.close()
+                except OSError:
+                    pass
+                try:
+                    self._proc.kill()
                 except OSError:
                     pass
                 raise RuntimeError(f"fork-server request failed: {e}") from e
@@ -422,6 +429,15 @@ class Raylet:
             except Exception:
                 logger.exception(
                     "fork-server spawn failed; falling back to subprocess"
+                )
+                # a timed-out fork may still complete late in the (killed)
+                # template; a FRESH worker id for the fallback guarantees the
+                # two can never collide in the registration table
+                worker_id = WorkerID.from_random()
+                overrides["RAYTPU_WORKER_ID"] = worker_id.hex()
+                log_path = os.path.join(
+                    self.session_dir, "logs", self.node_id.hex()[:12],
+                    f"worker-{worker_id.hex()[:12]}.log",
                 )
         env = dict(os.environ)
         env.update(overrides)

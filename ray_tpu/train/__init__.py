@@ -13,6 +13,14 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
+from ray_tpu.train.gbdt_trainer import (
+    GBDTPredictor,
+    GBDTTrainer,
+    LightGBMTrainer,
+    SklearnPredictor,
+    SklearnTrainer,
+    XGBoostTrainer,
+)
 from ray_tpu.train.result import Result
 from ray_tpu.train.session import (
     get_checkpoint,
@@ -37,8 +45,14 @@ __all__ = [
     "CheckpointManager",
     "DataParallelTrainer",
     "FailureConfig",
+    "GBDTPredictor",
+    "GBDTTrainer",
     "JaxConfig",
     "JaxTrainer",
+    "LightGBMTrainer",
+    "SklearnPredictor",
+    "SklearnTrainer",
+    "XGBoostTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",

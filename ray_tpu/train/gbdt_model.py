@@ -1,0 +1,675 @@
+"""Native histogram gradient-boosted decision trees.
+
+The reference ships GBDT training by wrapping xgboost/lightgbm behind
+data-sharded actors (reference: python/ray/train/gbdt_trainer.py:1-374,
+train/xgboost/xgboost_trainer.py). Neither library is a dependency here, so
+this module implements the engine itself: quantile pre-binning, level-wise
+tree growth from per-node gradient/hessian histograms, and shrinkage — the
+same histogram-aggregation algorithm distributed xgboost runs (its
+AllReduce over per-node histograms), expressed as numpy kernels so the
+distributed trainer (ray_tpu/train/gbdt_trainer.py) can sum worker
+histograms and grow one global tree.
+
+Everything float-accumulating uses float64 so that summing shard histograms
+in any order reproduces the single-shard model bit-for-bit in practice
+(asserted by tests/test_gbdt.py parity tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# bin index reserved for NaN / missing values; real bins are 0..n_bins-1
+_MISSING = 255
+_MAX_BINS = 255  # fits uint8 with _MISSING reserved
+
+
+def sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+# ---------------------------------------------------------------------------
+# objectives
+# ---------------------------------------------------------------------------
+
+
+class _SquaredError:
+    name = "reg:squarederror"
+    default_metric = "rmse"
+
+    @staticmethod
+    def base_score(y_sum: float, n: int) -> float:
+        return y_sum / max(n, 1)
+
+    @staticmethod
+    def grad_hess(margin: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        return margin - y, np.ones_like(margin)
+
+    @staticmethod
+    def transform(margin: np.ndarray) -> np.ndarray:
+        return margin
+
+
+class _Logistic:
+    name = "binary:logistic"
+    default_metric = "logloss"
+
+    @staticmethod
+    def base_score(y_sum: float, n: int) -> float:
+        p = min(max(y_sum / max(n, 1), 1e-6), 1 - 1e-6)
+        return float(np.log(p / (1 - p)))
+
+    @staticmethod
+    def grad_hess(margin: np.ndarray, y: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        p = sigmoid(margin)
+        return p - y, np.maximum(p * (1 - p), 1e-16)
+
+    @staticmethod
+    def transform(margin: np.ndarray) -> np.ndarray:
+        return sigmoid(margin)
+
+
+OBJECTIVES = {
+    "reg:squarederror": _SquaredError,
+    "regression": _SquaredError,  # lightgbm dialect
+    "binary:logistic": _Logistic,
+    "binary": _Logistic,  # lightgbm dialect
+}
+
+
+def eval_metric(name: str, y: np.ndarray, pred: np.ndarray) -> float:
+    if name == "rmse":
+        return float(np.sqrt(np.mean((y - pred) ** 2)))
+    if name == "mae":
+        return float(np.mean(np.abs(y - pred)))
+    if name == "logloss":
+        p = np.clip(pred, 1e-12, 1 - 1e-12)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    if name == "error":
+        return float(np.mean((pred > 0.5) != (y > 0.5)))
+    if name == "auc":
+        order = np.argsort(pred)
+        ranks = np.empty_like(order, dtype=np.float64)
+        ranks[order] = np.arange(1, len(pred) + 1)
+        npos = float(np.sum(y > 0.5))
+        nneg = float(len(y) - npos)
+        if npos == 0 or nneg == 0:
+            return 0.5
+        return float((np.sum(ranks[y > 0.5]) - npos * (npos + 1) / 2) / (npos * nneg))
+    raise ValueError(f"unknown eval metric {name!r}")
+
+
+def metric_numerator(name: str, y: np.ndarray, pred: np.ndarray) -> float:
+    """The summable-across-shards numerator of a metric (see
+    GBDTShard.evaluate). auc has no per-shard sufficient statistic of this
+    form and is only supported on driver-side eval sets."""
+    if name == "rmse":
+        return float(np.sum((y - pred) ** 2))
+    if name == "mae":
+        return float(np.sum(np.abs(y - pred)))
+    if name == "logloss":
+        p = np.clip(pred, 1e-12, 1 - 1e-12)
+        return float(-np.sum(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    if name == "error":
+        return float(np.sum((pred > 0.5) != (y > 0.5)))
+    raise ValueError(
+        f"metric {name!r} is not shard-decomposable; evaluate it on a "
+        "driver-side eval dataset instead"
+    )
+
+
+def finish_metric(name: str, numerator: float, n: int) -> float:
+    mean = numerator / max(n, 1)
+    return float(np.sqrt(mean)) if name == "rmse" else float(mean)
+
+
+# ---------------------------------------------------------------------------
+# binning
+# ---------------------------------------------------------------------------
+
+
+def feature_minmax(X: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-feature (min, max) ignoring NaNs — the first allreduce round.
+
+    Empty shards (and all-NaN columns) return the +inf/-inf merge
+    identities so they cannot skew the global range; the driver sanitizes
+    AFTER merging across shards."""
+    with np.errstate(invalid="ignore", all="ignore"):
+        mins = np.nanmin(X, axis=0) if len(X) else np.full(X.shape[1], np.inf)
+        maxs = np.nanmax(X, axis=0) if len(X) else np.full(X.shape[1], -np.inf)
+    return (
+        np.where(np.isnan(mins), np.inf, mins),
+        np.where(np.isnan(maxs), -np.inf, maxs),
+    )
+
+
+def value_histogram(
+    X: np.ndarray, mins: np.ndarray, maxs: np.ndarray, grid: int = 1024
+) -> np.ndarray:
+    """Counts of each feature's values on a uniform micro-grid between the
+    GLOBAL min/max — mergeable across shards by plain addition, which is
+    what lets the trainer derive one set of quantile edges that every shard
+    agrees on (the sketch-merge in xgboost's approx method plays this
+    role)."""
+    n_features = X.shape[1] if X.ndim == 2 else len(mins)
+    counts = np.zeros((n_features, grid), dtype=np.int64)
+    for f in range(n_features):
+        col = X[:, f]
+        col = col[~np.isnan(col)]
+        if not len(col):
+            continue
+        span = maxs[f] - mins[f]
+        if span <= 0:
+            counts[f, 0] = len(col)
+            continue
+        idx = np.clip(((col - mins[f]) / span * grid).astype(np.int64), 0, grid - 1)
+        np.add.at(counts[f], idx, 1)
+    return counts
+
+
+def edges_from_histogram(
+    counts: np.ndarray, mins: np.ndarray, maxs: np.ndarray, max_bins: int
+) -> List[np.ndarray]:
+    """Approximate-quantile bin edges from the merged value histogram."""
+    max_bins = min(max_bins, _MAX_BINS)
+    grid = counts.shape[1]
+    edges: List[np.ndarray] = []
+    for f in range(counts.shape[0]):
+        total = counts[f].sum()
+        span = maxs[f] - mins[f]
+        if total == 0 or span <= 0:
+            edges.append(np.array([], dtype=np.float64))
+            continue
+        cum = np.cumsum(counts[f])
+        targets = np.arange(1, max_bins) * (total / max_bins)
+        cell = np.searchsorted(cum, targets)  # micro-cell holding each quantile
+        # right edge of the micro-cell, deduplicated
+        vals = mins[f] + (np.unique(cell) + 1) * (span / grid)
+        edges.append(vals[vals < maxs[f]])
+    return edges
+
+
+def prebin(X: np.ndarray, edges: Sequence[np.ndarray]) -> np.ndarray:
+    """Map raw feature values onto uint8 bin codes (NaN -> _MISSING)."""
+    n, d = X.shape
+    out = np.empty((n, d), dtype=np.uint8)
+    for f in range(d):
+        col = X[:, f]
+        codes = np.searchsorted(edges[f], col, side="left").astype(np.uint8)
+        nan_mask = np.isnan(col)
+        if nan_mask.any():
+            codes[nan_mask] = _MISSING
+        out[:, f] = codes
+    return out
+
+
+# ---------------------------------------------------------------------------
+# histogram + split finding
+# ---------------------------------------------------------------------------
+
+
+def node_histograms(
+    Xb: np.ndarray,
+    g: np.ndarray,
+    h: np.ndarray,
+    node_slot: np.ndarray,
+    n_nodes: int,
+    n_bins: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(g, h, count) histograms of shape (n_nodes, n_features, n_bins+1)
+    for every active node at once. The trailing bin is _MISSING remapped.
+    ``node_slot`` is -1 for rows already settled in a leaf."""
+    n, d = Xb.shape
+    live = node_slot >= 0
+    gh = np.zeros((2, n_nodes, d, n_bins + 1), dtype=np.float64)
+    cnt = np.zeros((n_nodes, d, n_bins + 1), dtype=np.int64)
+    if not live.any():
+        return gh[0], gh[1], cnt
+    rows = np.nonzero(live)[0]
+    slot = node_slot[rows].astype(np.int64)
+    gl, hl = g[rows], h[rows]
+    width = n_bins + 1
+    base = slot * (d * width)
+    for f in range(d):
+        codes = Xb[rows, f].astype(np.int64)
+        codes[codes == _MISSING] = n_bins
+        idx = base + f * width + codes
+        size = n_nodes * d * width
+        gh[0] += np.bincount(idx, weights=gl, minlength=size).reshape(
+            n_nodes, d, width
+        )
+        gh[1] += np.bincount(idx, weights=hl, minlength=size).reshape(
+            n_nodes, d, width
+        )
+        cnt += np.bincount(idx, minlength=size).reshape(n_nodes, d, width)
+    return gh[0], gh[1], cnt
+
+
+def best_splits(
+    g_hist: np.ndarray,
+    h_hist: np.ndarray,
+    cnt_hist: np.ndarray,
+    reg_lambda: float,
+    gamma: float,
+    min_child_weight: float,
+) -> List[Optional[Tuple[int, int, bool, float]]]:
+    """Per node: (feature, split_bin, missing_left, gain) or None.
+
+    Rows with bin <= split_bin go left; missing rows go to the side that
+    maximizes gain (xgboost's learned default direction)."""
+    n_nodes, d, width = g_hist.shape
+    out: List[Optional[Tuple[int, int, bool, float]]] = []
+    for nid in range(n_nodes):
+        G = g_hist[nid].sum()
+        H = h_hist[nid].sum()
+        parent = G * G / (H + reg_lambda)
+        best = None
+        best_gain = 0.0
+        for f in range(d):
+            gm, hm = g_hist[nid, f, -1], h_hist[nid, f, -1]  # missing bin
+            gcum = np.cumsum(g_hist[nid, f, :-1])
+            hcum = np.cumsum(h_hist[nid, f, :-1])
+            if not len(gcum):
+                continue
+            for miss_left in (False, True):
+                gl = gcum + (gm if miss_left else 0.0)
+                hl = hcum + (hm if miss_left else 0.0)
+                gr, hr = G - gl, H - hl
+                ok = (hl >= min_child_weight) & (hr >= min_child_weight)
+                gains = np.where(
+                    ok,
+                    0.5
+                    * (
+                        gl * gl / (hl + reg_lambda)
+                        + gr * gr / (hr + reg_lambda)
+                        - parent
+                    )
+                    - gamma,
+                    -np.inf,
+                )
+                k = int(np.argmax(gains))
+                if gains[k] > best_gain + 1e-12:
+                    best_gain = float(gains[k])
+                    best = (f, k, miss_left, best_gain)
+        out.append(best)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+
+class Tree:
+    """Flat-array regression tree (vectorized traversal on predict)."""
+
+    __slots__ = ("feature", "threshold", "missing_left", "left", "right", "value")
+
+    def __init__(self):
+        self.feature: List[int] = []
+        self.threshold: List[float] = []
+        self.missing_left: List[bool] = []
+        self.left: List[int] = []
+        self.right: List[int] = []
+        self.value: List[float] = []
+
+    def add_node(self) -> int:
+        self.feature.append(-1)
+        self.threshold.append(0.0)
+        self.missing_left.append(True)
+        self.left.append(-1)
+        self.right.append(-1)
+        self.value.append(0.0)
+        return len(self.feature) - 1
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        feature = np.asarray(self.feature)
+        threshold = np.asarray(self.threshold)
+        miss_left = np.asarray(self.missing_left)
+        left = np.asarray(self.left)
+        right = np.asarray(self.right)
+        value = np.asarray(self.value)
+        node = np.zeros(len(X), dtype=np.int64)
+        live = feature[node] >= 0
+        while live.any():
+            rows = np.nonzero(live)[0]
+            nd = node[rows]
+            x = X[rows, feature[nd]]
+            goes_left = np.where(np.isnan(x), miss_left[nd], x <= threshold[nd])
+            node[rows] = np.where(goes_left, left[nd], right[nd])
+            live[rows] = feature[node[rows]] >= 0
+        return value[node]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "feature": np.asarray(self.feature, dtype=np.int32),
+            "threshold": np.asarray(self.threshold, dtype=np.float64),
+            "missing_left": np.asarray(self.missing_left, dtype=bool),
+            "left": np.asarray(self.left, dtype=np.int32),
+            "right": np.asarray(self.right, dtype=np.int32),
+            "value": np.asarray(self.value, dtype=np.float64),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Tree":
+        t = cls()
+        t.feature = list(d["feature"])
+        t.threshold = list(d["threshold"])
+        t.missing_left = list(d["missing_left"])
+        t.left = list(d["left"])
+        t.right = list(d["right"])
+        t.value = list(d["value"])
+        return t
+
+
+class GBDTModel:
+    """A trained booster: bin-independent (predicts on raw floats)."""
+
+    def __init__(self, objective: str, base_score: float, trees: List[Tree], params: Dict[str, Any]):
+        self.objective = objective
+        self.base_score = base_score
+        self.trees = trees
+        self.params = params
+
+    def predict_margin(self, X: np.ndarray, num_trees: Optional[int] = None) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        out = np.full(len(X), self.base_score, dtype=np.float64)
+        for t in self.trees[: num_trees if num_trees is not None else len(self.trees)]:
+            out += t.predict(X)
+        return out
+
+    def predict(self, X: np.ndarray, num_trees: Optional[int] = None) -> np.ndarray:
+        return OBJECTIVES[self.objective].transform(self.predict_margin(X, num_trees))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "format": "ray_tpu.gbdt.v1",
+            "objective": self.objective,
+            "base_score": self.base_score,
+            "params": dict(self.params),
+            "trees": [t.to_dict() for t in self.trees],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "GBDTModel":
+        return cls(
+            d["objective"],
+            d["base_score"],
+            [Tree.from_dict(t) for t in d["trees"]],
+            d.get("params", {}),
+        )
+
+
+# ---------------------------------------------------------------------------
+# shard-side worker state (driven by GBDTDriver, locally or via actors)
+# ---------------------------------------------------------------------------
+
+
+class GBDTShard:
+    """One data shard's training state. Every method is a pure function of
+    shard data + driver-broadcast decisions, so N shards driven by the same
+    decision stream grow the same global tree as one shard holding all the
+    data (the distributed-parity contract tested in tests/test_gbdt.py)."""
+
+    def __init__(self, X: np.ndarray, y: np.ndarray, objective: str):
+        self.X = np.asarray(X, dtype=np.float64)
+        self.y = np.asarray(y, dtype=np.float64)
+        self.obj = OBJECTIVES[objective]
+        self.Xb: Optional[np.ndarray] = None
+        self.margin: Optional[np.ndarray] = None
+        self.g: Optional[np.ndarray] = None
+        self.h: Optional[np.ndarray] = None
+        self.node_slot: Optional[np.ndarray] = None
+        self._slot_nodes: List[int] = []
+        self._tree_nodes: Dict[int, Tuple[int, int, float, bool]] = {}
+
+    # -- binning rounds ----------------------------------------------------
+
+    def stat_minmax(self):
+        return feature_minmax(self.X), float(self.y.sum()), len(self.y)
+
+    def stat_value_hist(self, mins, maxs, grid: int):
+        return value_histogram(self.X, mins, maxs, grid)
+
+    def set_edges(self, edges: List[np.ndarray], base_score: float):
+        self.edges = edges
+        self.Xb = prebin(self.X, edges)
+        self.margin = np.full(len(self.X), base_score, dtype=np.float64)
+
+    def resume_margin(self, model_dict: Dict[str, Any]):
+        """Recompute margins from a restored model (checkpoint resume)."""
+        model = GBDTModel.from_dict(model_dict)
+        self.margin = model.predict_margin(self.X)
+
+    # -- per-round ---------------------------------------------------------
+
+    def begin_round(self):
+        self.g, self.h = self.obj.grad_hess(self.margin, self.y)
+        self.node_slot = np.zeros(len(self.X), dtype=np.int64)
+        self._slot_nodes = [0]
+
+    def level_histograms(self, n_bins: int):
+        return node_histograms(
+            self.Xb, self.g, self.h, self.node_slot, len(self._slot_nodes), n_bins
+        )
+
+    def apply_level(self, decisions: List[Optional[Tuple[int, int, bool, int, int]]]):
+        """decisions[slot] = (feature, split_bin, missing_left, left_slot,
+        right_slot) or None (slot becomes a leaf)."""
+        new_slot = np.full(len(self.X), -1, dtype=np.int64)
+        n_next = 0
+        for d in decisions:
+            if d is not None:
+                n_next = max(n_next, d[3] + 1, d[4] + 1)
+        for slot, d in enumerate(decisions):
+            rows = self.node_slot == slot
+            if d is None:
+                continue
+            f, split_bin, miss_left, lslot, rslot = d
+            codes = self.Xb[rows, f]
+            goes_left = np.where(
+                codes == _MISSING, miss_left, codes <= split_bin
+            )
+            idx = np.nonzero(rows)[0]
+            new_slot[idx[goes_left]] = lslot
+            new_slot[idx[~goes_left]] = rslot
+        self.node_slot = new_slot
+        self._slot_nodes = list(range(n_next))
+
+    def end_round(self, tree_dict: Dict[str, Any]):
+        """Add the finished tree's contribution to the running margin."""
+        tree = Tree.from_dict(tree_dict)
+        self.margin += tree.predict(self.X)
+
+    def evaluate(self, metrics: List[str]):
+        """Summable sufficient statistics per metric: ``(numerator_sum, n)``.
+        The driver adds them across shards and FINISHES the metric (sqrt
+        for rmse) — averaging per-shard rmse values would be wrong for any
+        non-linear metric and would make reported train metrics depend on
+        shard count."""
+        pred = self.obj.transform(self.margin)
+        return {m: (metric_numerator(m, self.y, pred), len(self.y)) for m in metrics}
+
+
+# ---------------------------------------------------------------------------
+# the driver algorithm
+# ---------------------------------------------------------------------------
+
+
+class _Caller:
+    """Uniform fan-out over local GBDTShard objects or remote actors."""
+
+    def __init__(self, handles: Sequence[Any], remote: bool):
+        self.handles = handles
+        self.remote = remote
+
+    def all(self, method: str, *args):
+        if self.remote:
+            import ray_tpu
+
+            return ray_tpu.get(
+                [getattr(h, method).remote(*args) for h in self.handles]
+            )
+        return [getattr(h, method)(*args) for h in self.handles]
+
+
+DEFAULT_PARAMS: Dict[str, Any] = {
+    "objective": "reg:squarederror",
+    "eta": 0.3,
+    "max_depth": 6,
+    "max_bins": 128,
+    "reg_lambda": 1.0,
+    "gamma": 0.0,
+    "min_child_weight": 1.0,
+}
+
+# xgboost / lightgbm spellings accepted for the same knobs
+_PARAM_ALIASES = {
+    "learning_rate": "eta",
+    "lambda": "reg_lambda",
+    "min_split_loss": "gamma",
+    "max_bin": "max_bins",
+    "num_leaves": None,  # accepted, ignored (level-wise growth)
+    "n_estimators": None,
+    "tree_method": None,
+    "nthread": None,
+    "verbosity": None,
+    "seed": None,
+    "eval_metric": None,  # handled by the trainer
+}
+
+
+def normalize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(DEFAULT_PARAMS)
+    for k, v in (params or {}).items():
+        k2 = _PARAM_ALIASES.get(k, k)
+        if k2 is None:
+            continue
+        if k2 not in DEFAULT_PARAMS:
+            continue
+        out[k2] = v
+    return out
+
+
+def train_rounds(
+    caller: _Caller,
+    params: Dict[str, Any],
+    num_boost_round: int,
+    *,
+    resume_model: Optional[Dict[str, Any]] = None,
+    on_round=None,
+    eval_metrics: Optional[List[str]] = None,
+) -> GBDTModel:
+    """Grow ``num_boost_round`` trees over the shards behind ``caller``.
+
+    One global tree per round: shards send per-node (g, h) histograms, the
+    driver sums them (the allreduce), picks splits, and broadcasts the
+    decisions back — shard count changes throughput, not the model.
+    """
+    p = normalize_params(params)
+    objective = p["objective"]
+    n_bins = int(p["max_bins"])
+    obj = OBJECTIVES[objective]
+
+    # -- binning: minmax round, merged value histogram, shared edges -------
+    stats = caller.all("stat_minmax")
+    mins = np.min([s[0][0] for s in stats], axis=0)
+    maxs = np.max([s[0][1] for s in stats], axis=0)
+    # sanitize after the merge: a feature with no finite value anywhere
+    # (every shard returned the identities) degrades to a constant column
+    mins = np.where(np.isfinite(mins), mins, 0.0)
+    maxs = np.where(np.isfinite(maxs), maxs, 0.0)
+    y_sum = float(sum(s[1] for s in stats))
+    n_total = int(sum(s[2] for s in stats))
+    hists = caller.all("stat_value_hist", mins, maxs, 1024)
+    merged = np.sum(hists, axis=0)
+    edges = edges_from_histogram(merged, mins, maxs, n_bins)
+
+    if resume_model is not None:
+        model = GBDTModel.from_dict(resume_model)
+        base_score = model.base_score
+        trees = list(model.trees)
+        caller.all("set_edges", edges, base_score)
+        caller.all("resume_margin", resume_model)
+    else:
+        base_score = obj.base_score(y_sum, n_total)
+        trees = []
+        caller.all("set_edges", edges, base_score)
+
+    max_depth = int(p["max_depth"])
+    eta = float(p["eta"])
+
+    for rnd in range(num_boost_round):
+        caller.all("begin_round")
+        tree = Tree()
+        root = tree.add_node()
+        slot_to_node = [root]
+        for _depth in range(max_depth):
+            if not slot_to_node:
+                break
+            parts = caller.all("level_histograms", n_bins)
+            g_hist = np.sum([x[0] for x in parts], axis=0)
+            h_hist = np.sum([x[1] for x in parts], axis=0)
+            c_hist = np.sum([x[2] for x in parts], axis=0)
+            splits = best_splits(
+                g_hist,
+                h_hist,
+                c_hist,
+                float(p["reg_lambda"]),
+                float(p["gamma"]),
+                float(p["min_child_weight"]),
+            )
+            decisions: List[Optional[Tuple[int, int, bool, int, int]]] = []
+            next_slots: List[int] = []
+            for slot, split in enumerate(splits):
+                nid = slot_to_node[slot]
+                if split is None:
+                    decisions.append(None)
+                    _finalize_leaf(tree, nid, g_hist[slot], h_hist[slot], p, eta)
+                    continue
+                f, split_bin, miss_left, _gain = split
+                tree.feature[nid] = f
+                tree.threshold[nid] = (
+                    float(edges[f][split_bin])
+                    if split_bin < len(edges[f])
+                    else float("inf")
+                )
+                tree.missing_left[nid] = bool(miss_left)
+                lnid, rnid = tree.add_node(), tree.add_node()
+                tree.left[nid], tree.right[nid] = lnid, rnid
+                lslot, rslot = len(next_slots), len(next_slots) + 1
+                next_slots.extend([lnid, rnid])
+                decisions.append((f, split_bin, miss_left, lslot, rslot))
+            caller.all("apply_level", decisions)
+            slot_to_node = next_slots
+        if slot_to_node:
+            # depth limit reached with splits still pending: finalize leaves
+            parts = caller.all("level_histograms", n_bins)
+            g_hist = np.sum([x[0] for x in parts], axis=0)
+            h_hist = np.sum([x[1] for x in parts], axis=0)
+            for slot, nid in enumerate(slot_to_node):
+                _finalize_leaf(tree, nid, g_hist[slot], h_hist[slot], p, eta)
+            caller.all("apply_level", [None] * len(slot_to_node))
+        td = tree.to_dict()
+        caller.all("end_round", td)
+        trees.append(tree)
+        if on_round is not None:
+            evals = None
+            if eval_metrics:
+                shard_evals = caller.all("evaluate", eval_metrics)
+                evals = {}
+                for m in eval_metrics:
+                    num = sum(e[m][0] for e in shard_evals)
+                    den = sum(e[m][1] for e in shard_evals)
+                    evals[m] = finish_metric(m, num, den)
+            on_round(rnd, GBDTModel(objective, base_score, trees, p), evals)
+    return GBDTModel(objective, base_score, trees, p)
+
+
+def _finalize_leaf(tree: Tree, nid: int, g_node: np.ndarray, h_node: np.ndarray, p, eta: float):
+    # node totals are the same summed over any one feature's bins
+    G = g_node[0].sum()
+    H = h_node[0].sum()
+    tree.value[nid] = float(-eta * G / (H + float(p["reg_lambda"])))

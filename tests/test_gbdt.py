@@ -1,0 +1,209 @@
+"""GBDT + sklearn trainers: engine quality, distributed parity, resume.
+
+(reference surfaces: python/ray/train/tests/test_gbdt_trainer.py,
+test_xgboost_trainer.py, test_sklearn_trainer.py — quality thresholds and
+the shard-count-invariance contract of histogram-allreduce boosting.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.train import RunConfig, ScalingConfig
+from ray_tpu.train.batch_predictor import BatchPredictor
+from ray_tpu.train.gbdt_model import GBDTModel, GBDTShard, _Caller, train_rounds
+from ray_tpu.train.gbdt_trainer import (
+    GBDTPredictor,
+    SklearnPredictor,
+    SklearnTrainer,
+    XGBoostTrainer,
+)
+
+
+def _make_regression(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5))
+    y = (
+        2.0 * X[:, 0]
+        + np.sin(3 * X[:, 1])
+        + (X[:, 2] > 0.3) * 1.5
+        + 0.05 * rng.normal(size=n)
+    )
+    return X, y
+
+
+def _make_classification(n=2000, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    logit = 1.5 * X[:, 0] - 2.0 * X[:, 1] * X[:, 0] + X[:, 3]
+    y = (logit + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _local_train(X, y, params, rounds, resume=None):
+    shard = GBDTShard(X, y, params.get("objective", "reg:squarederror"))
+    return train_rounds(
+        _Caller([shard], remote=False), params, rounds, resume_model=resume
+    )
+
+
+def test_engine_regression_quality():
+    X, y = _make_regression()
+    model = _local_train(
+        X, y, {"objective": "reg:squarederror", "eta": 0.2, "max_depth": 4}, 40
+    )
+    pred = model.predict(X)
+    r2 = 1 - np.sum((y - pred) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert r2 > 0.95, f"R^2={r2}"
+
+
+def test_engine_classification_quality():
+    X, y = _make_classification()
+    model = _local_train(
+        X, y, {"objective": "binary:logistic", "eta": 0.3, "max_depth": 4}, 30
+    )
+    pred = model.predict(X)
+    assert ((pred > 0.5) == (y > 0.5)).mean() > 0.93
+    # probabilities, not margins
+    assert pred.min() >= 0.0 and pred.max() <= 1.0
+
+
+def test_engine_handles_missing_values():
+    X, y = _make_regression(800)
+    rng = np.random.default_rng(3)
+    X[rng.random(X.shape) < 0.2] = np.nan
+    model = _local_train(X, y, {"eta": 0.3, "max_depth": 4}, 20)
+    pred = model.predict(X)
+    r2 = 1 - np.sum((y - pred) ** 2) / np.sum((y - y.mean()) ** 2)
+    assert np.isfinite(pred).all()
+    assert r2 > 0.6, f"R^2={r2}"
+
+
+def test_distributed_parity_local():
+    """The histogram-allreduce contract: N shards grow the same trees as 1."""
+    X, y = _make_regression(1200, seed=7)
+    params = {"eta": 0.3, "max_depth": 4, "max_bins": 64}
+    one = _local_train(X, y, params, 8)
+    shards = [
+        GBDTShard(X[i::3], y[i::3], "reg:squarederror") for i in range(3)
+    ]
+    three = train_rounds(_Caller(shards, remote=False), params, 8)
+    Xt = _make_regression(200, seed=9)[0]
+    np.testing.assert_allclose(one.predict(Xt), three.predict(Xt), rtol=1e-8)
+
+
+def test_model_serialization_roundtrip():
+    X, y = _make_regression(500)
+    model = _local_train(X, y, {"max_depth": 3}, 5)
+    back = GBDTModel.from_dict(model.to_dict())
+    np.testing.assert_array_equal(model.predict(X), back.predict(X))
+
+
+def test_xgboost_trainer_distributed(ray_start_regular, tmp_path):
+    X, y = _make_regression(1600, seed=11)
+    ds = rd.from_numpy(
+        {"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2], "f3": X[:, 3], "f4": X[:, 4], "target": y},
+        parallelism=4,
+    )
+    Xv, yv = _make_regression(300, seed=12)
+    valid = rd.from_numpy(
+        {"f0": Xv[:, 0], "f1": Xv[:, 1], "f2": Xv[:, 2], "f3": Xv[:, 3], "f4": Xv[:, 4], "target": yv},
+        parallelism=1,
+    )
+    trainer = XGBoostTrainer(
+        datasets={"train": ds, "valid": valid},
+        label_column="target",
+        params={"objective": "reg:squarederror", "eta": 0.3, "max_depth": 4},
+        num_boost_round=12,
+        checkpoint_frequency=4,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    hist = result.metrics_history
+    assert len(hist) == 12
+    # training loss must descend materially
+    assert hist[-1]["train-rmse"] < 0.5 * hist[0]["train-rmse"]
+    assert "valid-rmse" in hist[-1]
+
+    # distributed training == local training on the gathered data
+    model = XGBoostTrainer.get_model(result.checkpoint)
+    local = _local_train(
+        X, y, {"objective": "reg:squarederror", "eta": 0.3, "max_depth": 4}, 12
+    )
+    np.testing.assert_allclose(model.predict(Xv), local.predict(Xv), rtol=1e-6)
+
+    # BatchPredictor integration
+    bp = BatchPredictor.from_checkpoint(result.checkpoint, GBDTPredictor)
+    out = bp.predict(valid, batch_size=128, num_actors=2)
+    preds = np.concatenate(
+        [b["predictions"] for b in out.iter_batches(batch_size=None)]
+    )
+    np.testing.assert_allclose(
+        np.sort(preds), np.sort(model.predict(Xv)), rtol=1e-6
+    )
+
+
+def test_gbdt_resume_from_checkpoint(ray_start_regular, tmp_path):
+    X, y = _make_regression(800, seed=21)
+    cols = {f"f{i}": X[:, i] for i in range(5)}
+    cols["target"] = y
+    ds = rd.from_numpy(cols, parallelism=2)
+
+    def run(rounds, resume=None, path="a"):
+        t = XGBoostTrainer(
+            datasets={"train": ds},
+            label_column="target",
+            params={"eta": 0.3, "max_depth": 3},
+            num_boost_round=rounds,
+            checkpoint_frequency=rounds,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(storage_path=str(tmp_path / path)),
+            resume_from_checkpoint=resume,
+        )
+        return t.fit()
+
+    first = run(4, path="a")
+    resumed = run(4, resume=first.checkpoint, path="b")
+    straight = run(8, path="c")
+    m_resumed = XGBoostTrainer.get_model(resumed.checkpoint)
+    m_straight = XGBoostTrainer.get_model(straight.checkpoint)
+    assert len(m_resumed.trees) == 8
+    np.testing.assert_allclose(
+        m_resumed.predict(X), m_straight.predict(X), rtol=1e-8
+    )
+
+
+def test_lightgbm_dialect():
+    X, y = _make_classification(900, seed=5)
+    model = _local_train(
+        X, y, {"objective": "binary", "learning_rate": 0.3, "max_depth": 4}, 15
+    )
+    assert ((model.predict(X) > 0.5) == (y > 0.5)).mean() > 0.9
+
+
+def test_sklearn_trainer(ray_start_regular, tmp_path):
+    from sklearn.ensemble import RandomForestRegressor
+
+    X, y = _make_regression(600, seed=31)
+    cols = {f"f{i}": X[:, i] for i in range(5)}
+    cols["target"] = y
+    ds = rd.from_numpy(cols, parallelism=2)
+    trainer = SklearnTrainer(
+        estimator=RandomForestRegressor(n_estimators=20, random_state=0),
+        datasets={"train": ds},
+        label_column="target",
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.metrics["train-score"] > 0.9
+    est = SklearnTrainer.get_model(result.checkpoint)
+    assert est.predict(X[:10]).shape == (10,)
+
+    bp = BatchPredictor.from_checkpoint(result.checkpoint, SklearnPredictor)
+    out = bp.predict(ds, batch_size=200, num_actors=1, feature_columns=[f"f{i}" for i in range(5)])
+    n = sum(len(b["predictions"]) for b in out.iter_batches(batch_size=None))
+    assert n == 600
