@@ -68,6 +68,10 @@ class _Config:
         "memory_monitor_period_s": 1.0,
         # --- health / fault tolerance ---
         "health_check_period_s": 1.0,
+        # GCS->raylet resource-view gossip cadence (the ray_syncer
+        # rebroadcast half); raylets spill from this cache when it is
+        # younger than 3 periods
+        "resource_broadcast_period_s": 0.5,
         "health_check_failure_threshold": 5,
         "task_max_retries_default": 3,
         "actor_max_restarts_default": 0,
@@ -86,6 +90,11 @@ class _Config:
         # dispatch pool size per RpcServer: large enough that long-poll
         # handlers (store gets, lease waits) cannot starve control traffic
         "rpc_dispatch_threads": 128,
+        # C++ transport (native/rpc_core.cc): epoll + frame reassembly +
+        # buffered sends without the GIL; falls back to the pure-Python
+        # poller when the lib can't build (RAYTPU_RPC_NATIVE_TRANSPORT=0
+        # forces the fallback)
+        "rpc_native_transport": True,
         # --- task events / observability ---
         "task_events_enabled": True,
         "log_to_driver": True,  # stream worker stdout/stderr to the driver

@@ -153,6 +153,10 @@ class GcsServer:
             target=self._health_loop, name="gcs-health", daemon=True
         )
         self._health_thread.start()
+        self._resource_bcast_thread = threading.Thread(
+            target=self._resource_broadcast_loop, name="gcs-resync", daemon=True
+        )
+        self._resource_bcast_thread.start()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -388,6 +392,26 @@ class GcsServer:
             "store_capacity": n.store_capacity,
             "demand": list(n.pending_demand),
         }
+
+    def _resource_broadcast_loop(self):
+        """Bidirectional resource sync, GCS->raylet half: rebroadcast the
+        aggregated per-node resource view to every subscribed raylet on a
+        bounded-staleness cadence (reference: common/ray_syncer/
+        ray_syncer.h:39 — raylets push their view up via heartbeats, the
+        syncer fans the merged view back down). Raylets then make spillback
+        decisions from the gossiped cache instead of a synchronous
+        get_nodes RPC per decision."""
+        period = GlobalConfig.resource_broadcast_period_s
+        while not self._stopped.wait(period):
+            with self._lock:
+                if not self._subscribers.get("resource_view"):
+                    continue
+                views = [
+                    self._node_view(n)
+                    for n in self._nodes.values()
+                    if n.alive
+                ]
+            self._publish("resource_view", {"ts": time.time(), "nodes": views})
 
     def _health_loop(self):
         period = GlobalConfig.health_check_period_s

@@ -329,11 +329,20 @@ class Raylet:
         self._stopped = threading.Event()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
-        self.gcs = RpcClient(gcs_address)
+        # the gossiped cluster resource view (GCS resource_view channel);
+        # spillback decisions read this cache instead of a synchronous
+        # get_nodes RPC per decision (reference: ray_syncer.h:39 — the
+        # NodeResourceInfo downstream half)
+        self._peer_view: Dict[str, Any] = {"at": 0.0, "nodes": []}
+        self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
         self.gcs.call(
             "register_node",
             (self.node_id, self.server.address, self.total_resources, self.labels),
         )
+        try:
+            self.gcs.call("subscribe", "resource_view", timeout=5.0)
+        except Exception:
+            pass  # older GCS: spillback falls back to get_nodes
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
         # memory monitor: kill the newest-leased worker under node memory
@@ -417,7 +426,23 @@ class Raylet:
         # booting an interpreter (~2 s). TPU workers keep the Popen path —
         # the template pinned JAX_PLATFORMS=cpu at its own import time — and
         # pip envs need a different interpreter entirely.
-        if GlobalConfig.worker_forkserver and not tpu and not renv.get("pip"):
+        from ray_tpu._private.runtime_env_plugins import (
+            apply_plugins,
+            check_fields_known,
+            plugin_fields,
+        )
+
+        # a field with no plugin registered IN THIS PROCESS fails the spawn
+        # loudly (the driver validated against ITS registry; silently
+        # dropping the field here would hand out a worker missing its env)
+        check_fields_known(renv)
+        needs_plugin = any(renv.get(f) is not None for f in plugin_fields())
+        if (
+            GlobalConfig.worker_forkserver
+            and not tpu
+            and not renv.get("pip")
+            and not needs_plugin
+        ):
             try:
                 proc = ForkServer.get(self.session_dir).fork_worker(
                     overrides, log_path, cwd, env_paths
@@ -464,10 +489,15 @@ class Raylet:
                 list(renv["pip"]),
                 renv.get("pip_find_links"),
             )
+        argv = [interpreter, "-m", "ray_tpu._private.default_worker"]
+        if needs_plugin:
+            # conda swaps the interpreter, container wraps the command
+            # (reference: _private/runtime_env/plugin.py dispatch)
+            env, argv = apply_plugins(renv, self.session_dir, env, argv)
         logfile = open(log_path, "ab")
         try:
             proc = subprocess.Popen(
-                [interpreter, "-m", "ray_tpu._private.default_worker"],
+                argv,
                 env=env,
                 cwd=cwd,
                 stdout=logfile,
@@ -532,15 +562,29 @@ class Raylet:
     # leases (two-level scheduling: callers lease workers from this node)
     # ------------------------------------------------------------------
 
+    def _on_gcs_notify(self, channel: str, message: Any):
+        if channel == "resource_view":
+            self._peer_view = {
+                "at": time.monotonic(),
+                "nodes": message.get("nodes") or [],
+            }
+
     def _find_spill_node(
         self, resources: Dict[str, float], against: str
     ) -> Optional[Tuple[str, int]]:
-        """Ask the GCS resource view for another node that fits the request
-        (the reference's spillback reply, direct_task_transport.cc:501)."""
-        try:
-            nodes = self.gcs.call("get_nodes", timeout=5.0)
-        except Exception:
-            return None
+        """Pick another node that fits the request, preferring the gossiped
+        resource view (bounded staleness <= 3 broadcast periods) over a
+        synchronous GCS round-trip (the reference's spillback reply,
+        direct_task_transport.cc:501, fed by the ray_syncer view)."""
+        view = self._peer_view
+        max_age = GlobalConfig.resource_broadcast_period_s * 3
+        if view["nodes"] and time.monotonic() - view["at"] <= max_age:
+            nodes = view["nodes"]
+        else:
+            try:
+                nodes = self.gcs.call("get_nodes", timeout=5.0)
+            except Exception:
+                return None
         best = None
         best_slack = None
         for n in nodes:
@@ -939,13 +983,19 @@ class Raylet:
                 return
             # connection to the GCS lost: reconnect and re-register
             try:
-                new_client = RpcClient(self.gcs_address)
+                new_client = RpcClient(
+                    self.gcs_address, on_notify=self._on_gcs_notify
+                )
                 old, self.gcs = self.gcs, new_client
                 try:
                     old.close()
                 except Exception:
                     pass
                 self._register_with_gcs()
+                try:
+                    self.gcs.call("subscribe", "resource_view", timeout=5.0)
+                except Exception:
+                    pass
                 logger.info(
                     "node %s reconnected to restarted GCS", self.node_id.hex()[:8]
                 )

@@ -328,58 +328,7 @@ class _SendState:
         self.sock = sock
 
     def send_frame(self, obj: Any):
-        kind, msg_id, method, payload_obj = obj
-        if kind == AUTH:
-            # raw bytes — the peer must be able to verify the token without
-            # running any unpickler on attacker-reachable input
-            data = (
-                payload_obj.encode()
-                if isinstance(payload_obj, str)
-                else bytes(payload_obj or b"")
-            )
-            parts = [_HEADER.pack(_MAGIC, _WIRE_VERSION, kind, len(data)), data]
-        else:
-            bufs: list = []
-
-            def _cb(pb: pickle.PickleBuffer):
-                v = pb.raw()
-                if v.nbytes >= _OOB_MIN_BYTES and v.contiguous:
-                    bufs.append(v.cast("B"))
-                    return False  # ship raw, out-of-band
-                return True  # small/strided: in-band
-
-            meta = pickle.dumps(
-                (msg_id, method, payload_obj), protocol=5, buffer_callback=_cb
-            )
-            total = _U32.size + len(meta) + sum(
-                _U32.size + b.nbytes for b in bufs
-            )
-            parts = [
-                _HEADER.pack(_MAGIC, _WIRE_VERSION, kind, total),
-                _U32.pack(len(meta)),
-                meta,
-            ]
-            for b in bufs:
-                parts.append(_U32.pack(b.nbytes))
-                parts.append(b)
-            # coalesce adjacent small parts into single sends: header+meta
-            # must leave as ONE segment (tiny writes each become a TCP
-            # segment under NODELAY), and per-part syscalls add up; only
-            # large out-of-band buffers are worth sending from their own
-            # memory without a copy
-            merged: list = []
-            run: list = []
-            for p in parts:
-                if isinstance(p, memoryview) and p.nbytes > 256 * 1024:
-                    if run:
-                        merged.append(b"".join(run))
-                        run = []
-                    merged.append(p)
-                else:
-                    run.append(bytes(p) if isinstance(p, memoryview) else p)
-            if run:
-                merged.append(b"".join(run))
-            parts = merged
+        parts = _encode_frame_parts(obj)
         with self.lock:
             if self.buf:
                 for p in parts:
@@ -443,6 +392,244 @@ class _SendState:
 # ---------------------------------------------------------------------------
 # the process-wide poller
 # ---------------------------------------------------------------------------
+#
+# Two interchangeable transports demultiplex every RPC socket in the
+# process:
+#   - _NativePoller: the C++ event loop (native/rpc_core.cc) owns the fds —
+#     epoll, recv, frame reassembly, buffered nonblocking sends all run
+#     without the GIL; ONE Python pump thread drains complete frames in
+#     batches. This is the reference's C++ gRPC-core split (grpc_server.h:
+#     completion queues in C++, application sees whole messages).
+#   - _Poller: the pure-Python selector loop (fallback when the native lib
+#     can't build, and the reference implementation for tests).
+# Both expose register/unregister/watch_write + attach() returning a sender
+# whose send_frame speaks the same v3 wire format, so peers mix freely.
+
+
+def _get_poller():
+    if GlobalConfig.rpc_native_transport:
+        p = _NativePoller.get()
+        if p is not None:
+            return p
+    return _Poller.get()
+
+
+def _encode_frame_parts(obj) -> list:
+    """Encode (kind, msg_id, method, payload) into wire parts: the shared
+    frame codec for both senders. Small parts are pre-joined; large
+    out-of-band buffers stay as their own memoryviews (no copy)."""
+    kind, msg_id, method, payload_obj = obj
+    if kind == AUTH:
+        data = (
+            payload_obj.encode()
+            if isinstance(payload_obj, str)
+            else bytes(payload_obj or b"")
+        )
+        return [_HEADER.pack(_MAGIC, _WIRE_VERSION, kind, len(data)) + data]
+    bufs: list = []
+
+    def _cb(pb: pickle.PickleBuffer):
+        v = pb.raw()
+        if v.nbytes >= _OOB_MIN_BYTES and v.contiguous:
+            bufs.append(v.cast("B"))
+            return False  # ship raw, out-of-band
+        return True  # small/strided: in-band
+
+    meta = pickle.dumps(
+        (msg_id, method, payload_obj), protocol=5, buffer_callback=_cb
+    )
+    total = _U32.size + len(meta) + sum(_U32.size + b.nbytes for b in bufs)
+    parts = [
+        _HEADER.pack(_MAGIC, _WIRE_VERSION, kind, total),
+        _U32.pack(len(meta)),
+        meta,
+    ]
+    for b in bufs:
+        parts.append(_U32.pack(b.nbytes))
+        parts.append(b)
+    # coalesce adjacent small parts: header+meta must leave as one segment
+    merged: list = []
+    run: list = []
+    for p in parts:
+        if isinstance(p, memoryview) and p.nbytes > 256 * 1024:
+            if run:
+                merged.append(b"".join(run))
+                run = []
+            merged.append(p)
+        else:
+            run.append(bytes(p) if isinstance(p, memoryview) else p)
+    if run:
+        merged.append(b"".join(run))
+    return merged
+
+
+class _NativeSendState:
+    """Sender backed by the C++ loop: encode the frame, hand the scatter
+    list to the extension's sendv (atomic per frame; partial writes are
+    buffered in C++ and flushed by the loop on EPOLLOUT). The extension
+    takes the buffer protocol directly — out-of-band memoryviews ship with
+    zero copies."""
+
+    __slots__ = ("_poller", "_cid", "stream")
+
+    def __init__(self, poller: "_NativePoller", cid: int, stream: Any):
+        self._poller = poller
+        self._cid = cid
+        self.stream = stream
+
+    def send_frame(self, obj: Any):
+        rc = self._poller.loop.sendv(self._cid, _encode_frame_parts(obj))
+        if rc == 0:
+            return
+        if rc == -3:
+            err = ConnectionLost("peer not draining (send buffer overflow)")
+            self._poller.unregister_cid(self._cid)
+            try:
+                self.stream.on_closed(err)
+            except Exception:
+                pass
+            raise err
+        # -2 (hard send error): the C++ loop queued a dead-notice, so the
+        # pump delivers on_closed to every other waiter; this caller gets
+        # the exception directly. -1 (unknown conn): already unregistered.
+        raise ConnectionLost(f"connection closed (rc={rc})")
+
+    def on_writable(self):  # pragma: no cover - python-poller interface only
+        return True
+
+
+class _NativePoller:
+    """C++ transport front-end: registration table + the pump thread that
+    drains packed event records from rt_loop_poll and dispatches frames to
+    streams exactly like the Python poller does (same thread discipline:
+    one thread, per-connection arrival order)."""
+
+    _instance: Optional["_NativePoller"] = None
+    _failed = False
+    _ilock = threading.Lock()
+    _POLL_BUF = 8 * 1024 * 1024
+
+    @classmethod
+    def get(cls) -> Optional["_NativePoller"]:
+        with cls._ilock:
+            if cls._failed:
+                return None
+            if cls._instance is None or not cls._instance._thread.is_alive():
+                try:
+                    cls._instance = cls()
+                except Exception:
+                    cls._failed = True  # build/toolchain issue: fall back
+                    return None
+            return cls._instance
+
+    def __init__(self):
+        from ray_tpu.native import rpc_native
+
+        self.loop = rpc_native.load().loop_new(GlobalConfig.rpc_max_frame_bytes)
+        self._streams: Dict[int, Any] = {}
+        self._cid_by_sock: Dict[int, int] = {}  # id(sock) -> cid
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._pump, name="rpc-npoller", daemon=True
+        )
+        self._thread.start()
+
+    # -- registration ---------------------------------------------------
+
+    def attach(self, sock: socket.socket, stream: Any):
+        """Take ownership of the socket's fd; returns the stream's sender.
+
+        The stream's ``sender`` and ``_poller`` are installed BEFORE the fd
+        is armed in the loop: the moment rt_loop_add succeeds the pump may
+        deliver a frame whose handler replies through ``stream.sender`` — a
+        stale Python sender over the now-detached socket would EBADF and
+        silently drop the reply."""
+        sock.setblocking(False)
+        cid = next(self._ids)
+        sender = _NativeSendState(self, cid, stream)
+        stream.sender = sender
+        stream._poller = self
+        with self._lock:
+            self._streams[cid] = stream
+            self._cid_by_sock[id(sock)] = cid
+        fd = sock.detach()
+        if self.loop.add(cid, fd) != 0:
+            import os as _os
+
+            try:
+                _os.close(fd)
+            except OSError:
+                pass
+            with self._lock:
+                self._streams.pop(cid, None)
+                self._cid_by_sock.pop(id(sock), None)
+            raise ConnectionLost("native loop rejected connection")
+        return sender
+
+    # python-poller-compatible surface ---------------------------------
+
+    def register(self, sock: socket.socket, stream: Any):
+        # attach() is the native path; register() exists only so code
+        # written against the python poller keeps working
+        stream.sender = self.attach(sock, stream)
+
+    def unregister(self, sock: socket.socket):
+        with self._lock:
+            cid = self._cid_by_sock.pop(id(sock), None)
+        if cid is not None:
+            self.unregister_cid(cid, _pop_sock=False)
+
+    def unregister_cid(self, cid: int, _pop_sock: bool = True):
+        with self._lock:
+            self._streams.pop(cid, None)
+            if _pop_sock:
+                for k, v in list(self._cid_by_sock.items()):
+                    if v == cid:
+                        del self._cid_by_sock[k]
+                        break
+        self.loop.remove(cid)
+
+    def watch_write(self, sock: socket.socket, stream: Any):
+        pass  # the C++ loop arms EPOLLOUT itself
+
+    # -- the pump -------------------------------------------------------
+
+    def _pump(self):
+        loop = self.loop
+        streams = self._streams
+        while True:
+            events = loop.poll(1000)
+            if events is None:
+                return
+            for cid, kind, payload in events:
+                with self._lock:
+                    stream = streams.get(cid)
+                if stream is None:
+                    continue
+                if kind >= 0:
+                    self._deliver(cid, stream, kind, payload)
+                else:  # closed by the C++ loop (fd already shut)
+                    self.unregister_cid(cid)
+                    try:
+                        stream.on_closed(ConnectionLost(payload or "closed"))
+                    except Exception:
+                        pass
+
+    def _deliver(self, cid: int, stream: Any, wire_kind: int, body: bytes):
+        try:
+            stream._on_frame(wire_kind, body)
+        except Exception as e:  # stream is dead (auth refusal, protocol)
+            self.unregister_cid(cid)
+            exc = (
+                e
+                if isinstance(e, ConnectionLost)
+                else ConnectionLost(f"{type(e).__name__}: {e}")
+            )
+            try:
+                stream.on_closed(exc)
+            except Exception:
+                pass
 
 
 class _Poller:
@@ -712,6 +899,7 @@ class ServerConn:
         self.meta: Dict[str, Any] = {}  # handler-attached state (e.g. worker id)
         self._server = server
         self._frames = _FrameBuffer()
+        self._poller = None  # set when the native transport owns the fd
         self.sender = _SendState(sock, self)
 
     # -- poller interface ----------------------------------------------
@@ -777,6 +965,8 @@ class ServerConn:
 
     def close(self):
         self.closed.set()
+        if self._poller is not None:
+            self._poller.unregister(self.sock)  # closes the fd in the loop
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -901,7 +1091,16 @@ class RpcServer:
             conn = ServerConn(sock, addr, self)
             with self._conns_lock:
                 self._conns[id(conn)] = conn
-            _Poller.get().register(sock, conn)
+            poller = _get_poller()
+            if isinstance(poller, _NativePoller):
+                try:
+                    poller.attach(sock, conn)  # installs conn.sender itself
+                except ConnectionLost:
+                    with self._conns_lock:
+                        self._conns.pop(id(conn), None)
+                    continue
+            else:
+                poller.register(sock, conn)
 
     def _run_disconnect(self, conn: ServerConn):
         try:
@@ -967,7 +1166,8 @@ class RpcServer:
         with self._conns_lock:
             conns = list(self._conns.values())
         for c in conns:
-            _Poller.get().unregister(c.sock)
+            if c._poller is None:
+                _Poller.get().unregister(c.sock)
             c.close()
         self._pool.shutdown(wait=False)
 
@@ -1019,7 +1219,11 @@ class RpcClient:
         self._frames = _FrameBuffer()
         self._notify_q: deque = deque()
         self._notify_draining = False
-        _Poller.get().register(self._sock, self)
+        self._poller = _get_poller()
+        if isinstance(self._poller, _NativePoller):
+            self.sender = self._poller.attach(self._sock, self)
+        else:
+            self._poller.register(self._sock, self)
         if session_token() is not None:
             # first frame on the wire: prove session membership
             self.sender.send_frame((AUTH, 0, "", session_token()))
@@ -1144,7 +1348,7 @@ class RpcClient:
         return self._closed.is_set()
 
     def close(self):
-        _Poller.get().unregister(self._sock)
+        self._poller.unregister(self._sock)
         was_closed = self._closed.is_set()
         try:
             self._sock.close()

@@ -164,6 +164,13 @@ def resolve_runtime_env(
             out["pip_find_links"] = os.path.abspath(
                 os.path.expanduser(str(runtime_env["pip_find_links"]))
             )
+    # plugin-owned fields (conda/container/registered plugins) pass through
+    # verbatim: their setup runs node-side at worker-spawn time
+    for key, value in runtime_env.items():
+        if key not in out and key not in (
+            "env_vars", "working_dir", "py_modules", "pip", "pip_find_links"
+        ):
+            out[key] = value
     _env_memo[memo_key] = (now, out)
     return out
 
@@ -189,6 +196,16 @@ def runtime_env_key(runtime_env: Optional[Dict[str, Any]]) -> tuple:
         key.append(("pip", tuple(runtime_env["pip"])))
         if runtime_env.get("pip_find_links"):
             key.append(("pipfl", str(runtime_env["pip_find_links"])))
+    # plugin-owned fields (conda/container/...) pool by value hash too —
+    # a conda-env worker must never serve a bare-env lease
+    try:
+        from ray_tpu._private.runtime_env_plugins import _value_key, plugin_fields
+
+        for field in plugin_fields():
+            if runtime_env.get(field) is not None:
+                key.append(_value_key(field, runtime_env[field]))
+    except ImportError:  # pragma: no cover - bootstrap ordering
+        pass
     return tuple(key)
 
 

@@ -1,0 +1,251 @@
+"""runtime_env plugin registry + conda / container plugins.
+
+Reference: python/ray/_private/runtime_env/plugin.py (the RuntimeEnvPlugin
+interface + per-field plugin dispatch), conda.py (conda env create/reuse
+keyed by spec hash), container.py (worker command wrapped in a container
+runtime). The built-in fields (env_vars / working_dir / py_modules / pip)
+stay hard-wired in raylet._spawn_worker for the hot path; this registry
+handles the long tail: each plugin owns one runtime_env key and can
+
+  - ``setup(value, session_dir) -> context``   (once per node per value)
+  - ``modify_worker(context, env, argv) -> (env, argv)``
+
+so a plugin can inject env vars, swap the interpreter (conda) or wrap the
+whole worker command (container) without raylet changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import subprocess
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_plugins: Dict[str, "RuntimeEnvPlugin"] = {}
+
+
+class RuntimeEnvPlugin:
+    """One plugin per runtime_env key (reference: runtime_env/plugin.py)."""
+
+    #: the runtime_env field this plugin consumes
+    name: str = ""
+    #: plugins sort by priority when several modify the same worker
+    priority: int = 50
+
+    def setup(self, value: Any, session_dir: str) -> Any:
+        """Prepare node-local state (create env, pull image); returns a
+        context object passed to modify_worker. Runs once per distinct
+        value per node (cached by value hash)."""
+        return value
+
+    def modify_worker(
+        self,
+        context: Any,
+        env: Dict[str, str],
+        argv: List[str],
+        runtime_env: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[Dict[str, str], List[str]]:
+        return env, argv
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> RuntimeEnvPlugin:
+    if not plugin.name:
+        raise ValueError("plugin must set .name")
+    _plugins[plugin.name] = plugin
+    return plugin
+
+
+def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
+    return _plugins.get(name)
+
+
+def plugin_fields() -> List[str]:
+    return list(_plugins)
+
+
+_setup_cache: Dict[Tuple[str, str], Any] = {}
+
+
+def _value_key(name: str, value: Any) -> Tuple[str, str]:
+    return name, hashlib.sha256(
+        json.dumps(value, sort_keys=True, default=str).encode()
+    ).hexdigest()[:16]
+
+
+def apply_plugins(
+    runtime_env: Dict[str, Any],
+    session_dir: str,
+    env: Dict[str, str],
+    argv: List[str],
+) -> Tuple[Dict[str, str], List[str]]:
+    """Run every registered plugin whose key appears in runtime_env.
+    Called by raylet._spawn_worker for the Popen path."""
+    active = sorted(
+        (p for name, p in _plugins.items() if runtime_env.get(name) is not None),
+        key=lambda p: p.priority,
+    )
+    for plugin in active:
+        value = runtime_env[plugin.name]
+        key = _value_key(plugin.name, value)
+        if key not in _setup_cache:
+            _setup_cache[key] = plugin.setup(value, session_dir)
+        try:
+            env, argv = plugin.modify_worker(
+                _setup_cache[key], env, argv, runtime_env=runtime_env
+            )
+        except TypeError:  # older plugin signature without runtime_env
+            env, argv = plugin.modify_worker(_setup_cache[key], env, argv)
+    return env, argv
+
+
+#: runtime_env fields the raylet handles without the plugin registry
+BUILTIN_FIELDS = frozenset(
+    {"env_vars", "working_dir", "py_modules", "pip", "pip_find_links"}
+)
+
+
+def check_fields_known(runtime_env: Dict[str, Any]) -> None:
+    """Raise if runtime_env carries a field neither built-in nor owned by a
+    plugin registered IN THIS PROCESS. The driver validates against its own
+    registry; a raylet that never imported the user's plugin module must
+    fail the spawn loudly rather than silently drop the field (plugins
+    must be importable on every node, as in the reference's plugin-class
+    path contract, runtime_env/plugin.py)."""
+    unknown = set(runtime_env or ()) - BUILTIN_FIELDS - set(_plugins)
+    if unknown:
+        raise RuntimeError(
+            f"runtime_env fields {sorted(unknown)} have no registered plugin "
+            "on this node (register_plugin must run in every node process, "
+            "e.g. from an imported module or sitecustomize)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# conda
+# ---------------------------------------------------------------------------
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """``runtime_env={"conda": "env-name" | {spec-dict}}`` (reference:
+    runtime_env/conda.py): a named env reuses an existing conda env; a spec
+    dict creates one per hash under the session dir. The worker's
+    interpreter becomes the env's python."""
+
+    name = "conda"
+    priority = 20  # interpreter swap happens before wrappers
+
+    def _conda_exe(self) -> Optional[str]:
+        return shutil.which("conda") or shutil.which("mamba")
+
+    def setup(self, value: Any, session_dir: str) -> Dict[str, Any]:
+        conda = self._conda_exe()
+        if conda is None:
+            raise RuntimeError(
+                'runtime_env={"conda": ...} requires a conda/mamba binary '
+                "on PATH (not present in this image; use pip envs instead)"
+            )
+        if isinstance(value, str):
+            # named, pre-existing env
+            info = subprocess.run(
+                [conda, "env", "list", "--json"],
+                capture_output=True, text=True, check=True,
+            )
+            for prefix in json.loads(info.stdout).get("envs", []):
+                if os.path.basename(prefix) == value:
+                    return {"prefix": prefix}
+            raise RuntimeError(f"conda env {value!r} not found")
+        spec_hash = hashlib.sha256(
+            json.dumps(value, sort_keys=True).encode()
+        ).hexdigest()[:12]
+        prefix = os.path.join(session_dir, "runtime_envs", f"conda-{spec_hash}")
+        if not os.path.exists(os.path.join(prefix, "bin", "python")):
+            spec_file = prefix + ".yml"
+            os.makedirs(os.path.dirname(prefix), exist_ok=True)
+            with open(spec_file, "w") as f:
+                json.dump(value, f)
+            subprocess.run(
+                [conda, "env", "create", "--prefix", prefix, "--file", spec_file],
+                check=True, capture_output=True,
+            )
+        return {"prefix": prefix}
+
+    def modify_worker(self, context, env, argv, runtime_env=None):
+        python = os.path.join(context["prefix"], "bin", "python")
+        env = dict(env)
+        env["CONDA_PREFIX"] = context["prefix"]
+        env["PATH"] = os.path.join(context["prefix"], "bin") + os.pathsep + env.get("PATH", "")
+        # argv[0] is the interpreter (raylet builds [python, -m, worker])
+        return env, [python, *argv[1:]]
+
+
+# ---------------------------------------------------------------------------
+# container
+# ---------------------------------------------------------------------------
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    """``runtime_env={"container": {"image": ..., "run_options": [...]}}``
+    (reference: runtime_env/container.py): wrap the worker command in a
+    container runtime (podman/docker), bind-mounting the session dir so
+    logs/sockets work. The runtime binary is injectable for tests."""
+
+    name = "container"
+    priority = 90  # outermost wrapper
+
+    def __init__(self, runtime: Optional[str] = None):
+        self._runtime = runtime
+
+    def setup(self, value: Any, session_dir: str) -> Dict[str, Any]:
+        if not isinstance(value, dict) or "image" not in value:
+            raise ValueError('container runtime_env needs {"image": ...}')
+        runtime = (
+            self._runtime
+            or value.get("runtime")
+            or shutil.which("podman")
+            or shutil.which("docker")
+        )
+        if runtime is None:
+            raise RuntimeError(
+                "container runtime_env requires podman or docker on PATH"
+            )
+        image = value["image"]
+        if value.get("pull", True) and os.path.sep not in str(runtime):
+            try:
+                subprocess.run(
+                    [runtime, "pull", image], check=True, capture_output=True
+                )
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                logger.warning("container pull failed (%s); trying local image", e)
+        return {
+            "runtime": runtime,
+            "image": image,
+            "run_options": list(value.get("run_options", ())),
+            "session_dir": session_dir,
+        }
+
+    def modify_worker(self, context, env, argv, runtime_env=None):
+        session_dir = context["session_dir"]
+        cmd = [
+            context["runtime"], "run", "--rm", "--network=host",
+            "-v", f"{session_dir}:{session_dir}",
+        ]
+        # framework vars + the user's OWN runtime_env env_vars cross the
+        # container boundary; arbitrary host env (HOME, PATH...) must not
+        user_vars = set((runtime_env or {}).get("env_vars") or ())
+        for key, value in env.items():
+            if key in user_vars or key.startswith(
+                ("RAYTPU_", "PYTHON", "JAX_", "XLA_")
+            ):
+                cmd += ["-e", f"{key}={value}"]
+        cmd += context["run_options"]
+        cmd.append(context["image"])
+        return dict(env), cmd + argv
+
+
+register_plugin(CondaPlugin())
+register_plugin(ContainerPlugin())

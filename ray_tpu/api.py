@@ -79,11 +79,15 @@ def _check_options(options: Dict[str, Any]):
         raise ValueError(f"unknown options: {sorted(unknown)}")
     env = options.get("runtime_env")
     if env is not None:
-        supported = {"env_vars", "working_dir", "py_modules", "pip", "pip_find_links"}
+        from ray_tpu._private.runtime_env_plugins import plugin_fields
+
+        supported = {
+            "env_vars", "working_dir", "py_modules", "pip", "pip_find_links",
+            *plugin_fields(),  # conda / container / registered plugins
+        }
         extra = set(env) - supported
         if extra:
-            # conda/container envs need infrastructure not in this build;
-            # fail loudly rather than silently ignore
+            # fail loudly rather than silently ignore unknown fields
             raise ValueError(
                 f"runtime_env fields {sorted(extra)} not supported "
                 f"(supported: {sorted(supported)})"

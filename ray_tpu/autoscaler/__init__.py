@@ -1,23 +1,39 @@
 """Autoscaler: demand-driven node provisioning with TPU-slice awareness.
 
 Reference: python/ray/autoscaler/_private/autoscaler.py (StandardAutoscaler),
-resource_demand_scheduler.py (bin-packing), _private/gcp/node.py (TPU pods).
+resource_demand_scheduler.py (bin-packing), _private/gcp/node.py (TPU pods),
+_private/updater.py + command_runner.py (node bootstrap).
 """
 
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.command_runner import (
+    CommandRunner,
+    CommandRunnerError,
+    DockerCommandRunner,
+    SSHCommandRunner,
+    SubprocessCommandRunner,
+)
 from ray_tpu.autoscaler.gcp import GcpHttpClient, GcpTpuNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     LocalSubprocessNodeProvider,
     NodeProvider,
     TPUSliceNodeProvider,
 )
+from ray_tpu.autoscaler.updater import BootstrappingNodeProvider, NodeUpdater
 
 __all__ = [
     "AutoscalerConfig",
+    "BootstrappingNodeProvider",
+    "CommandRunner",
+    "CommandRunnerError",
+    "DockerCommandRunner",
     "GcpHttpClient",
     "GcpTpuNodeProvider",
     "LocalSubprocessNodeProvider",
     "NodeProvider",
+    "NodeUpdater",
+    "SSHCommandRunner",
     "StandardAutoscaler",
+    "SubprocessCommandRunner",
     "TPUSliceNodeProvider",
 ]

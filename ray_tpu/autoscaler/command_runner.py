@@ -1,0 +1,232 @@
+"""Command runners: how the updater reaches a provisioned machine.
+
+Reference: python/ray/autoscaler/_private/command_runner.py (921 LoC:
+SSHCommandRunner/DockerCommandRunner with retrying exec + rsync). The
+contract here is the minimal surface NodeUpdater needs — run a command,
+sync a directory — behind which three transports ship:
+
+- SubprocessCommandRunner: executes on THIS host against an isolated root
+  directory standing in for the remote machine (drives tests and
+  single-host elasticity; the reference's fake-multinode analogue).
+- SSHCommandRunner: composes `ssh`/`rsync` argv for a real remote host.
+  The exec function is injectable so argv composition is testable with no
+  network; production uses the default (subprocess.run).
+- DockerCommandRunner: wraps another runner, prefixing `docker exec`.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class CommandRunnerError(RuntimeError):
+    def __init__(self, cmd: str, returncode: int, output: str):
+        super().__init__(f"command failed ({returncode}): {cmd}\n{output}")
+        self.returncode = returncode
+        self.output = output
+
+
+class CommandRunner:
+    def run(
+        self,
+        cmd: str,
+        *,
+        env: Optional[Dict[str, str]] = None,
+        timeout: float = 120.0,
+        daemon: bool = False,
+    ) -> str:
+        """Run a shell command on the target; returns combined output.
+        ``daemon=True`` starts it detached and returns immediately."""
+        raise NotImplementedError
+
+    def sync(self, local_path: str, remote_path: str) -> None:
+        """Replicate a local file/directory onto the target."""
+        raise NotImplementedError
+
+    def resolve(self, remote_path: str) -> str:
+        """Target-absolute form of a remote path (the subprocess runner
+        maps it under its isolation root; real transports return it
+        unchanged)."""
+        return remote_path
+
+    def wait_ready(self, timeout: float = 60.0, interval: float = 1.0) -> None:
+        """Poll until the target executes commands (ssh up, VM booted)."""
+        deadline = time.monotonic() + timeout
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self.run("true", timeout=10.0)
+                return
+            except Exception as e:  # noqa: BLE001
+                last = e
+                time.sleep(interval)
+        raise TimeoutError(f"target never became ready: {last}")
+
+
+class SubprocessCommandRunner(CommandRunner):
+    """Runs commands locally under an isolated root directory that stands
+    in for the remote machine's filesystem. `{root}` in commands expands to
+    that directory; sync copies into it."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._daemons: List[subprocess.Popen] = []
+
+    def run(self, cmd, *, env=None, timeout=120.0, daemon=False) -> str:
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        shell_cmd = cmd.format(root=self.root)
+        if daemon:
+            proc = subprocess.Popen(
+                ["bash", "-c", shell_cmd],
+                cwd=self.root,
+                env=full_env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            self._daemons.append(proc)
+            return f"daemon pid {proc.pid}"
+        res = subprocess.run(
+            ["bash", "-c", shell_cmd],
+            cwd=self.root,
+            env=full_env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if res.returncode != 0:
+            raise CommandRunnerError(
+                shell_cmd, res.returncode, res.stdout + res.stderr
+            )
+        return res.stdout
+
+    def resolve(self, remote_path: str) -> str:
+        return os.path.join(self.root, remote_path.lstrip("/"))
+
+    def sync(self, local_path: str, remote_path: str) -> None:
+        dest = os.path.join(self.root, remote_path.lstrip("/"))
+        if os.path.isdir(local_path):
+            shutil.copytree(
+                local_path,
+                dest,
+                dirs_exist_ok=True,
+                ignore=shutil.ignore_patterns(
+                    "__pycache__", "*.pyc", ".git", "*.so.tmp.*"
+                ),
+            )
+        else:
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            shutil.copy2(local_path, dest)
+
+    def stop_daemons(self):
+        for p in self._daemons:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), 15)
+                except (ProcessLookupError, PermissionError, OSError):
+                    p.terminate()
+        deadline = time.monotonic() + 10
+        for p in self._daemons:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._daemons.clear()
+
+
+class SSHCommandRunner(CommandRunner):
+    """Composes ssh/rsync command lines for a real host (reference:
+    command_runner.py SSHCommandRunner). ``exec_fn(argv, timeout)`` is
+    injectable for tests; the default shells out."""
+
+    SSH_OPTS = [
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+        "-o", "ConnectTimeout=10",
+        "-o", "LogLevel=ERROR",
+    ]
+
+    def __init__(
+        self,
+        host: str,
+        *,
+        user: str = "",
+        ssh_key: Optional[str] = None,
+        exec_fn: Optional[Callable[[List[str], float], str]] = None,
+    ):
+        self.host = host
+        self.user = user
+        self.ssh_key = ssh_key
+        self._exec = exec_fn or self._default_exec
+
+    @staticmethod
+    def _default_exec(argv: List[str], timeout: float) -> str:
+        res = subprocess.run(argv, capture_output=True, text=True, timeout=timeout)
+        if res.returncode != 0:
+            raise CommandRunnerError(
+                " ".join(argv), res.returncode, res.stdout + res.stderr
+            )
+        return res.stdout
+
+    @property
+    def _target(self) -> str:
+        return f"{self.user}@{self.host}" if self.user else self.host
+
+    def _key_opts(self) -> List[str]:
+        return ["-i", self.ssh_key] if self.ssh_key else []
+
+    def run(self, cmd, *, env=None, timeout=120.0, daemon=False) -> str:
+        envprefix = "".join(
+            f"{k}={shlex.quote(v)} " for k, v in (env or {}).items()
+        )
+        remote = envprefix + cmd
+        if daemon:
+            remote = f"nohup bash -c {shlex.quote(remote)} >/dev/null 2>&1 &"
+        argv = ["ssh", *self.SSH_OPTS, *self._key_opts(), self._target, remote]
+        return self._exec(argv, timeout)
+
+    def sync(self, local_path: str, remote_path: str) -> None:
+        src = local_path.rstrip("/") + ("/" if os.path.isdir(local_path) else "")
+        ssh_cmd = " ".join(["ssh", *self.SSH_OPTS, *self._key_opts()])
+        argv = [
+            "rsync", "-az", "--delete",
+            "--exclude", "__pycache__", "--exclude", ".git",
+            "-e", ssh_cmd,
+            src, f"{self._target}:{remote_path}",
+        ]
+        self._exec(argv, 600.0)
+
+
+class DockerCommandRunner(CommandRunner):
+    """Runs inside a container on the target via another runner
+    (reference: command_runner.py DockerCommandRunner)."""
+
+    def __init__(self, inner: CommandRunner, container: str):
+        self.inner = inner
+        self.container = container
+
+    def run(self, cmd, *, env=None, timeout=120.0, daemon=False) -> str:
+        envflags = "".join(
+            f"-e {shlex.quote(f'{k}={v}')} " for k, v in (env or {}).items()
+        )
+        wrapped = (
+            f"docker exec {envflags}{'-d ' if daemon else ''}"
+            f"{self.container} bash -c {shlex.quote(cmd)}"
+        )
+        return self.inner.run(wrapped, timeout=timeout, daemon=False)
+
+    def sync(self, local_path: str, remote_path: str) -> None:
+        staging = f"/tmp/raytpu_docker_stage{remote_path}"
+        self.inner.sync(local_path, staging)
+        self.inner.run(
+            f"docker cp {shlex.quote(staging)} "
+            f"{self.container}:{shlex.quote(remote_path)}",
+            timeout=600.0,
+        )

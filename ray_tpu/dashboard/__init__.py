@@ -120,11 +120,12 @@ class DashboardServer:
     """Serves the dashboard for one cluster (run on or near the head)."""
 
     def __init__(self, gcs_address: str, host: str = "127.0.0.1",
-                 port: int = 8265):
+                 port: int = 8265, session_dir: Optional[str] = None):
         from ray_tpu.util import state as state_api
 
         self._state = state_api
         self.gcs_address = gcs_address
+        self.session_dir = session_dir
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -226,11 +227,84 @@ class DashboardServer:
             return 200, b'{"ok": true}'
         return 404, b'{"error": "not found"}'
 
+    # static SPA (dashboard/client/: hash-routed JS modules, no build step —
+    # the role of the reference's React app under dashboard/client/src)
+    _CLIENT_TYPES = {
+        ".html": "text/html; charset=utf-8",
+        ".js": "text/javascript; charset=utf-8",
+        ".css": "text/css; charset=utf-8",
+        ".svg": "image/svg+xml",
+    }
+
+    def _serve_client(self, name: str):
+        import os as _os
+
+        client_dir = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "client")
+        full = _os.path.realpath(_os.path.join(client_dir, name))
+        if not full.startswith(_os.path.realpath(client_dir) + _os.sep):
+            return None, ""
+        ext = _os.path.splitext(full)[1]
+        if ext not in self._CLIENT_TYPES or not _os.path.exists(full):
+            return None, ""
+        with open(full, "rb") as f:
+            return f.read(), self._CLIENT_TYPES[ext]
+
+    def _list_logs(self):
+        import os as _os
+
+        if not self.session_dir:
+            return {"files": [], "error": "dashboard has no session_dir"}
+        root = _os.path.join(self.session_dir, "logs")
+        files = []
+        for dirpath, _dirs, names in _os.walk(root):
+            for n in names:
+                full = _os.path.join(dirpath, n)
+                try:
+                    files.append(
+                        {
+                            "file": _os.path.relpath(full, root),
+                            "size": _os.path.getsize(full),
+                        }
+                    )
+                except OSError:
+                    continue
+        return {"files": sorted(files, key=lambda f: f["file"])}
+
+    def _tail_log(self, query: str):
+        import os as _os
+        from urllib.parse import parse_qs, unquote
+
+        if not self.session_dir:
+            return {"error": "dashboard has no session_dir"}
+        q = parse_qs(query)
+        rel = unquote((q.get("file") or [""])[0])
+        tail = int((q.get("tail") or ["65536"])[0])
+        root = _os.path.realpath(_os.path.join(self.session_dir, "logs"))
+        full = _os.path.realpath(_os.path.join(root, rel))
+        if not full.startswith(root + _os.sep) or not _os.path.isfile(full):
+            return {"error": f"no such log {rel!r}"}
+        size = _os.path.getsize(full)
+        with open(full, "rb") as f:
+            if size > tail:
+                f.seek(size - tail)
+            data = f.read()
+        return {
+            "file": rel,
+            "size": size,
+            "text": data.decode("utf-8", "replace"),
+        }
+
     def _route(self, path: str):
         a = self.gcs_address
         s = self._state
-        if path in ("/", "/index.html"):
+        base0 = path.partition("?")[0]
+        if base0 in ("/", "/index.html"):
+            body, ctype = self._serve_client("index.html")
+            if body is not None:
+                return body, ctype
             return _PAGE.encode(), "text/html; charset=utf-8"
+        if base0.startswith("/static/"):
+            return self._serve_client(base0[len("/static/") :])
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
 
@@ -249,6 +323,13 @@ class DashboardServer:
             "/api/cluster": lambda: self._cluster_overview(),
         }
         base, _, query = path.partition("?")
+        if base == "/api/logs":
+            if "file=" in query:
+                return (
+                    json.dumps(self._tail_log(query)).encode(),
+                    "application/json",
+                )
+            return json.dumps(self._list_logs()).encode(), "application/json"
         if base == "/api/metrics_history":
             return (
                 json.dumps(list(self._history)).encode(),

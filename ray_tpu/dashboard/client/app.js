@@ -1,0 +1,260 @@
+// ray_tpu dashboard SPA — hash router + polling views over the JSON API.
+// Plain ES modules, no dependencies, no build step.
+
+const $main = document.getElementById("main");
+const $status = document.getElementById("status");
+const $auto = document.getElementById("auto");
+
+let timer = null;
+let sortState = {}; // per-view: {col, dir}
+let filterState = {}; // per-view filter text
+
+async function api(path) {
+  const r = await fetch(path);
+  if (!r.ok) throw new Error(`${path}: HTTP ${r.status}`);
+  return r.json();
+}
+
+function fmt(v) {
+  if (v === null || v === undefined) return "";
+  if (typeof v === "number" && !Number.isInteger(v)) return v.toFixed(2);
+  if (typeof v === "object") return JSON.stringify(v);
+  return String(v);
+}
+
+function stateClass(v) {
+  const good = ["ALIVE", "RUNNING", "FINISHED", "SUCCEEDED", "CREATED", true, "true"];
+  const bad = ["DEAD", "FAILED", "ERRORED", false, "false"];
+  if (good.includes(v)) return "ok";
+  if (bad.includes(v)) return "bad";
+  return "";
+}
+
+// sortable + filterable table; onRow(row) -> optional click handler
+function table(view, rows, cols, onRow) {
+  if (!rows || !rows.length) return "<p class='dim'>none</p>";
+  cols = cols || Object.keys(rows[0]);
+  const f = (filterState[view] || "").toLowerCase();
+  if (f) {
+    rows = rows.filter((r) =>
+      cols.some((c) => fmt(r[c]).toLowerCase().includes(f))
+    );
+  }
+  const s = sortState[view];
+  if (s) {
+    rows = [...rows].sort((a, b) => {
+      const x = a[s.col], y = b[s.col];
+      const cmp = typeof x === "number" && typeof y === "number"
+        ? x - y : fmt(x).localeCompare(fmt(y));
+      return s.dir * cmp;
+    });
+  }
+  let h = `<table data-view="${view}"><thead><tr>`;
+  for (const c of cols) {
+    const arrow = s && s.col === c ? `<span class="arrow">${s.dir > 0 ? "▲" : "▼"}</span>` : "";
+    h += `<th data-col="${c}">${c} ${arrow}</th>`;
+  }
+  h += "</tr></thead><tbody>";
+  rows.forEach((r, i) => {
+    h += `<tr data-i="${i}">` + cols.map((c) => {
+      const cls = ["state", "alive", "status"].includes(c) ? stateClass(r[c]) : "";
+      return `<td class="${cls}">${fmt(r[c])}</td>`;
+    }).join("") + "</tr>";
+  });
+  h += "</tbody></table>";
+  // attach handlers after render
+  queueMicrotask(() => {
+    const el = $main.querySelector(`table[data-view="${view}"]`);
+    if (!el) return;
+    el.querySelectorAll("th").forEach((th) =>
+      th.addEventListener("click", () => {
+        const col = th.dataset.col;
+        const cur = sortState[view];
+        sortState[view] = { col, dir: cur && cur.col === col ? -cur.dir : 1 };
+        render();
+      })
+    );
+    if (onRow) {
+      el.querySelectorAll("tbody tr").forEach((tr) =>
+        tr.addEventListener("click", () => onRow(rows[Number(tr.dataset.i)]))
+      );
+    }
+  });
+  return h;
+}
+
+function filterBox(view) {
+  queueMicrotask(() => {
+    const el = $main.querySelector(`input.filter[data-view="${view}"]`);
+    if (!el) return;
+    el.value = filterState[view] || "";
+    el.addEventListener("input", () => {
+      filterState[view] = el.value;
+      render();
+    });
+  });
+  return `<input class="filter" data-view="${view}" placeholder="filter...">`;
+}
+
+// tiny dependency-free line chart
+function chart(hist, key, label, color) {
+  const w = 280, h = 64, pad = 2;
+  const vals = hist.map((p) => p[key] || 0);
+  if (!vals.length) return "";
+  const max = Math.max(...vals, 1e-9);
+  const pts = vals.map((v, i) => {
+    const x = pad + (i / Math.max(vals.length - 1, 1)) * (w - 2 * pad);
+    const y = h - pad - (v / max) * (h - 2 * pad);
+    return `${x.toFixed(1)},${y.toFixed(1)}`;
+  }).join(" ");
+  const last = vals[vals.length - 1];
+  return `<div class="chart"><div class="label">${label} — now ${fmt(last)}, max ${fmt(max)}</div>
+    <svg width="${w}" height="${h}"><polyline fill="none" stroke="${color}" stroke-width="1.5" points="${pts}"/></svg></div>`;
+}
+
+// ---------------------------------------------------------------------------
+// views
+// ---------------------------------------------------------------------------
+
+const views = {
+  async overview() {
+    const [ov, hist] = await Promise.all([
+      api("/api/cluster"), api("/api/metrics_history"),
+    ]);
+    const tile = (k, v) => `<div class="tile"><div class="v">${fmt(v)}</div><div class="k">${k}</div></div>`;
+    const res = ov.total_resources || {};
+    const avail = ov.available_resources || {};
+    let h = "<div class='tiles'>";
+    h += tile("alive nodes", ov.alive_nodes);
+    for (const k of Object.keys(res)) {
+      h += tile(k, `${fmt((res[k] || 0) - (avail[k] || 0))} / ${fmt(res[k])}`);
+    }
+    h += "</div><h2>History</h2><div class='charts'>";
+    h += chart(hist, "cpu_used", "CPU in use", "#3455d1");
+    h += chart(hist, "running_tasks", "running tasks", "#0a7d2c");
+    h += chart(hist, "finished_tasks", "finished tasks", "#777785");
+    h += chart(hist, "live_actors", "live actors", "#b0561f");
+    h += "</div>";
+    return h;
+  },
+
+  async nodes() {
+    const rows = await api("/api/nodes");
+    return filterBox("nodes") + table("nodes", rows, null);
+  },
+
+  async actors(arg) {
+    if (arg) return views._actorDetail(arg);
+    const rows = await api("/api/actors");
+    return filterBox("actors") + table("actors", rows, null,
+      (r) => { location.hash = `#/actors/${r.actor_id}`; });
+  },
+
+  async _actorDetail(actorId) {
+    let profile = "";
+    const rows = (await api("/api/actors")).filter((a) => a.actor_id.startsWith(actorId));
+    const h = `<div class="crumb"><a href="#/actors">actors</a> / ${actorId}</div>
+      <div class="detail"><pre>${fmt(rows[0] || "unknown actor")}</pre>
+      <button id="prof">CPU profile (2s)</button><pre id="profout"></pre></div>`;
+    queueMicrotask(() => {
+      const btn = document.getElementById("prof");
+      if (btn) btn.addEventListener("click", async () => {
+        document.getElementById("profout").textContent = "profiling...";
+        const out = await api(`/api/profile?actor=${actorId}&duration=2`);
+        document.getElementById("profout").textContent =
+          typeof out === "string" ? out : JSON.stringify(out, null, 2);
+      });
+    });
+    return h;
+  },
+
+  async tasks(arg) {
+    if (arg) return views._taskDetail(arg);
+    const [rows, summary] = await Promise.all([
+      api("/api/tasks"), api("/api/summary"),
+    ]);
+    let h = "<h2>Summary</h2><div class='tiles'>";
+    for (const [name, states] of Object.entries(summary)) {
+      h += `<div class="tile"><div class="v">${Object.entries(states).map(([s, n]) => `${s}:${n}`).join(" ")}</div><div class="k">${name}</div></div>`;
+    }
+    h += "</div><h2>Tasks</h2>" + filterBox("tasks") +
+      table("tasks", rows, null, (r) => { location.hash = `#/tasks/${r.task_id}`; });
+    return h;
+  },
+
+  async _taskDetail(taskId) {
+    const d = await api(`/api/task?id=${taskId}`);
+    let h = `<div class="crumb"><a href="#/tasks">tasks</a> / ${taskId}</div>`;
+    h += `<div class="detail"><h2>State</h2><pre>${JSON.stringify(d.task, null, 2)}</pre></div>`;
+    if (d.events && d.events.length) {
+      const t0 = d.events[0].ts;
+      h += "<div class='detail'><h2>Lifecycle</h2>" + table("taskev",
+        d.events.map((e) => ({ "+ms": ((e.ts - t0) * 1000).toFixed(1), ...e })),
+        null) + "</div>";
+    }
+    return h;
+  },
+
+  async jobs() {
+    const rows = await api("/api/jobs");
+    return filterBox("jobs") + table("jobs", rows, null);
+  },
+
+  async pgs() {
+    const rows = await api("/api/placement_groups");
+    return filterBox("pgs") + table("pgs", rows, null);
+  },
+
+  async objects() {
+    const rows = await api("/api/objects");
+    return filterBox("objects") + table("objects", rows, null);
+  },
+
+  async logs(arg) {
+    if (arg) {
+      const d = await api(`/api/logs?file=${encodeURIComponent(arg)}&tail=65536`);
+      return `<div class="crumb"><a href="#/logs">logs</a> / ${d.file || arg}</div>
+        <pre class="logview">${(d.text || d.error || "").replace(/</g, "&lt;")}</pre>`;
+    }
+    const d = await api("/api/logs");
+    if (d.error) return `<p class="dim">${d.error}</p>`;
+    let h = "<h2>Session logs</h2><div class='loglist'>";
+    for (const f of d.files) {
+      h += `<a href="#/logs/${encodeURIComponent(f.file)}">${f.file} <span class="dim">(${f.size} B)</span></a>`;
+    }
+    return h + "</div>";
+  },
+};
+
+// ---------------------------------------------------------------------------
+// router + refresh loop
+// ---------------------------------------------------------------------------
+
+function parseHash() {
+  const parts = (location.hash || "#/overview").slice(2).split("/");
+  return { view: parts[0] || "overview", arg: parts.slice(1).join("/") || null };
+}
+
+async function render() {
+  const { view, arg } = parseHash();
+  document.querySelectorAll("#nav a").forEach((a) =>
+    a.classList.toggle("active", a.hash === `#/${view}`)
+  );
+  const fn = views[view] || views.overview;
+  try {
+    $main.innerHTML = await fn(arg ? decodeURIComponent(arg) : null);
+    $status.textContent = `updated ${new Date().toLocaleTimeString()}`;
+  } catch (e) {
+    $status.textContent = `error: ${e.message}`;
+  }
+}
+
+function loop() {
+  clearInterval(timer);
+  timer = setInterval(() => { if ($auto.checked) render(); }, 3000);
+}
+
+window.addEventListener("hashchange", render);
+$auto.addEventListener("change", loop);
+render();
+loop();

@@ -23,6 +23,9 @@ from ray_tpu.data.read_api import (
     read_numpy,
     read_text,
     read_parquet,
+    read_tfrecords,
+    read_images,
+    from_jax,
 )
 
 __all__ = [
@@ -48,4 +51,7 @@ __all__ = [
     "read_numpy",
     "read_text",
     "read_parquet",
+    "read_tfrecords",
+    "read_images",
+    "from_jax",
 ]

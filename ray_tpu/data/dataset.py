@@ -262,9 +262,39 @@ def _write_block_task(blk, path, fmt):
         import pyarrow.csv as pacsv
 
         pacsv.write_csv(blk, path)
+    elif fmt == "json":
+        # newline-delimited json, the format read_json consumes back
+        import json as _json
+
+        with open(path, "w") as f:
+            for row in B.block_rows(blk):
+                f.write(_json.dumps(_json_safe_row(row)))
+                f.write("\n")
+    elif fmt == "tfrecords":
+        from ray_tpu.data import tfrecord as tfr
+
+        tfr.write_records(
+            path, (tfr.build_example(row) for row in B.block_rows(blk))
+        )
     else:
         raise ValueError(fmt)
     return path
+
+
+def _json_safe_row(row):
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        elif isinstance(v, bytes):
+            out[k] = v.decode("utf-8", "replace")
+        else:
+            out[k] = v
+    return out
 
 
 @ray_tpu.remote(max_concurrency=1)
@@ -882,11 +912,43 @@ class Dataset:
     def write_csv(self, path: str) -> List[str]:
         return self._write(path, "csv")
 
+    def write_json(self, path: str) -> List[str]:
+        """Newline-delimited JSON, one file per block (reference:
+        data/datasource/json_datasource.py); read_json round-trips it."""
+        return self._write(path, "json")
+
+    def write_tfrecords(self, path: str) -> List[str]:
+        """tf.train.Example TFRecords via the dependency-free codec
+        (ray_tpu/data/tfrecord.py); read_tfrecords round-trips it."""
+        return self._write(path, "tfrecords")
+
+    def to_jax(self, *, columns: Optional[List[str]] = None, device=None):
+        """Materialize as a dict of jax.Arrays (device_put once over the
+        gathered columns — the inverse of read_api.from_jax)."""
+        import jax
+        import jax.numpy as jnp
+
+        batches = list(self.iter_batches(batch_size=None, batch_format="numpy"))
+        if not batches:
+            return {}
+        names = columns or list(batches[0].keys())
+        out = {}
+        for name in names:
+            host = np.concatenate([b[name] for b in batches])
+            arr = jnp.asarray(host)
+            out[name] = jax.device_put(arr, device) if device is not None else arr
+        return out
+
     def _write(self, path: str, fmt: str) -> List[str]:
         import os
 
         os.makedirs(path, exist_ok=True)
-        ext = {"parquet": "parquet", "csv": "csv"}[fmt]
+        ext = {
+            "parquet": "parquet",
+            "csv": "csv",
+            "json": "json",
+            "tfrecords": "tfrecords",
+        }[fmt]
         return ray_tpu.get(
             [
                 _write_block_task.remote(

@@ -247,3 +247,84 @@ def read_json(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
     files = _expand_paths(paths)
     pairs = [_read_json_task.options(num_returns=2).remote(p) for p in files]
     return Dataset([p[0] for p in pairs], [p[1] for p in pairs], [("read_json", 0.0)])
+
+
+@ray_tpu.remote
+def _read_tfrecords_task(path):
+    from ray_tpu.data import tfrecord as tfr
+
+    examples = [tfr.parse_example(rec) for rec in tfr.read_records(path)]
+    blk = B.block_from_batch(tfr.examples_to_batch(examples))
+    return blk, _meta_of(blk)
+
+
+def read_tfrecords(paths, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """tf.train.Example TFRecord files, one task per file, WITHOUT a
+    tensorflow dependency (reference:
+    python/ray/data/datasource/tfrecords_datasource.py goes through tf;
+    the framing + proto subset is decoded by ray_tpu/data/tfrecord.py).
+    Fixed-width float/int64 lists become tensor columns."""
+    files = _expand_paths(paths)
+    pairs = [
+        _read_tfrecords_task.options(num_returns=2).remote(p) for p in files
+    ]
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs],
+                   [("read_tfrecords", 0.0)])
+
+
+@ray_tpu.remote
+def _read_images_task(paths, size, mode, include_paths):
+    from PIL import Image
+
+    arrays, kept = [], []
+    for p in paths:
+        img = Image.open(p)
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))  # PIL takes (w, h)
+        arrays.append(np.asarray(img))
+        kept.append(p)
+    batch = {"image": np.stack(arrays)} if size is not None else {
+        "image": np.asarray(arrays, dtype=object)
+    }
+    if include_paths:
+        batch["path"] = np.asarray(kept, dtype=object)
+    blk = B.block_from_batch(batch)
+    return blk, _meta_of(blk)
+
+
+def read_images(
+    paths,
+    *,
+    size: Optional[tuple] = None,
+    mode: str = "RGB",
+    include_paths: bool = False,
+    parallelism: int = DEFAULT_PARALLELISM,
+) -> Dataset:
+    """Image files -> tensor column "image" (reference:
+    python/ray/data/datasource/image_datasource.py). With ``size=(h, w)``
+    every image is resized and the column is a dense (n, h, w, c) tensor
+    ready for `iter_batches -> jnp.asarray`; without it, rows keep their
+    native shapes as an object column."""
+    files = _expand_paths(paths)
+    parallelism = max(1, min(parallelism, len(files)))
+    chunks = [files[i::parallelism] for i in builtins.range(parallelism)]
+    pairs = [
+        _read_images_task.options(num_returns=2).remote(
+            chunk, size, mode, include_paths
+        )
+        for chunk in chunks
+        if chunk
+    ]
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs],
+                   [("read_images", 0.0)])
+
+
+def from_jax(arrays, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """jax.Array columns -> Dataset (device -> host once, then the normal
+    numpy path; tensor shapes survive). The inverse is Dataset.to_jax()."""
+    if not isinstance(arrays, dict):
+        arrays = {"data": arrays}
+    host = {k: np.asarray(v) for k, v in arrays.items()}
+    return from_numpy(host, parallelism=parallelism)

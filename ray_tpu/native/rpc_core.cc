@@ -1,0 +1,521 @@
+// Native RPC transport: epoll loop, frame parsing, buffered sends.
+//
+// Replaces the hot inner loops of ray_tpu/_private/rpc.py (_Poller /
+// _FrameBuffer / _SendState) with C++ — the role the reference's C++ gRPC
+// core plays for its control plane (reference: src/ray/rpc/grpc_server.h,
+// client_call.h: completion-queue threads doing all byte work in C++,
+// Python seeing only whole messages). Python keeps: connection setup
+// (connect/accept/auth policy), pickle codec, dispatch. C++ owns: epoll,
+// recv, length-prefixed frame reassembly, nonblocking send with
+// backpressure buffering, fd lifecycle.
+//
+// Threading: Python calls rt_poll from ONE pump thread (GIL released by
+// ctypes); sends may come from any thread. A mutex guards the connection
+// table and send buffers; an eventfd wakes the poller for table changes.
+//
+// Event records written into the caller's poll buffer:
+//   u64 conn_id | u32 kind | u32 len | len bytes (padded to 8)
+// kind: 0 = frame (len bytes = wire kind byte + body)
+//       1 = closed (len bytes = utf-8 reason)
+//       2 = big frame handle (len = 16: u64 token | u32 frame_len | u32 wire_kind)
+//           -> fetch via rt_frame_ptr/rt_frame_free
+// Frames larger than RT_INLINE_MAX are parked on the heap and handed to
+// Python by token so an 8 MiB object-transfer chunk never forces a giant
+// poll buffer or an extra copy.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint16_t kMagic = 0x5254;  // "RT"
+constexpr uint8_t kWireVersion = 3;
+constexpr size_t kHeaderSize = 8;  // >HBBI
+constexpr size_t kInlineMax = 256 * 1024;
+constexpr size_t kRecvChunk = 1 << 18;
+
+struct Conn {
+  int fd = -1;
+  uint64_t id = 0;
+  std::vector<uint8_t> rbuf;   // partial frame bytes
+  size_t rpos = 0;             // consumed prefix of rbuf
+  std::deque<std::vector<uint8_t>> sendq;  // buffered unsent bytes
+  size_t send_off = 0;         // offset into sendq.front()
+  bool want_write = false;
+  bool dead = false;
+};
+
+struct BigFrame {
+  std::vector<uint8_t> data;
+};
+
+struct Loop {
+  int epfd = -1;
+  int wakefd = -1;
+  std::mutex mu;
+  std::unordered_map<uint64_t, Conn*> conns;
+  std::unordered_map<uint64_t, BigFrame*> frames;
+  std::atomic<uint64_t> next_token{1};
+  uint64_t max_frame = 512ull << 20;
+  uint64_t max_buffer = 1ull << 30;  // per-conn send buffer cap
+  // deferred close list: conns that died while poll() packed events
+  std::vector<uint64_t> pending_close;
+  // conns killed by a SENDER thread (hard send error): the poller must
+  // still emit their closed event — the dead flag makes it skip their
+  // epoll wakeups, so without this queue Python would never see on_closed
+  std::vector<std::pair<uint64_t, std::string>> dead_notices;
+};
+
+void wake(Loop* lp) {
+  uint64_t one = 1;
+  ssize_t wr = ::write(lp->wakefd, &one, 8);
+  (void)wr;
+}
+
+inline uint64_t rd64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline void wr_record(std::vector<uint8_t>& out, uint64_t conn_id,
+                      uint32_t kind, const uint8_t* data, uint32_t len) {
+  size_t base = out.size();
+  size_t padded = (len + 7) & ~size_t(7);
+  out.resize(base + 16 + padded);
+  std::memcpy(&out[base], &conn_id, 8);
+  std::memcpy(&out[base + 8], &kind, 4);
+  std::memcpy(&out[base + 12], &len, 4);
+  if (len) std::memcpy(&out[base + 16], data, len);
+  if (padded > len) std::memset(&out[base + 16 + len], 0, padded - len);
+}
+
+void arm(Loop* lp, Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+  ev.data.u64 = c->id;
+  epoll_ctl(lp->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// returns false when the connection died mid-send
+bool flush_locked(Loop* lp, Conn* c) {
+  while (!c->sendq.empty()) {
+    auto& front = c->sendq.front();
+    while (c->send_off < front.size()) {
+      ssize_t n = ::send(c->fd, front.data() + c->send_off,
+                         front.size() - c->send_off, MSG_NOSIGNAL);
+      if (n > 0) {
+        c->send_off += size_t(n);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (!c->want_write) {
+          c->want_write = true;
+          arm(lp, c);
+        }
+        return true;
+      }
+      if (n < 0 && errno == EINTR) continue;
+      return false;  // hard error
+    }
+    c->sendq.pop_front();
+    c->send_off = 0;
+  }
+  if (c->want_write) {
+    c->want_write = false;
+    arm(lp, c);
+  }
+  return true;
+}
+
+// Parse complete frames out of c->rbuf into event records.
+// Returns false on protocol error (reason filled).
+bool drain_frames(Loop* lp, Conn* c, std::vector<uint8_t>& out,
+                  std::string& reason) {
+  for (;;) {
+    size_t avail = c->rbuf.size() - c->rpos;
+    if (avail < kHeaderSize) break;
+    const uint8_t* p = c->rbuf.data() + c->rpos;
+    uint16_t magic = uint16_t(p[0]) << 8 | p[1];
+    uint8_t version = p[2];
+    uint8_t kind = p[3];
+    uint32_t length = uint32_t(p[4]) << 24 | uint32_t(p[5]) << 16 |
+                      uint32_t(p[6]) << 8 | p[7];
+    if (magic != kMagic || version != kWireVersion) {
+      reason = "bad frame header";
+      return false;
+    }
+    if (uint64_t(length) > lp->max_frame) {
+      reason = "frame too large";
+      return false;
+    }
+    if (avail < kHeaderSize + length) break;
+    const uint8_t* body = p + kHeaderSize;
+    if (size_t(length) + 1 <= kInlineMax) {
+      // record payload = wire kind byte + body
+      size_t base = out.size();
+      size_t len = size_t(length) + 1;
+      size_t padded = (len + 7) & ~size_t(7);
+      out.resize(base + 16 + padded);
+      uint32_t rkind = 0;
+      uint32_t len32 = uint32_t(len);
+      std::memcpy(&out[base], &c->id, 8);
+      std::memcpy(&out[base + 8], &rkind, 4);
+      std::memcpy(&out[base + 12], &len32, 4);
+      out[base + 16] = kind;
+      if (length) std::memcpy(&out[base + 17], body, length);
+      if (padded > len) std::memset(&out[base + 16 + len], 0, padded - len);
+    } else {
+      auto* bf = new BigFrame();
+      bf->data.assign(body, body + length);
+      uint64_t token = lp->next_token.fetch_add(1);
+      lp->frames.emplace(token, bf);
+      uint8_t rec[16];
+      std::memcpy(rec, &token, 8);
+      uint32_t flen = length;
+      std::memcpy(rec + 8, &flen, 4);
+      uint32_t wkind = kind;
+      std::memcpy(rec + 12, &wkind, 4);
+      wr_record(out, c->id, 2, rec, 16);
+    }
+    c->rpos += kHeaderSize + length;
+  }
+  if (c->rpos) {
+    c->rbuf.erase(c->rbuf.begin(), c->rbuf.begin() + c->rpos);
+    c->rpos = 0;
+  }
+  return true;
+}
+
+void emit_closed(Loop* lp, Conn* c, std::vector<uint8_t>& out,
+                 const std::string& reason) {
+  c->dead = true;
+  wr_record(out, c->id, 1, reinterpret_cast<const uint8_t*>(reason.data()),
+            uint32_t(reason.size()));
+  epoll_ctl(lp->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  ::close(c->fd);
+  c->fd = -1;
+  lp->pending_close.push_back(c->id);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rt_loop_new(uint64_t max_frame_bytes) {
+  auto* lp = new Loop();
+  lp->epfd = epoll_create1(EPOLL_CLOEXEC);
+  lp->wakefd = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (max_frame_bytes) lp->max_frame = max_frame_bytes;
+  lp->max_buffer = lp->max_frame * 2;
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // conn_id 0 reserved for the waker
+  epoll_ctl(lp->epfd, EPOLL_CTL_ADD, lp->wakefd, &ev);
+  return lp;
+}
+
+void rt_loop_free(void* h) {
+  auto* lp = static_cast<Loop*>(h);
+  {
+    std::lock_guard<std::mutex> g(lp->mu);
+    for (auto& kv : lp->conns) {
+      if (kv.second->fd >= 0) ::close(kv.second->fd);
+      delete kv.second;
+    }
+    for (auto& kv : lp->frames) delete kv.second;
+    lp->conns.clear();
+    lp->frames.clear();
+  }
+  ::close(lp->epfd);
+  ::close(lp->wakefd);
+  delete lp;
+}
+
+// Takes ownership of fd (caller must have detach()ed it). conn_id must be
+// nonzero and unique for the loop's lifetime.
+int rt_loop_add(void* h, uint64_t conn_id, int fd) {
+  auto* lp = static_cast<Loop*>(h);
+  auto* c = new Conn();
+  c->fd = fd;
+  c->id = conn_id;
+  {
+    std::lock_guard<std::mutex> g(lp->mu);
+    if (!lp->conns.emplace(conn_id, c).second) {
+      delete c;
+      return -1;
+    }
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = conn_id;
+  if (epoll_ctl(lp->epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+    std::lock_guard<std::mutex> g(lp->mu);
+    lp->conns.erase(conn_id);
+    delete c;
+    return -1;
+  }
+  return 0;
+}
+
+// Close + forget a connection (no 'closed' event is emitted for explicit
+// removal — Python initiated it and already knows).
+int rt_loop_remove(void* h, uint64_t conn_id) {
+  auto* lp = static_cast<Loop*>(h);
+  std::lock_guard<std::mutex> g(lp->mu);
+  auto it = lp->conns.find(conn_id);
+  if (it == lp->conns.end()) return -1;
+  Conn* c = it->second;
+  if (c->fd >= 0) {
+    epoll_ctl(lp->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    ::close(c->fd);
+  }
+  lp->conns.erase(it);
+  delete c;
+  return 0;
+}
+
+// Queue (and opportunistically write) one pre-encoded wire frame given as
+// nparts scatter segments (header+meta, then per-OOB-buffer length/bytes
+// pairs). The whole frame is sent atomically w.r.t. other senders (the
+// loop mutex is held). Returns:
+//  0 ok, -1 unknown conn, -2 connection dead, -3 buffer cap exceeded.
+int rt_loop_sendv(void* h, uint64_t conn_id, const uint8_t* const* parts,
+                  const uint64_t* sizes, int nparts) {
+  auto* lp = static_cast<Loop*>(h);
+  std::lock_guard<std::mutex> g(lp->mu);
+  auto it = lp->conns.find(conn_id);
+  if (it == lp->conns.end()) return -1;
+  Conn* c = it->second;
+  if (c->dead || c->fd < 0) return -2;
+  uint64_t total = 0;
+  for (int i = 0; i < nparts; i++) total += sizes[i];
+  if (c->sendq.empty()) {
+    // fast path: writev straight to the kernel (IOV_MAX-safe batches)
+    std::vector<iovec> iov;
+    iov.reserve(size_t(nparts));
+    for (int i = 0; i < nparts; i++) {
+      if (sizes[i]) {
+        iov.push_back({const_cast<uint8_t*>(parts[i]), size_t(sizes[i])});
+      }
+    }
+    uint64_t written = 0;
+    size_t first = 0;
+    while (written < total && first < iov.size()) {
+      int cnt = int(std::min(iov.size() - first, size_t(64)));
+      ssize_t n = ::writev(c->fd, iov.data() + first, cnt);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        c->dead = true;
+        lp->dead_notices.emplace_back(c->id, std::strerror(errno));
+        wake(lp);
+        return -2;
+      }
+      written += uint64_t(n);
+      uint64_t left = uint64_t(n);
+      while (left && first < iov.size()) {
+        if (iov[first].iov_len <= left) {
+          left -= iov[first].iov_len;
+          first++;
+        } else {
+          iov[first].iov_base =
+              static_cast<uint8_t*>(iov[first].iov_base) + left;
+          iov[first].iov_len -= left;
+          left = 0;
+        }
+      }
+    }
+    if (written >= total) return 0;
+    // buffer the unsent tail as one vector
+    std::vector<uint8_t> tail;
+    tail.reserve(size_t(total - written));
+    for (size_t k = first; k < iov.size(); k++) {
+      const uint8_t* b = static_cast<const uint8_t*>(iov[k].iov_base);
+      tail.insert(tail.end(), b, b + iov[k].iov_len);
+    }
+    c->sendq.emplace_back(std::move(tail));
+    c->want_write = true;
+    arm(lp, c);
+    // wake the poller so EPOLLOUT interest takes effect promptly
+    uint64_t one = 1;
+    ssize_t wr = ::write(lp->wakefd, &one, 8);
+    (void)wr;
+    return 0;
+  }
+  // slow path: already buffered — append, enforcing the cap
+  uint64_t queued = 0;
+  for (auto& v : c->sendq) queued += v.size();
+  if (queued + total > lp->max_buffer) return -3;
+  std::vector<uint8_t> all;
+  all.reserve(size_t(total));
+  for (int i = 0; i < nparts; i++) {
+    if (sizes[i]) all.insert(all.end(), parts[i], parts[i] + sizes[i]);
+  }
+  c->sendq.emplace_back(std::move(all));
+  return 0;
+}
+
+// Poll for events; returns number of bytes written into out (0 on timeout),
+// -1 on loop shutdown. Called from ONE thread.
+int64_t rt_loop_poll(void* h, uint8_t* out, uint64_t cap, int timeout_ms) {
+  auto* lp = static_cast<Loop*>(h);
+  epoll_event evs[64];
+  int n = epoll_wait(lp->epfd, evs, 64, timeout_ms);
+  if (n < 0) {
+    if (errno == EINTR) return 0;
+    return -1;
+  }
+  std::vector<uint8_t> outv;
+  outv.reserve(16384);
+  std::lock_guard<std::mutex> g(lp->mu);
+  lp->pending_close.clear();
+  // closed events for conns a sender thread killed (hard send error)
+  for (auto& notice : lp->dead_notices) {
+    auto it = lp->conns.find(notice.first);
+    if (it == lp->conns.end()) continue;
+    Conn* c = it->second;
+    wr_record(outv, c->id, 1,
+              reinterpret_cast<const uint8_t*>(notice.second.data()),
+              uint32_t(notice.second.size()));
+    if (c->fd >= 0) {
+      epoll_ctl(lp->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+      ::close(c->fd);
+      c->fd = -1;
+    }
+    lp->pending_close.push_back(c->id);
+  }
+  lp->dead_notices.clear();
+  for (int i = 0; i < n; i++) {
+    uint64_t cid = evs[i].data.u64;
+    if (cid == 0) {  // waker
+      uint64_t junk;
+      while (::read(lp->wakefd, &junk, 8) == 8) {
+      }
+      continue;
+    }
+    auto it = lp->conns.find(cid);
+    if (it == lp->conns.end()) continue;
+    Conn* c = it->second;
+    if (c->dead) continue;
+    if (evs[i].events & EPOLLOUT) {
+      if (!flush_locked(lp, c)) {
+        emit_closed(lp, c, outv, "send failed");
+        continue;
+      }
+    }
+    if (evs[i].events & (EPOLLIN | EPOLLHUP | EPOLLERR)) {
+      // bounded read budget per conn per wakeup (fairness, like the
+      // Python _FrameBuffer's budget); level-triggered epoll re-fires.
+      // SIGNED so a final recv larger than the remainder can't wrap it
+      ssize_t budget = ssize_t(8 * kRecvChunk);
+      bool closed = false;
+      std::string reason;
+      while (budget > 0) {
+        size_t old = c->rbuf.size();
+        c->rbuf.resize(old + kRecvChunk);
+        ssize_t r = ::recv(c->fd, c->rbuf.data() + old, kRecvChunk, 0);
+        if (r > 0) {
+          c->rbuf.resize(old + size_t(r));
+          budget -= r;
+          continue;
+        }
+        c->rbuf.resize(old);
+        if (r == 0) {
+          closed = true;
+          reason = "socket closed";
+        } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          // drained
+        } else if (errno == EINTR) {
+          continue;
+        } else {
+          closed = true;
+          reason = std::strerror(errno);
+        }
+        break;
+      }
+      if (!drain_frames(lp, c, outv, reason)) {
+        closed = true;
+        if (reason.empty()) reason = "protocol error";
+      }
+      if (closed) {
+        emit_closed(lp, c, outv, reason);
+        continue;
+      }
+    }
+  }
+  for (uint64_t cid : lp->pending_close) {
+    auto it = lp->conns.find(cid);
+    if (it != lp->conns.end()) {
+      delete it->second;
+      lp->conns.erase(it);
+    }
+  }
+  lp->pending_close.clear();
+  if (outv.size() > cap) {
+    // caller's buffer too small — deliver what fits on the next call via
+    // the parked-overflow stash (rare: cap is sized ≥ inline max * 64)
+    auto* bf = new BigFrame();
+    bf->data = std::move(outv);
+    uint64_t token = lp->next_token.fetch_add(1);
+    lp->frames.emplace(token, bf);
+    // special record: kind 3 = overflow handle
+    std::vector<uint8_t> rec;
+    uint8_t body[16];
+    std::memcpy(body, &token, 8);
+    uint32_t flen = uint32_t(bf->data.size());
+    std::memcpy(body + 8, &flen, 4);
+    uint32_t zero = 0;
+    std::memcpy(body + 12, &zero, 4);
+    wr_record(rec, 0, 3, body, 16);
+    std::memcpy(out, rec.data(), rec.size());
+    return int64_t(rec.size());
+  }
+  if (!outv.empty()) std::memcpy(out, outv.data(), outv.size());
+  return int64_t(outv.size());
+}
+
+const uint8_t* rt_frame_ptr(void* h, uint64_t token) {
+  auto* lp = static_cast<Loop*>(h);
+  std::lock_guard<std::mutex> g(lp->mu);
+  auto it = lp->frames.find(token);
+  return it == lp->frames.end() ? nullptr : it->second->data.data();
+}
+
+void rt_frame_free(void* h, uint64_t token) {
+  auto* lp = static_cast<Loop*>(h);
+  std::lock_guard<std::mutex> g(lp->mu);
+  auto it = lp->frames.find(token);
+  if (it != lp->frames.end()) {
+    delete it->second;
+    lp->frames.erase(it);
+  }
+}
+
+// How many bytes are waiting in a connection's send buffer (0 if none /
+// unknown conn) — lets Python surface backpressure.
+uint64_t rt_loop_pending(void* h, uint64_t conn_id) {
+  auto* lp = static_cast<Loop*>(h);
+  std::lock_guard<std::mutex> g(lp->mu);
+  auto it = lp->conns.find(conn_id);
+  if (it == lp->conns.end()) return 0;
+  uint64_t total = 0;
+  for (auto& v : it->second->sendq) total += v.size();
+  return total;
+}
+
+}  // extern "C"

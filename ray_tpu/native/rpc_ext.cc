@@ -1,0 +1,265 @@
+// CPython extension binding for the native RPC loop (rpc_core.cc).
+//
+// ctypes added ~5-10us per call (argument marshalling + array building),
+// which ate the C++ transport's win on small control frames — this
+// extension exposes the same loop through METH_FASTCALL entry points that
+// accept buffer objects directly and RETURN ready Python objects:
+//   poll(timeout_ms) -> list[(conn_id, kind, payload_bytes)] built in C,
+// so the Python pump does zero record parsing. (reference analogue:
+// _raylet.pyx binding the C++ core_worker — python/ray/_raylet.pyx.)
+//
+// Compiled together with rpc_core.cc (see rpc_native.py build line).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <vector>
+
+// the C-ABI surface of rpc_core.cc
+extern "C" {
+void* rt_loop_new(uint64_t max_frame_bytes);
+void rt_loop_free(void* h);
+int rt_loop_add(void* h, uint64_t conn_id, int fd);
+int rt_loop_remove(void* h, uint64_t conn_id);
+int rt_loop_sendv(void* h, uint64_t conn_id, const uint8_t* const* parts,
+                  const uint64_t* sizes, int nparts);
+int64_t rt_loop_poll(void* h, uint8_t* out, uint64_t cap, int timeout_ms);
+const uint8_t* rt_frame_ptr(void* h, uint64_t token);
+void rt_frame_free(void* h, uint64_t token);
+uint64_t rt_loop_pending(void* h, uint64_t conn_id);
+}
+
+namespace {
+
+constexpr size_t kPollBuf = 8 * 1024 * 1024;
+
+struct LoopObject {
+  PyObject_HEAD
+  void* loop;
+  uint8_t* pollbuf;
+};
+
+PyTypeObject LoopType;  // fwd
+
+PyObject* Loop_new_py(PyObject*, PyObject* args) {
+  unsigned long long max_frame = 0;
+  if (!PyArg_ParseTuple(args, "K", &max_frame)) return nullptr;
+  auto* self = PyObject_New(LoopObject, &LoopType);
+  if (!self) return nullptr;
+  self->loop = rt_loop_new(max_frame);
+  self->pollbuf = static_cast<uint8_t*>(PyMem_RawMalloc(kPollBuf));
+  if (!self->loop || !self->pollbuf) {
+    Py_DECREF(self);
+    PyErr_SetString(PyExc_RuntimeError, "rt_loop_new failed");
+    return nullptr;
+  }
+  return reinterpret_cast<PyObject*>(self);
+}
+
+void Loop_dealloc(PyObject* o) {
+  auto* self = reinterpret_cast<LoopObject*>(o);
+  if (self->loop) rt_loop_free(self->loop);
+  if (self->pollbuf) PyMem_RawFree(self->pollbuf);
+  PyObject_Free(o);
+}
+
+PyObject* Loop_add(PyObject* o, PyObject* const* args, Py_ssize_t n) {
+  auto* self = reinterpret_cast<LoopObject*>(o);
+  if (n != 2) {
+    PyErr_SetString(PyExc_TypeError, "add(conn_id, fd)");
+    return nullptr;
+  }
+  uint64_t cid = PyLong_AsUnsignedLongLong(args[0]);
+  long fd = PyLong_AsLong(args[1]);
+  if (PyErr_Occurred()) return nullptr;
+  int rc = rt_loop_add(self->loop, cid, int(fd));
+  return PyLong_FromLong(rc);
+}
+
+PyObject* Loop_remove(PyObject* o, PyObject* const* args, Py_ssize_t n) {
+  auto* self = reinterpret_cast<LoopObject*>(o);
+  if (n != 1) {
+    PyErr_SetString(PyExc_TypeError, "remove(conn_id)");
+    return nullptr;
+  }
+  uint64_t cid = PyLong_AsUnsignedLongLong(args[0]);
+  if (PyErr_Occurred()) return nullptr;
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = rt_loop_remove(self->loop, cid);
+  Py_END_ALLOW_THREADS
+  return PyLong_FromLong(rc);
+}
+
+// sendv(conn_id, parts) — parts: tuple/list of bytes-like objects.
+PyObject* Loop_sendv(PyObject* o, PyObject* const* args, Py_ssize_t n) {
+  auto* self = reinterpret_cast<LoopObject*>(o);
+  if (n != 2) {
+    PyErr_SetString(PyExc_TypeError, "sendv(conn_id, parts)");
+    return nullptr;
+  }
+  uint64_t cid = PyLong_AsUnsignedLongLong(args[0]);
+  if (PyErr_Occurred()) return nullptr;
+  PyObject* seq = PySequence_Fast(args[1], "parts must be a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t np = PySequence_Fast_GET_SIZE(seq);
+  const size_t count = static_cast<size_t>(np);
+  std::vector<Py_buffer> views(count);
+  std::vector<const uint8_t*> ptrs(count);
+  std::vector<uint64_t> sizes(count);
+  Py_ssize_t got = 0;
+  int rc = 0;
+  for (; got < np; got++) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, got);
+    if (PyObject_GetBuffer(item, &views[size_t(got)], PyBUF_CONTIG_RO) != 0) {
+      rc = -100;
+      break;
+    }
+    ptrs[size_t(got)] = static_cast<const uint8_t*>(views[size_t(got)].buf);
+    sizes[size_t(got)] = uint64_t(views[size_t(got)].len);
+  }
+  if (rc == 0) {
+    Py_BEGIN_ALLOW_THREADS
+    rc = rt_loop_sendv(self->loop, cid, ptrs.data(), sizes.data(), int(np));
+    Py_END_ALLOW_THREADS
+  }
+  for (Py_ssize_t i = 0; i < got; i++) PyBuffer_Release(&views[size_t(i)]);
+  Py_DECREF(seq);
+  if (rc == -100) return nullptr;  // buffer error already set
+  return PyLong_FromLong(rc);
+}
+
+// Parse one packed record stream into out_list (list of tuples).
+int parse_records(void* loop, const uint8_t* buf, size_t nbytes,
+                  PyObject* out_list) {
+  size_t off = 0;
+  while (off + 16 <= nbytes) {
+    uint64_t cid;
+    uint32_t rkind, ln;
+    memcpy(&cid, buf + off, 8);
+    memcpy(&rkind, buf + off + 8, 4);
+    memcpy(&ln, buf + off + 12, 4);
+    off += 16;
+    const uint8_t* payload = buf + off;
+    off += (size_t(ln) + 7) & ~size_t(7);
+    PyObject* tup = nullptr;
+    if (rkind == 0) {
+      // inline frame: first byte = wire kind
+      if (ln < 1) continue;
+      PyObject* body = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(payload + 1), Py_ssize_t(ln - 1));
+      if (!body) return -1;
+      tup = Py_BuildValue("(KiN)", (unsigned long long)cid, int(payload[0]),
+                          body);
+    } else if (rkind == 1) {
+      PyObject* reason = PyUnicode_DecodeUTF8(
+          reinterpret_cast<const char*>(payload), Py_ssize_t(ln), "replace");
+      if (!reason) return -1;
+      tup = Py_BuildValue("(KiN)", (unsigned long long)cid, -1, reason);
+    } else if (rkind == 2) {
+      uint64_t token;
+      uint32_t flen, wkind;
+      memcpy(&token, payload, 8);
+      memcpy(&flen, payload + 8, 4);
+      memcpy(&wkind, payload + 12, 4);
+      const uint8_t* fp = rt_frame_ptr(loop, token);
+      if (!fp) continue;
+      PyObject* body = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(fp), Py_ssize_t(flen));
+      rt_frame_free(loop, token);
+      if (!body) return -1;
+      tup = Py_BuildValue("(KiN)", (unsigned long long)cid, int(wkind), body);
+    } else if (rkind == 3) {
+      uint64_t token;
+      uint32_t flen;
+      memcpy(&token, payload, 8);
+      memcpy(&flen, payload + 8, 4);
+      const uint8_t* fp = rt_frame_ptr(loop, token);
+      if (!fp) continue;
+      int r = parse_records(loop, fp, flen, out_list);
+      rt_frame_free(loop, token);
+      if (r != 0) return r;
+      continue;
+    } else {
+      continue;
+    }
+    if (!tup) return -1;
+    if (PyList_Append(out_list, tup) != 0) {
+      Py_DECREF(tup);
+      return -1;
+    }
+    Py_DECREF(tup);
+  }
+  return 0;
+}
+
+// poll(timeout_ms) -> list of (conn_id, kind, payload)
+//   kind >= 0: wire frame kind, payload = body bytes
+//   kind == -1: closed, payload = reason str
+PyObject* Loop_poll(PyObject* o, PyObject* const* args, Py_ssize_t n) {
+  auto* self = reinterpret_cast<LoopObject*>(o);
+  if (n != 1) {
+    PyErr_SetString(PyExc_TypeError, "poll(timeout_ms)");
+    return nullptr;
+  }
+  long timeout_ms = PyLong_AsLong(args[0]);
+  if (PyErr_Occurred()) return nullptr;
+  int64_t got;
+  Py_BEGIN_ALLOW_THREADS
+  got = rt_loop_poll(self->loop, self->pollbuf, kPollBuf, int(timeout_ms));
+  Py_END_ALLOW_THREADS
+  if (got < 0) Py_RETURN_NONE;  // loop shut down
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  if (parse_records(self->loop, self->pollbuf, size_t(got), out) != 0) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+PyObject* Loop_pending(PyObject* o, PyObject* const* args, Py_ssize_t n) {
+  auto* self = reinterpret_cast<LoopObject*>(o);
+  if (n != 1) {
+    PyErr_SetString(PyExc_TypeError, "pending(conn_id)");
+    return nullptr;
+  }
+  uint64_t cid = PyLong_AsUnsignedLongLong(args[0]);
+  if (PyErr_Occurred()) return nullptr;
+  return PyLong_FromUnsignedLongLong(rt_loop_pending(self->loop, cid));
+}
+
+PyMethodDef Loop_methods[] = {
+    {"add", reinterpret_cast<PyCFunction>(Loop_add), METH_FASTCALL, nullptr},
+    {"remove", reinterpret_cast<PyCFunction>(Loop_remove), METH_FASTCALL,
+     nullptr},
+    {"sendv", reinterpret_cast<PyCFunction>(Loop_sendv), METH_FASTCALL,
+     nullptr},
+    {"poll", reinterpret_cast<PyCFunction>(Loop_poll), METH_FASTCALL, nullptr},
+    {"pending", reinterpret_cast<PyCFunction>(Loop_pending), METH_FASTCALL,
+     nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyMethodDef module_methods[] = {
+    {"loop_new", Loop_new_py, METH_VARARGS, "loop_new(max_frame_bytes)"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef rtrpc_module = {
+    PyModuleDef_HEAD_INIT, "_rtrpc", "native rpc transport", -1,
+    module_methods,        nullptr,  nullptr,                nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__rtrpc(void) {
+  LoopType = {PyVarObject_HEAD_INIT(nullptr, 0) "_rtrpc.Loop"};
+  LoopType.tp_basicsize = sizeof(LoopObject);
+  LoopType.tp_dealloc = Loop_dealloc;
+  LoopType.tp_flags = Py_TPFLAGS_DEFAULT;
+  LoopType.tp_methods = Loop_methods;
+  if (PyType_Ready(&LoopType) < 0) return nullptr;
+  return PyModule_Create(&rtrpc_module);
+}

@@ -34,7 +34,9 @@ from ray_tpu.rl.connectors import (
 from ray_tpu.rl.td3 import DDPG, DDPGConfig, TD3, TD3Config, TD3RolloutWorker
 from ray_tpu.rl.dqn import DQN, DQNConfig, DQNLearner, DQNRolloutWorker, QNetwork
 from ray_tpu.rl.env import CartPole, Pendulum, VectorEnv, make_env
+from ray_tpu.rl.apex import ApexDQN, ApexDQNConfig, ReplayShardActor
 from ray_tpu.rl.impala import Impala, ImpalaConfig, ImpalaLearner, vtrace
+from ray_tpu.rl.policy_server import PolicyClient, PolicyServer
 from ray_tpu.rl.learner import LearnerGroup, PPOLearner, PPOLossConfig
 from ray_tpu.rl.multi_agent import (
     IndependentCartPoles,
@@ -102,7 +104,12 @@ __all__ = [
     "DQNRolloutWorker",
     "DiscretePolicyModule",
     "Impala",
+    "ApexDQN",
+    "ApexDQNConfig",
     "ImpalaConfig",
+    "PolicyClient",
+    "PolicyServer",
+    "ReplayShardActor",
     "ImpalaLearner",
     "LearnerGroup",
     "PPO",

@@ -1,0 +1,261 @@
+"""Ape-X DQN: distributed prioritized replay with decoupled sampling.
+
+Reference: rllib/algorithms/apex_dqn/apex_dqn.py (Horgan et al., "Distributed
+Prioritized Experience Replay"): rollout workers compute INITIAL priorities
+locally and push transitions straight into sharded replay ACTORS (never
+through the driver); the learner pulls minibatches from the shards while
+sampling continues — sampling and learning overlap instead of alternating
+(the structural difference from the synchronous DQN loop, dqn.py DQN.train).
+
+The capability class this exercises beyond plain DQN: actor→actor data
+paths, sharded mutable state with priority writeback, and a driver loop
+built on ray_tpu.wait pipelining rather than lockstep gets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.dqn import DQNConfig, DQNLearner, DQNRolloutWorker
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.replay_buffers import PrioritizedReplayBuffer
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+@ray_tpu.remote
+class ReplayShardActor:
+    """One shard of the distributed replay memory (reference:
+    apex_dqn.py's replay actor set). Holds a PrioritizedReplayBuffer;
+    workers add with worker-computed initial priorities, the learner
+    samples and writes trained TD priorities back."""
+
+    def __init__(self, capacity: int, alpha: float, seed: int):
+        self.buffer = PrioritizedReplayBuffer(capacity, alpha=alpha, seed=seed)
+
+    def add(self, batch: SampleBatch, priorities) -> int:
+        idx = self.buffer.add(batch)
+        if priorities is not None:
+            self.buffer.update_priorities(idx, np.asarray(priorities))
+        return len(self.buffer)
+
+    def sample(self, n: int, beta: float):
+        if len(self.buffer) < n:
+            return None
+        return self.buffer.sample(n, beta=beta)
+
+    def update_priorities(self, indexes, td) -> bool:
+        self.buffer.update_priorities(indexes, td)
+        return True
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+
+@ray_tpu.remote
+class ApexRolloutWorker(DQNRolloutWorker._cls):
+    """DQN rollout worker that pushes straight to replay shards with
+    locally-computed initial TD priorities (the Ape-X worker contract)."""
+
+    def __init__(self, env_name: str, *, gamma: float = 0.99, **kw):
+        super().__init__(env_name, **kw)
+        self.gamma = gamma
+
+        def td_error(params, obs, actions, rewards, new_obs, dones):
+            q = self.net.apply({"params": params}, obs)
+            q_taken = jnp.take_along_axis(
+                q, actions[:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            q_next = self.net.apply({"params": params}, new_obs)
+            best = jnp.max(q_next, axis=-1)
+            target = rewards + self.gamma * (1.0 - dones) * best
+            return q_taken - target
+
+        self._td = jax.jit(td_error)
+
+    def sample_to_replay(
+        self, num_steps: int, epsilon: float, shard, steps_before: int
+    ) -> Tuple[int, int]:
+        """Collect, compute initial priorities, push to the given shard.
+        Returns (env steps collected, shard size after the push)."""
+        batch = self.sample(num_steps, epsilon)
+        td = np.asarray(
+            self._td(
+                self.params,
+                jnp.asarray(batch["obs"]),
+                jnp.asarray(batch["actions"]),
+                jnp.asarray(batch["rewards"]),
+                jnp.asarray(batch["new_obs"]),
+                jnp.asarray(batch["dones"], jnp.float32),
+            )
+        )
+        size = ray_tpu.get(shard.add.remote(batch, td), timeout=120)
+        return len(batch), size
+
+
+@dataclasses.dataclass
+class ApexDQNConfig(DQNConfig):
+    num_replay_shards: int = 2
+    # how many sample_to_replay futures stay in flight per worker
+    max_inflight_per_worker: int = 2
+    weight_sync_interval_s: float = 2.0
+
+    def build(self) -> "ApexDQN":
+        return ApexDQN(self)
+
+
+class ApexDQN:
+    """Driver: pipelined sampling into shards + continuous learner pulls."""
+
+    def __init__(self, config: ApexDQNConfig):
+        self.config = config
+        probe = make_env(config.env)
+        self.learner = DQNLearner(
+            probe.observation_size, probe.num_actions,
+            hidden=config.hidden, lr=config.lr, gamma=config.gamma,
+            seed=config.seed,
+        )
+        self.shards = [
+            ReplayShardActor.remote(
+                max(1, config.buffer_size // config.num_replay_shards),
+                config.per_alpha,
+                config.seed + 7 * i,
+            )
+            for i in range(config.num_replay_shards)
+        ]
+        self.workers = [
+            ApexRolloutWorker.remote(
+                config.env,
+                gamma=config.gamma,
+                num_envs=config.num_envs_per_worker,
+                seed=config.seed + 1000 * i,
+                hidden=config.hidden,
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self._env_steps = 0
+        self._updates = 0
+        self._iteration = 0
+        self._inflight: Dict[Any, Any] = {}  # future -> worker
+        self._shard_rr = 0
+        self._last_sync = 0.0
+        self._broadcast_weights()
+
+    def _broadcast_weights(self):
+        weights = self.learner.get_weights()
+        ray_tpu.get(
+            [w.set_weights.remote(weights) for w in self.workers], timeout=120
+        )
+        self._last_sync = time.monotonic()
+
+    def _kick_workers(self):
+        cfg = self.config
+        counts: Dict[Any, int] = {id(w): 0 for w in self.workers}
+        for worker in self._inflight.values():
+            counts[id(worker)] += 1
+        for worker in self.workers:
+            while counts[id(worker)] < cfg.max_inflight_per_worker:
+                shard = self.shards[self._shard_rr % len(self.shards)]
+                self._shard_rr += 1
+                fut = worker.sample_to_replay.remote(
+                    cfg.rollout_fragment_length, self.epsilon, shard,
+                    self._env_steps,
+                )
+                self._inflight[fut] = worker
+                counts[id(worker)] += 1
+
+    def _reap_workers(self, timeout: float = 0.0):
+        if not self._inflight:
+            return
+        done, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=len(self._inflight),
+            timeout=timeout,
+        )
+        for fut in done:
+            self._inflight.pop(fut, None)
+            try:
+                steps, _size = ray_tpu.get(fut, timeout=60)
+                self._env_steps += steps
+            except Exception:
+                pass  # worker died: the remaining fleet keeps sampling
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: keep the sampling pipeline full, run
+        ``updates_per_iteration`` learner updates against the shards."""
+        t0 = time.perf_counter()
+        cfg = self.config
+        losses: List[float] = []
+        self._kick_workers()
+        while len(losses) < cfg.updates_per_iteration:
+            self._reap_workers(timeout=0.0)
+            self._kick_workers()
+            shard = self.shards[self._shard_rr % len(self.shards)]
+            self._shard_rr += 1
+            mb = ray_tpu.get(
+                shard.sample.remote(cfg.train_batch_size, cfg.per_beta),
+                timeout=120,
+            )
+            if mb is None:
+                # shard not warm yet: give sampling the core for a moment
+                self._reap_workers(timeout=0.25)
+                if self._env_steps >= cfg.learning_starts:
+                    continue
+                if time.perf_counter() - t0 > 30:
+                    break
+                continue
+            loss, td = self.learner.update(mb)
+            shard.update_priorities.remote(mb["batch_indexes"], td)
+            losses.append(loss)
+            self._updates += 1
+            if self._updates % cfg.target_update_interval == 0:
+                self.learner.sync_target()
+            if time.monotonic() - self._last_sync > cfg.weight_sync_interval_s:
+                self._broadcast_weights()
+        self._reap_workers(timeout=0.0)
+
+        episode_returns: List[float] = []
+        for w in self.workers:
+            try:
+                episode_returns.extend(
+                    ray_tpu.get(w.episode_returns.remote(), timeout=60)
+                )
+            except Exception:
+                pass
+        self._iteration += 1
+        shard_sizes = ray_tpu.get(
+            [s.size.remote() for s in self.shards], timeout=60
+        )
+        return {
+            "training_iteration": self._iteration,
+            "env_steps_total": self._env_steps,
+            "num_updates": self._updates,
+            "epsilon": self.epsilon,
+            "replay_shard_sizes": shard_sizes,
+            "buffer_size": int(sum(shard_sizes)),
+            "mean_loss": float(np.mean(losses)) if losses else float("nan"),
+            "episode_return_mean": float(np.mean(episode_returns))
+            if episode_returns else float("nan"),
+            "episodes_this_iter": len(episode_returns),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def stop(self):
+        for fut in list(self._inflight):
+            self._inflight.pop(fut, None)
+        for actor in (*self.workers, *self.shards):
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass

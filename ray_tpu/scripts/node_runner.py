@@ -78,6 +78,7 @@ def main() -> int:
                 f"{node.gcs_address[0]}:{node.gcs_address[1]}",
                 host=args.host,
                 port=args.dashboard_port,
+                session_dir=node.session_dir,
             )
             dashboard_addr = f"{dashboard.address[0]}:{dashboard.address[1]}"
         except OSError:

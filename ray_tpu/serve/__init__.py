@@ -27,14 +27,18 @@ __all__ = [
     "DeploymentResponse",
     "HTTPProxy",
     "apply",
+    "DAGDriver",
+    "InputNode",
     "batch",
     "build",
+    "build_graph",
     "delete",
     "deployment",
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "multiplexed",
     "run",
+    "run_graph",
     "shutdown",
     "start_http_proxy",
     "status",
@@ -318,3 +322,12 @@ def apply(config: Dict[str, Any], *, timeout: float = 60.0) -> DeploymentHandle:
     # first deployment, matching the file's declaration order
     ingress = config.get("ingress") or config["deployments"][0]["name"]
     return handles[ingress]
+
+
+# explicit deployment-graph API (reference: serve/deployment_graph.py)
+from ray_tpu.serve.dag import (  # noqa: E402
+    DAGDriver,
+    InputNode,
+    build as build_graph,
+    run_graph,
+)

@@ -1,0 +1,248 @@
+"""Serve deployment graphs: an explicit, inspectable DAG API.
+
+Reference: python/ray/serve/deployment_graph.py + dag.py — the
+``InputNode`` / ``.bind()`` authoring surface and the ``DAGDriver`` that
+routes each request through the graph. Composition via handles in init
+args (serve/__init__.py _deploy_tree) stays the implicit path; this module
+adds the explicit build/inspect surface the reference exposes:
+
+    with InputNode() as inp:
+        a = preprocess.bind()            # Application (class node)
+        features = a.transform.bind(inp) # MethodNode
+        out = model.predict.bind(features)
+    graph = build(out)                   # inspectable plan
+    handle = run_graph(out)              # DAGDriver deployment
+
+Per request the driver topologically evaluates the node plan, fanning
+independent branches out concurrently through DeploymentHandles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["DAGDriver", "InputNode", "MethodNode", "build", "run_graph"]
+
+
+class InputNode:
+    """Placeholder for the per-request payload (reference:
+    deployment_graph.py InputNode; usable as a context manager the way the
+    reference's examples write it)."""
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def __repr__(self):
+        return "InputNode()"
+
+
+class MethodNode:
+    """A bound call of a deployment method on upstream values."""
+
+    def __init__(self, app, method: str, args: Tuple[Any, ...]):
+        self.app = app
+        self.method = method
+        self.args = args
+
+    def __repr__(self):
+        return f"MethodNode({self.app.deployment.name}.{self.method})"
+
+    # chaining: a MethodNode's result can feed another bind
+    def bind(self, *args):  # pragma: no cover - parity convenience
+        raise TypeError(
+            "MethodNode is a value; bind methods on an Application "
+            "(deployment.bind().method.bind(...))"
+        )
+
+
+class _MethodBinder:
+    def __init__(self, app, method: str):
+        self._app = app
+        self._method = method
+
+    def bind(self, *args) -> MethodNode:
+        return MethodNode(self._app, self._method, args)
+
+
+def _install_application_binder():
+    """Give Application dotted method binding (app.method.bind(...)) without
+    touching its own attributes."""
+    from ray_tpu.serve import Application
+
+    if getattr(Application, "_dag_binder_installed", False):
+        return
+
+    def __getattr__(self, name):  # noqa: N807 - class patch
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodBinder(self, name)
+
+    Application.__getattr__ = __getattr__
+    Application._dag_binder_installed = True
+
+
+_install_application_binder()
+
+
+# ---------------------------------------------------------------------------
+# build: node graph -> serializable plan
+# ---------------------------------------------------------------------------
+
+
+class BuiltGraph:
+    """The inspectable plan: ``nodes`` in topological order, each
+    {"id", "type", "deployment", "method", "args"} where args reference
+    upstream ids as {"node": id} and literals verbatim."""
+
+    def __init__(self, nodes: List[Dict[str, Any]], apps: List[Any], output_id: int):
+        self.nodes = nodes
+        self.apps = apps  # distinct Applications, deploy order
+        self.output_id = output_id
+
+    def __repr__(self):
+        lines = [
+            f"  %{n['id']} = {n['type']}"
+            + (
+                f" {n['deployment']}.{n['method']}("
+                + ", ".join(
+                    f"%{a['node']}" if isinstance(a, dict) and "node" in a else repr(a)
+                    for a in n["args"]
+                )
+                + ")"
+                if n["type"] == "method"
+                else ""
+            )
+            for n in self.nodes
+        ]
+        return "BuiltGraph(\n" + "\n".join(lines) + f"\n) -> %{self.output_id}"
+
+
+def build(output) -> BuiltGraph:
+    """Flatten the node graph reachable from ``output`` into a plan
+    (reference: serve.build on a deployment graph)."""
+    from ray_tpu.serve import Application
+
+    nodes: List[Dict[str, Any]] = []
+    apps: List[Any] = []
+    seen: Dict[int, int] = {}  # id(obj) -> node id
+    keep: List[Any] = []  # pin traversed objects so ids stay unique
+
+    def visit(node) -> int:
+        if id(node) in seen:
+            return seen[id(node)]
+        keep.append(node)
+        if isinstance(node, InputNode):
+            nid = len(nodes)
+            nodes.append({"id": nid, "type": "input", "args": []})
+        elif isinstance(node, MethodNode):
+            app = node.app
+            if not isinstance(app, Application):
+                raise TypeError(f"MethodNode app must be an Application, got {app!r}")
+            if app not in apps:
+                apps.append(app)
+            arg_spec: List[Any] = []
+            for a in node.args:
+                if isinstance(a, (InputNode, MethodNode)):
+                    arg_spec.append({"node": visit(a)})
+                else:
+                    arg_spec.append(a)
+            nid = len(nodes)
+            nodes.append(
+                {
+                    "id": nid,
+                    "type": "method",
+                    "deployment": app.deployment.name,
+                    "method": node.method,
+                    "args": arg_spec,
+                }
+            )
+        else:
+            raise TypeError(f"not a DAG node: {node!r}")
+        seen[id(node)] = nid
+        return nid
+
+    out_id = visit(output)
+    return BuiltGraph(nodes, apps, out_id)
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+
+class _DAGDriverImpl:
+    """Evaluates the plan per request. Independent branches fan out
+    concurrently: every node's call fires as soon as its inputs resolve
+    (DeploymentResponse futures chain through .result())."""
+
+    def __init__(self, plan: Dict[str, Any]):
+        from ray_tpu.serve import get_deployment_handle
+
+        self.plan = plan
+        self.handles = {
+            n["deployment"]: get_deployment_handle(n["deployment"])
+            for n in plan["nodes"]
+            if n["type"] == "method"
+        }
+
+    def __call__(self, request):
+        values: Dict[int, Any] = {}
+        pending: Dict[int, Any] = {}  # node id -> DeploymentResponse
+
+        def resolved(nid):
+            if nid in values:
+                return True
+            if nid in pending:
+                values[nid] = pending.pop(nid).result(timeout=60.0)
+                return True
+            return False
+
+        # topological order is construction order (build() appends children
+        # before parents)
+        for n in self.plan["nodes"]:
+            if n["type"] == "input":
+                values[n["id"]] = request
+                continue
+            args = []
+            for a in n["args"]:
+                if isinstance(a, dict) and "node" in a:
+                    resolved(a["node"])
+                    args.append(values[a["node"]])
+                else:
+                    args.append(a)
+            handle = self.handles[n["deployment"]]
+            pending[n["id"]] = getattr(handle, n["method"]).remote(*args)
+        out_id = self.plan["output_id"]
+        resolved(out_id)
+        return values[out_id]
+
+
+def run_graph(
+    output,
+    *,
+    name: str = "DAGDriver",
+    num_replicas: int = 1,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    timeout: float = 60.0,
+):
+    """Deploy every Application in the graph, then a DAGDriver deployment
+    that executes the plan per request; returns the driver's handle."""
+    import ray_tpu.serve as serve
+
+    graph = build(output)
+    for app in graph.apps:
+        serve.run(app, timeout=timeout)
+    plan = {"nodes": graph.nodes, "output_id": graph.output_id}
+    driver_app = serve.deployment(
+        _DAGDriverImpl,
+        name=name,
+        num_replicas=num_replicas,
+        ray_actor_options=ray_actor_options,
+    ).bind(plan)
+    return serve.run(driver_app, timeout=timeout)
+
+
+DAGDriver = _DAGDriverImpl

@@ -12,12 +12,14 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     ConcurrencyLimiter,
+    Repeater,
     Searcher,
     TPESearcher,
     choice,
@@ -52,7 +54,9 @@ __all__ = [
     "FIFOScheduler",
     "HyperBandScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
+    "Repeater",
     "ProgressReporter",
     "Searcher",
     "ResultGrid",

@@ -371,3 +371,135 @@ class PopulationBasedTraining(TrialScheduler):
         factor = self._rng.choice(self.perturbation_factors)
         out = value * factor
         return int(round(out)) if isinstance(value, int) else out
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits: PBT whose EXPLORE step picks new numeric
+    hyperparameters with a GP-UCB bandit instead of random multiply/resample.
+
+    Reference: tune/schedulers/pb2.py (Parker-Holder et al., "Provably
+    Efficient Online Hyperparameter Optimization with Population-Based
+    Bandits", NeurIPS 2020). The reference delegates the GP to GPy; here
+    the GP is a small exact-RBF implementation in numpy: fit reward DELTAS
+    over intervals as a function of the (normalized) numeric config, then
+    select the candidate maximizing mean + kappa * std within the mutation
+    bounds. Non-numeric keys keep PBT's mutation semantics.
+    """
+
+    def __init__(self, *args, kappa: float = 1.5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.kappa = kappa
+        # observation log: ([t, *numeric config], reward delta) — time is a
+        # GP input (the paper's time-varying bandit): on non-stationary
+        # surfaces the kernel localizes predictions to the CURRENT phase of
+        # training instead of pooling early and late reward signals
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._t_max = 1.0
+        self._last_score_at_perturb: Dict[str, float] = {}
+        self._numeric_keys: Optional[List[str]] = None
+        self._bounds: Dict[str, Tuple[float, float]] = {}
+
+    # -- data collection ---------------------------------------------------
+
+    def _numeric_spec_bounds(self, key) -> Optional[Tuple[float, float]]:
+        from ray_tpu.tune.search import LogUniform, RandInt, Uniform
+
+        spec = self.hyperparam_mutations.get(key)
+        if isinstance(spec, (Uniform, LogUniform, RandInt)):
+            return float(spec.low), float(spec.high)
+        if isinstance(spec, (list, tuple)) and all(
+            isinstance(v, (int, float)) for v in spec
+        ):
+            return float(min(spec)), float(max(spec))
+        return None
+
+    def _vec(self, config: Dict[str, Any]) -> Optional[List[float]]:
+        if self._numeric_keys is None:
+            self._numeric_keys = sorted(
+                k for k in self.hyperparam_mutations
+                if self._numeric_spec_bounds(k) is not None
+            )
+            for k in self._numeric_keys:
+                self._bounds[k] = self._numeric_spec_bounds(k)
+        if not self._numeric_keys:
+            return None
+        vec = []
+        for k in self._numeric_keys:
+            lo, hi = self._bounds[k]
+            v = float(config.get(k, lo))
+            vec.append((v - lo) / max(hi - lo, 1e-12))
+        return vec
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        # record the reward delta of the completed interval BEFORE the
+        # parent updates its bookkeeping
+        if self.metric is not None and self.metric in result:
+            t = result.get(self.time_attr) or 0
+            if t - self._last_perturb.get(trial_id, 0) >= self.perturbation_interval:
+                sign = 1.0 if (self.mode or "max") == "max" else -1.0
+                score = sign * float(result[self.metric])
+                prev = self._last_score_at_perturb.get(trial_id)
+                vec = self._vec(self._configs.get(trial_id, {}))
+                if prev is not None and vec is not None:
+                    self._t_max = max(self._t_max, float(t))
+                    self._obs_x.append([float(t), *vec])
+                    self._obs_y.append(score - prev)
+                self._last_score_at_perturb[trial_id] = score
+        return super().on_result(trial_id, result)
+
+    def commit_exploit(self, trial_id: str, new_cfg: Dict[str, Any]):
+        super().commit_exploit(trial_id, new_cfg)
+        # the exploited trial restarts from the donor's checkpoint: its
+        # next delta baseline is the donor's level, unknown here — reset
+        self._last_score_at_perturb.pop(trial_id, None)
+
+    # -- the bandit explore ------------------------------------------------
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = super()._explore(config)  # categorical/fallback mutations
+        vec = self._vec(config)
+        if vec is None or len(self._obs_x) < 4:
+            return new  # not enough data: PBT behavior
+        import numpy as np
+
+        X = np.asarray(self._obs_x[-64:], dtype=np.float64)
+        y = np.asarray(self._obs_y[-64:], dtype=np.float64)
+        X = X.copy()
+        X[:, 0] /= self._t_max  # normalize the time axis to [0, 1]
+        y_std = y.std() or 1.0
+        yn = (y - y.mean()) / y_std
+        # exact GP, RBF kernel in the normalized unit cube
+        ls, noise = 0.2, 1e-3
+        d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        K = np.exp(-d2 / (2 * ls * ls)) + noise * np.eye(len(X))
+        try:
+            L = np.linalg.cholesky(K)
+        except np.linalg.LinAlgError:
+            return new
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        # candidates: trust region around the donor + global draws, all
+        # evaluated at the CURRENT (latest) time — we are choosing a config
+        # for the NEXT interval
+        rng = np.random.default_rng(self._rng.randrange(1 << 31))
+        local = np.clip(
+            np.asarray(vec) + rng.normal(scale=0.15, size=(128, len(vec))),
+            0.0, 1.0,
+        )
+        cands = np.vstack([local, rng.random((128, len(vec)))])
+        t_now = np.full((len(cands), 1), X[:, 0].max())
+        cands_t = np.hstack([t_now, cands])
+        dc2 = ((cands_t[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        Kc = np.exp(-dc2 / (2 * ls * ls))
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)
+        var = np.maximum(1.0 + noise - (v * v).sum(0), 1e-12)
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cands[int(np.argmax(ucb))]
+        for i, k in enumerate(self._numeric_keys):
+            lo, hi = self._bounds[k]
+            val = lo + float(best[i]) * (hi - lo)
+            if isinstance(config.get(k), int):
+                val = int(round(val))
+            new[k] = val
+        return new

@@ -405,3 +405,57 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result)
+
+
+class Repeater(Searcher):
+    """Evaluate every underlying suggestion ``repeat`` times and report the
+    MEAN metric back to the wrapped searcher once the whole group finishes
+    (reference: tune/search/repeater.py — variance reduction for noisy
+    objectives so model-based searchers fit the signal, not the noise)."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        super().__init__(searcher.metric, searcher.mode)
+        if repeat < 1:
+            raise ValueError("repeat must be >= 1")
+        self.searcher = searcher
+        self.repeat = repeat
+        self._group_of: Dict[str, str] = {}  # trial_id -> group leader id
+        self._groups: Dict[str, Dict[str, Any]] = {}  # leader -> state
+        self._current: Optional[Tuple[str, Dict[str, Any]]] = None
+        self._dealt = 0
+
+    def set_search_properties(self, metric, mode):
+        super().set_search_properties(metric, mode)
+        self.searcher.set_search_properties(metric, mode)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._current is None or self._dealt >= self.repeat:
+            cfg = self.searcher.suggest(trial_id)
+            if cfg is None:
+                return None
+            self._current = (trial_id, cfg)
+            self._groups[trial_id] = {"results": [], "config": dict(cfg)}
+            self._dealt = 0
+        leader, cfg = self._current
+        self._group_of[trial_id] = leader
+        self._dealt += 1
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None):
+        leader = self._group_of.pop(trial_id, None)
+        if leader is None:
+            return
+        group = self._groups.get(leader)
+        if group is None:
+            return
+        if result and self.metric in result:
+            group["results"].append(result[self.metric])
+        group.setdefault("done", 0)
+        group["done"] += 1
+        if group["done"] >= self.repeat:
+            del self._groups[leader]
+            values = group["results"]
+            mean = (
+                {self.metric: sum(values) / len(values)} if values else None
+            )
+            self.searcher.on_trial_complete(leader, mean)

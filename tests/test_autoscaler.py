@@ -236,3 +236,112 @@ def test_tpu_slice_provider_gang(ray_start_cluster):
     finally:
         provider.shutdown()
         ray_tpu.shutdown()
+
+
+def test_ssh_command_runner_argv_composition():
+    """SSHCommandRunner composes correct ssh/rsync argv (reference:
+    command_runner.py SSHCommandRunner); exec is injected, no network."""
+    from ray_tpu.autoscaler import SSHCommandRunner
+
+    calls = []
+
+    def fake_exec(argv, timeout):
+        calls.append(argv)
+        return "ok"
+
+    r = SSHCommandRunner("10.0.0.5", user="ubuntu", ssh_key="/k.pem",
+                         exec_fn=fake_exec)
+    r.run("echo hi", env={"A": "b c"})
+    argv = calls[-1]
+    assert argv[0] == "ssh" and "ubuntu@10.0.0.5" in argv
+    assert "-i" in argv and "/k.pem" in argv
+    assert argv[-1] == "A='b c' echo hi"
+
+    r.run("sleep 99", daemon=True)
+    assert calls[-1][-1].startswith("nohup bash -c ")
+
+    r.sync("/some/dir", "/raytpu")
+    argv = calls[-1]
+    assert argv[0] == "rsync" and argv[-1] == "ubuntu@10.0.0.5:/raytpu"
+    assert "/some/dir" in argv
+
+
+def test_docker_command_runner_wraps():
+    from ray_tpu.autoscaler import DockerCommandRunner, SubprocessCommandRunner
+
+    inner_calls = []
+
+    class Spy(SubprocessCommandRunner):
+        def run(self, cmd, **kw):
+            inner_calls.append(cmd)
+            return ""
+
+    r = DockerCommandRunner(Spy("/tmp/dockerspy"), "raytpu_c")
+    r.run("echo 1", env={"X": "1"})
+    assert inner_calls[-1].startswith("docker exec -e X=1 raytpu_c bash -c")
+
+
+def test_updater_bootstraps_node_end_to_end(ray_start_cluster, tmp_path):
+    """The verdict-#5 contract: the autoscaler provisions a BARE machine
+    (fresh directory, no code), the updater syncs the package and starts
+    node_runner FROM THE SYNCED COPY, the node registers, and a parked
+    task completes on it."""
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private import rpc as rpc_mod
+    from ray_tpu.autoscaler import (
+        BootstrappingNodeProvider,
+        SubprocessCommandRunner,
+    )
+
+    cluster = ray_start_cluster
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    runners = {}
+
+    def machine_factory(nid):
+        r = SubprocessCommandRunner(str(tmp_path / nid))
+        runners[nid] = r
+        return r
+
+    import os
+    os.environ["RAYTPU_PYTHON"] = sys.executable
+    provider = BootstrappingNodeProvider(
+        cluster.address,
+        machine_factory,
+        num_cpus=2,
+        auth_token=rpc_mod.session_token(),
+        run_dir=str(tmp_path / "run"),
+    )
+    a = StandardAutoscaler(
+        cluster.address, provider,
+        AutoscalerConfig(max_workers=1, update_interval_s=0.5,
+                         idle_timeout_s=120.0),
+    )
+    a.start()
+    try:
+        # saturate the head (2 CPUs) with pinned holders so the probe task
+        # must park -> demand -> the provider boots a machine via the updater
+        @ray_tpu.remote(num_cpus=1, resources={"head": 0.01})
+        class Holder:
+            def ping(self):
+                return 1
+
+        holders = [Holder.remote() for _ in range(2)]
+        ray_tpu.get([h.ping.remote() for h in holders], timeout=120)
+
+        @ray_tpu.remote(num_cpus=2)
+        def where():
+            return __import__("ray_tpu").__file__
+
+        path = ray_tpu.get(where.remote(), timeout=180)
+        nid = provider.non_terminated_nodes()[0]
+        synced_root = runners[nid].resolve("/raytpu")
+        assert path.startswith(synced_root), (
+            f"worker imported {path}, expected the synced copy under "
+            f"{synced_root}"
+        )
+    finally:
+        a.stop()
+        ray_tpu.shutdown()

@@ -43,7 +43,16 @@ def test_dashboard_apis(ray_start_regular):
         assert ray_tpu.get(p.ping.remote(), timeout=60) == "pong"
 
         page = _get(base + "/").decode()
-        assert "ray_tpu dashboard" in page and "/api/nodes" in page
+        assert "ray_tpu dashboard" in page and "app.js" in page
+
+        # the SPA's static modules serve with correct types
+        js = _get(base + "/static/app.js").decode()
+        assert "/api/nodes" in js and "hashchange" in js
+        css = _get(base + "/static/style.css").decode()
+        assert "table" in css
+        # path traversal is refused
+        with pytest.raises(Exception):
+            _get(base + "/static/../__init__.py")
 
         nodes = json.loads(_get(base + "/api/nodes"))
         assert len(nodes) == 1 and nodes[0]["alive"] is True
@@ -161,3 +170,33 @@ def test_metrics_history_and_task_drilldown(dashboard_cluster):
     assert detail["task"]["task_id"] == target["task_id"]
     states = [e["state"] for e in detail["events"]]
     assert "FINISHED" in states
+
+
+def test_dashboard_log_endpoints(ray_start_regular, tmp_path):
+    """/api/logs lists session log files and tails them, refusing paths
+    outside the logs root."""
+    import os
+
+    core = ray_start_regular.core
+    host, port = core.gcs.address
+    logdir = tmp_path / "logs" / "node1"
+    os.makedirs(logdir)
+    (logdir / "worker-abc.log").write_text("hello\nworld\n" * 50)
+    (tmp_path / "secret.txt").write_text("not a log")
+    dash = DashboardServer(f"{host}:{port}", port=0, session_dir=str(tmp_path))
+    base = f"http://127.0.0.1:{dash.address[1]}"
+    try:
+        listing = json.loads(_get(base + "/api/logs"))
+        files = [f["file"] for f in listing["files"]]
+        assert "node1/worker-abc.log" in files
+
+        tail = json.loads(
+            _get(base + "/api/logs?file=node1%2Fworker-abc.log&tail=64")
+        )
+        assert tail["text"].endswith("world\n")
+        assert tail["size"] == len("hello\nworld\n") * 50
+
+        bad = json.loads(_get(base + "/api/logs?file=..%2Fsecret.txt"))
+        assert "error" in bad
+    finally:
+        dash.stop()

@@ -1,0 +1,180 @@
+"""Data readers/writers round 5: tfrecords, images, jax, json + the
+tensor-column pipeline contract.
+
+(reference surfaces: python/ray/data/tests/test_tfrecords.py,
+test_image.py, test_json.py; the tensor-extension contract in
+python/ray/air/util/tensor_extensions/arrow.py — fixed-shape ndarray
+columns survive every op and land in jax without reshaping.)
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data import tfrecord as tfr
+
+
+# ---------------------------------------------------------------------------
+# codec-level (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_tfrecord_framing_roundtrip(tmp_path):
+    path = str(tmp_path / "a.tfrecords")
+    recs = [b"alpha", b"", b"x" * 10_000]
+    assert tfr.write_records(path, recs) == 3
+    assert list(tfr.read_records(path)) == recs
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "a.tfrecords")
+    tfr.write_records(path, [b"payload-bytes"])
+    raw = bytearray(open(path, "rb").read())
+    raw[14] ^= 0xFF  # flip a data byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="corrupt"):
+        list(tfr.read_records(path))
+
+
+def test_example_proto_roundtrip():
+    row = {
+        "name": b"abc",
+        "score": np.float32(1.5),
+        "label": 7,
+        "vec": np.asarray([1.0, 2.0, 3.0], dtype=np.float32),
+        "ids": np.asarray([10, -20, 1 << 40]),
+    }
+    parsed = tfr.parse_example(tfr.build_example(row))
+    assert parsed["name"] == ("bytes", [b"abc"])
+    assert parsed["score"][0] == "float"
+    assert parsed["score"][1] == pytest.approx([1.5])
+    assert parsed["label"] == ("int64", [7])
+    assert parsed["vec"][1] == pytest.approx([1.0, 2.0, 3.0])
+    assert parsed["ids"] == ("int64", [10, -20, 1 << 40])
+
+
+def test_example_interop_with_tensorflow(tmp_path):
+    """Our writer must be readable by TF's parser and vice versa."""
+    tf = pytest.importorskip("tensorflow")
+    path = str(tmp_path / "tf.tfrecords")
+    tfr.write_records(
+        path,
+        [tfr.build_example({"x": np.float32(2.5), "n": 4, "s": b"hi"})],
+    )
+    raw = next(iter(tf.data.TFRecordDataset(path)))
+    ex = tf.train.Example()
+    ex.ParseFromString(raw.numpy())
+    f = ex.features.feature
+    assert f["x"].float_list.value[0] == pytest.approx(2.5)
+    assert f["n"].int64_list.value[0] == 4
+    assert f["s"].bytes_list.value[0] == b"hi"
+
+    # reverse: TF writes, we read
+    ex2 = tf.train.Example()
+    ex2.features.feature["y"].float_list.value.extend([1.0, 2.0])
+    ex2.features.feature["k"].int64_list.value.append(9)
+    path2 = str(tmp_path / "tf2.tfrecords")
+    with tf.io.TFRecordWriter(path2) as w:
+        w.write(ex2.SerializeToString())
+    parsed = tfr.parse_example(next(tfr.read_records(path2)))
+    assert parsed["y"][1] == pytest.approx([1.0, 2.0])
+    assert parsed["k"] == ("int64", [9])
+
+
+# ---------------------------------------------------------------------------
+# dataset-level
+# ---------------------------------------------------------------------------
+
+
+def test_read_write_tfrecords(ray_start_regular, tmp_path):
+    ds = rd.from_numpy(
+        {
+            "feat": np.arange(40, dtype=np.float32).reshape(20, 2),
+            "label": np.arange(20),
+        },
+        parallelism=2,
+    )
+    files = ds.write_tfrecords(str(tmp_path / "out"))
+    assert len(files) == 2
+    back = rd.read_tfrecords(str(tmp_path / "out"))
+    batch = rd.concat_blocks(
+        [b for b in (ray_tpu.get(r) for r in back._block_refs)]
+    )
+    got = rd.block_to_batch(batch)
+    order = np.argsort(got["label"])
+    np.testing.assert_array_equal(got["label"][order], np.arange(20))
+    np.testing.assert_allclose(
+        got["feat"][order], np.arange(40, dtype=np.float32).reshape(20, 2)
+    )
+
+
+def test_write_read_json(ray_start_regular, tmp_path):
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(10)])
+    files = ds.write_json(str(tmp_path / "j"))
+    assert files and all(os.path.exists(f) for f in files)
+    # ndjson lines parse individually
+    rows = [json.loads(ln) for f in files for ln in open(f)]
+    assert sorted(r["a"] for r in rows) == list(range(10))
+    back = rd.read_json(files)
+    assert back.count() == 10
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        arr = rng.integers(0, 255, size=(14 + i, 10, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path), size=(8, 12), include_paths=True)
+    batches = list(ds.iter_batches(batch_size=None))
+    imgs = np.concatenate([b["image"] for b in batches])
+    assert imgs.shape == (6, 8, 12, 3)
+    assert imgs.dtype == np.uint8
+    paths = sorted(p for b in batches for p in b["path"].tolist())
+    assert len(paths) == 6 and paths[0].endswith("img0.png")
+
+
+def test_from_jax_to_jax_roundtrip(ray_start_regular):
+    import jax.numpy as jnp
+
+    x = jnp.arange(24.0).reshape(12, 2)
+    y = jnp.arange(12)
+    ds = rd.from_jax({"x": x, "y": y}, parallelism=3)
+    assert ds.count() == 12
+    out = ds.to_jax()
+    assert isinstance(out["x"], jnp.ndarray)
+    order = jnp.argsort(out["y"])
+    np.testing.assert_allclose(np.asarray(out["x"][order]), np.asarray(x))
+
+
+def test_tensor_column_pipeline_to_jax(ray_start_regular):
+    """The verdict-#3 contract: a tensor column survives
+    map_batches -> random_shuffle -> iter_batches and lands in jax with
+    its element shape intact."""
+    import jax.numpy as jnp
+
+    imgs = np.arange(2 * 5 * 4 * 3, dtype=np.float32).reshape(10, 4, 3)[:10]
+    base = np.stack([imgs[i % 10] + i for i in range(30)])  # (30, 4, 3)
+    ds = rd.from_numpy({"img": base, "idx": np.arange(30)}, parallelism=3)
+
+    ds2 = ds.map_batches(lambda b: {"img": b["img"] * 2.0, "idx": b["idx"]},
+                         batch_size=7)
+    ds3 = ds2.random_shuffle(seed=42)
+    got_imgs, got_idx = [], []
+    for batch in ds3.iter_batches(batch_size=8):
+        assert batch["img"].shape[1:] == (4, 3)
+        arr = jnp.asarray(batch["img"])  # tensor column -> device array
+        got_imgs.append(np.asarray(arr))
+        got_idx.append(batch["idx"])
+    got_imgs = np.concatenate(got_imgs)
+    got_idx = np.concatenate(got_idx)
+    assert got_imgs.shape == (30, 4, 3)
+    assert sorted(got_idx.tolist()) == list(range(30))
+    # order-independent content check: row i must equal base[i] * 2
+    order = np.argsort(got_idx)
+    np.testing.assert_allclose(got_imgs[order], base * 2.0)

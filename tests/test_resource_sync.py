@@ -1,0 +1,127 @@
+"""Bidirectional resource sync: GCS gossips aggregated node views down to
+raylets; spillback targets the idlest peer from the gossiped cache.
+
+(reference: src/ray/common/ray_syncer/ray_syncer.h:39 — heartbeats push
+views up, the syncer rebroadcasts the merged view; spillback in
+direct_task_transport.cc:501 consumes it. VERDICT r4 missing #9 / next #7.)
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _occupy(n, label):
+    """Park n long-running 1-CPU actors on the node tagged ``label``."""
+
+    @ray_tpu.remote(num_cpus=1, resources={label: 0.01})
+    class Holder:
+        def ping(self):
+            return 1
+
+    holders = [Holder.remote() for _ in range(n)]
+    ray_tpu.get([h.ping.remote() for h in holders], timeout=120)
+    return holders
+
+
+def test_gossiped_view_reaches_raylets(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        view = node.raylet._peer_view
+        if view["nodes"] and time.monotonic() - view["at"] < 2.0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("raylet never received a gossiped resource view")
+    ids = {n["node_id"].hex() for n in view["nodes"]}
+    assert node.raylet.node_id.hex() in ids
+    # the view carries live availability numbers for spill decisions
+    assert all("available" in n and "resources" in n for n in view["nodes"])
+
+
+def test_spillback_targets_idlest_peer_from_gossip(ray_start_cluster):
+    """Saturate the head; three peers have measurably different load; the
+    parked task must spill to the idlest one, decided from the gossiped
+    cache (no synchronous get_nodes on the spill path)."""
+    cluster = ray_start_cluster
+    busy = cluster.add_node(num_cpus=4, resources={"busy": 1.0})
+    mid = cluster.add_node(num_cpus=4, resources={"mid": 1.0})
+    idle = cluster.add_node(num_cpus=4, resources={"idle": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+    holders = _occupy(3, "busy") + _occupy(2, "mid")
+    # head: 2 CPUs, occupy both so the probe task must spill
+    head_holders = _occupy(2, "head")
+
+    # wait until the gossip reflects the occupancy everywhere
+    head_raylet = cluster.head_node.raylet
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        nodes = {
+            n["node_id"]: n for n in head_raylet._peer_view["nodes"]
+        }
+        if (
+            nodes.get(busy.raylet.node_id, {}).get("available", {}).get("CPU") == 1.0
+            and nodes.get(mid.raylet.node_id, {}).get("available", {}).get("CPU") == 2.0
+            and nodes.get(idle.raylet.node_id, {}).get("available", {}).get("CPU") == 4.0
+        ):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("gossip never converged to the expected occupancy")
+
+    # count raylet-side synchronous view fetches during the spill
+    calls = []
+    orig_call = head_raylet.gcs.call
+
+    def spy(method, *a, **kw):
+        calls.append(method)
+        return orig_call(method, *a, **kw)
+
+    head_raylet.gcs.call = spy
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def where():
+            import os
+
+            return os.environ.get("RAYTPU_NODE_ID")
+
+        target = ray_tpu.get(where.remote(), timeout=60)
+    finally:
+        head_raylet.gcs.call = orig_call
+
+    assert target == idle.raylet.node_id.hex(), (
+        f"spilled to {target}, expected the idlest node "
+        f"{idle.raylet.node_id.hex()}"
+    )
+    assert "get_nodes" not in calls, (
+        "spill decision fell back to a synchronous get_nodes RPC instead "
+        "of the gossiped view"
+    )
+    del holders, head_holders
+
+
+def test_spillback_falls_back_when_gossip_stale(ray_start_cluster):
+    """With an empty/stale cache the spill path still works via the RPC
+    fallback (older GCS / first seconds of a node's life)."""
+    cluster = ray_start_cluster
+    peer = cluster.add_node(num_cpus=4, resources={"peer": 1.0})
+    ray_tpu.init(address=cluster.address, log_level="WARNING")
+    holders = _occupy(2, "head")
+
+    head_raylet = cluster.head_node.raylet
+    head_raylet._peer_view = {"at": 0.0, "nodes": []}  # force staleness
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import os
+
+        return os.environ.get("RAYTPU_NODE_ID")
+
+    assert ray_tpu.get(where.remote(), timeout=60) == peer.raylet.node_id.hex()
+    del holders

@@ -1,0 +1,143 @@
+"""External-env RL serving (PolicyClient/Server) + Ape-X distributed replay.
+
+(reference surfaces: rllib/env/tests/test_policy_client_server_setup.sh —
+an external CartPole loop learns over the wire; rllib/algorithms/apex_dqn
+— sharded prioritized replay with worker-side initial priorities.)
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import ApexDQNConfig, DQNConfig, PolicyClient, PolicyServer
+from ray_tpu.rl.env import make_env
+
+
+def test_policy_client_server_external_cartpole():
+    """The verdict-#4 contract: an external CartPole loop (the env lives in
+    THIS process, policy + learning live behind a socket) improves over
+    the wire."""
+    probe = make_env("CartPole-v1")
+    server = PolicyServer(
+        probe.observation_size,
+        probe.num_actions,
+        lr=1e-3,
+        learning_starts=300,
+        train_every=8,
+        epsilon_decay_steps=2500,
+        seed=0,
+    )
+    client = PolicyClient(server.address)
+    env = make_env("CartPole-v1")
+    try:
+        returns = []
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            episode_id = client.start_episode()
+            obs, _ = env.reset(seed=len(returns))
+            done = False
+            while not done:
+                action = client.get_action(episode_id, obs)
+                obs, reward, term, trunc, _ = env.step(action)
+                client.log_returns(episode_id, reward)
+                done = term or trunc
+            out = client.end_episode(episode_id, obs)
+            returns.append(out["episode_return"])
+            if len(returns) >= 20 and np.mean(returns[-10:]) >= 120.0:
+                break
+        recent = float(np.mean(returns[-10:]))
+        assert recent >= 120.0, (
+            f"external client failed to learn: last-10 mean {recent} "
+            f"over {len(returns)} episodes"
+        )
+        stats = client.get_stats()
+        assert stats["updates"] > 0 and stats["transitions"] > 300
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_policy_server_unknown_episode_errors():
+    server = PolicyServer(4, 2, seed=1)
+    client = PolicyClient(server.address)
+    try:
+        with pytest.raises(KeyError):
+            client.get_action("nonexistent", np.zeros(4, np.float32))
+        # concurrent episodes are independent
+        e1, e2 = client.start_episode(), client.start_episode()
+        a1 = client.get_action(e1, np.zeros(4, np.float32))
+        a2 = client.get_action(e2, np.ones(4, np.float32))
+        assert a1 in (0, 1) and a2 in (0, 1)
+        client.log_returns(e1, 1.0)
+        client.end_episode(e1, np.zeros(4, np.float32))
+        client.log_returns(e2, 2.0)
+        out = client.end_episode(e2, np.ones(4, np.float32))
+        assert out["episode_return"] == pytest.approx(2.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_apex_mechanics_and_learning(ray_start_regular):
+    """Shards fill from worker pushes (not via the driver), priorities are
+    written back, and the learner improves on CartPole."""
+    algo = ApexDQNConfig(
+        num_rollout_workers=2,
+        num_envs_per_worker=4,
+        num_replay_shards=2,
+        rollout_fragment_length=32,
+        learning_starts=500,
+        updates_per_iteration=48,
+        train_batch_size=64,
+        target_update_interval=200,
+        epsilon_decay_steps=4000,
+        lr=1e-3,
+        seed=0,
+    ).build()
+    best = 0.0
+    try:
+        for _ in range(50):
+            result = algo.train()
+            assert len(result["replay_shard_sizes"]) == 2
+            if np.isfinite(result["episode_return_mean"]):
+                best = max(best, result["episode_return_mean"])
+            if best >= 100.0:
+                break
+        # both shards participated
+        final = algo.train()
+        assert all(s > 0 for s in final["replay_shard_sizes"]), final
+        assert final["num_updates"] > 0
+        assert best >= 100.0, f"Apex-DQN failed to learn: best {best}"
+    finally:
+        algo.stop()
+
+
+@pytest.mark.skipif(
+    __import__("os").environ.get("RAYTPU_RUN_SLOW") != "1",
+    reason="wall-clock comparison is contention-sensitive; slow tier only",
+)
+def test_apex_overlaps_sampling_with_learning(ray_start_regular):
+    """Ape-X's decoupled pipeline must collect more env steps than the
+    synchronous DQN loop in the same wall-clock budget (the reason the
+    architecture exists)."""
+
+    def steps_in(builder, budget_s):
+        algo = builder.build()
+        try:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < budget_s:
+                result = algo.train()
+            return result["env_steps_total"] if "env_steps_total" in result else result.get("env_steps", 0)
+        finally:
+            algo.stop()
+
+    common = dict(
+        num_rollout_workers=2, num_envs_per_worker=4,
+        rollout_fragment_length=32, learning_starts=400,
+        updates_per_iteration=16, train_batch_size=64, seed=0,
+    )
+    apex_steps = steps_in(ApexDQNConfig(num_replay_shards=2, **common), 25)
+    dqn_steps = steps_in(DQNConfig(**common), 25)
+    assert apex_steps >= dqn_steps, (apex_steps, dqn_steps)

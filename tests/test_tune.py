@@ -384,3 +384,143 @@ def test_progress_reporter_table(ray_start_regular, tmp_path, caplog):
     assert not grid.errors
     text = "\n".join(r.message for r in caplog.records)
     assert "tune progress" in text and "TERMINATED" in text and "score" in text
+
+
+def test_concurrency_limiter_bounds_tpe():
+    """The limiter must cap in-flight TPE suggestions (reference:
+    search/concurrency_limiter.py); releases open new slots."""
+    space = {"x": tune.uniform(-1.0, 1.0)}
+    limited = tune.ConcurrencyLimiter(
+        tune.TPESearcher(space, metric="score", mode="max", seed=1,
+                         num_samples=100),
+        max_concurrent=3,
+    )
+    live = []
+    for i in range(3):
+        cfg = limited.suggest(f"t{i}")
+        assert cfg is not None
+        live.append(f"t{i}")
+    # saturated: 4th suggestion is refused
+    assert limited.suggest("t3") is None
+    limited.on_trial_complete("t0", {"score": 0.5})
+    # slot freed: next suggestion succeeds
+    assert limited.suggest("t4") is not None
+    assert limited.suggest("t5") is None
+
+
+def test_repeater_aggregates_means():
+    """Repeater deals each underlying config `repeat` times and reports
+    the MEAN back exactly once per group (reference: search/repeater.py)."""
+
+    class Recording(tune.Searcher):
+        def __init__(self):
+            super().__init__(metric="score", mode="max")
+            self.n = 0
+            self.completed = []
+
+        def suggest(self, trial_id):
+            self.n += 1
+            return {"cfg": self.n}
+
+        def on_trial_complete(self, trial_id, result=None):
+            self.completed.append((trial_id, result))
+
+    inner = Recording()
+    rep = tune.Repeater(inner, repeat=3)
+    cfgs = [rep.suggest(f"t{i}") for i in range(6)]
+    # 6 trials -> only 2 underlying configs, each dealt 3x
+    assert [c["cfg"] for c in cfgs] == [1, 1, 1, 2, 2, 2]
+    for i, score in zip(range(3), (1.0, 2.0, 3.0)):
+        rep.on_trial_complete(f"t{i}", {"score": score})
+    for i, score in zip(range(3, 6), (10.0, 20.0, 30.0)):
+        rep.on_trial_complete(f"t{i}", {"score": score})
+    assert inner.completed == [
+        ("t0", {"score": 2.0}),
+        ("t3", {"score": 20.0}),
+    ]
+
+
+def test_pb2_explore_follows_reward_signal():
+    """With observations where high `h` produced the big reward deltas,
+    PB2's GP-UCB explore must propose a higher `h` than random-PBT's
+    multiply-by-0.8/1.2 envelope would from a mid donor."""
+    pb2 = tune.PB2(
+        metric="score", mode="max", perturbation_interval=1,
+        hyperparam_mutations={"h": tune.uniform(0.0, 1.0)},
+        resample_probability=0.0, seed=7,
+    )
+    # seed the observation log: delta grows linearly with h
+    for v in [0.1, 0.2, 0.3, 0.5, 0.6, 0.8, 0.9]:
+        pb2._obs_x.append([1.0, *pb2._vec({"h": v})])
+        pb2._obs_y.append(v)  # reward delta == h
+    donor = {"h": 0.5}
+    proposals = [pb2._explore(donor)["h"] for _ in range(8)]
+    assert sum(p > 0.6 for p in proposals) >= 6, proposals
+    assert all(0.0 <= p <= 1.0 for p in proposals)
+
+
+def test_pb2_beats_static_search_on_drifting_surface(ray_start_regular, tmp_path):
+    """A non-stationary objective (optimal h drifts during training):
+    population-based adaptation (PB2) must beat budget-matched static
+    configs (TPE), which cannot move h mid-trial."""
+    STEPS = 32
+
+    def drifting(config):
+        import time as _time
+
+        import numpy as np
+
+        ckpt = tune.get_checkpoint()
+        state = ckpt.to_dict() if ckpt else {"step": 0, "acc": 0.0}
+        rng = np.random.default_rng(state["step"] * 7 + 1)
+        for step in range(state["step"], STEPS):
+            # drift to 0.95 by step 15, then hold: static low-h trials
+            # bleed ~0.4/step for the whole plateau
+            target = min(0.95, 0.05 + 0.06 * step)
+            gain = 1.0 - (config["h"] - target) ** 2
+            state["acc"] += gain + 0.02 * rng.normal()
+            state["step"] = step + 1
+            tune.report(
+                {"score": state["acc"], "training_iteration": state["step"]},
+                checkpoint=tune.Checkpoint.from_dict(dict(state)),
+            )
+            _time.sleep(0.08)  # trials must overlap for quantile ranking
+
+    pb2 = tune.PB2(
+        perturbation_interval=4,
+        hyperparam_mutations={"h": tune.uniform(0.0, 1.0)},
+        quantile_fraction=0.5,
+        resample_probability=0.1,
+        kappa=2.0,
+        seed=3,
+    )
+    # initial population sampled LOW (0..0.3) while the optimum drifts to
+    # ~0.95: only mid-training adaptation can follow it (PB2's mutation
+    # range spans the full axis). TPE's trials are static for their whole
+    # life, so the same low initial space caps what it can reach.
+    pop = Tuner(
+        drifting,
+        param_space={"h": tune.uniform(0.0, 0.3)},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pb2,
+                               num_samples=4, seed=5),
+        run_config=ray_tpu.train.RunConfig(name="pb2d", storage_path=str(tmp_path)),
+    ).fit()
+    assert not pop.errors
+    assert pb2.num_perturbations >= 1, "PB2 never exploited/explored"
+    pb2_best = pop.get_best_result().metrics["score"]
+
+    static = Tuner(
+        drifting,
+        param_space={"h": tune.uniform(0.0, 0.3)},
+        tune_config=TuneConfig(
+            metric="score", mode="max", num_samples=4, seed=5,
+            search_alg=tune.TPESearcher(
+                {"h": tune.uniform(0.0, 0.3)}, metric="score", mode="max",
+                seed=5, num_samples=4,
+            ),
+        ),
+        run_config=ray_tpu.train.RunConfig(name="tped", storage_path=str(tmp_path)),
+    ).fit()
+    assert not static.errors
+    tpe_best = static.get_best_result().metrics["score"]
+    assert pb2_best > tpe_best, (pb2_best, tpe_best)

@@ -195,9 +195,11 @@ def test_runtime_env_env_vars(ray_start_regular):
     ).remote()
     assert ray_tpu.get(a.val.remote(), timeout=60) == "xyz"
 
-    # pip is supported now (runtime_env_pip); conda/containers are not
+    # conda/container are plugin-owned fields now (runtime_env_plugins);
+    # validation accepts them, and truly unknown fields still fail loudly
+    read_env.options(runtime_env={"conda": "some-env"})  # accepted
     with pytest.raises(ValueError):
-        read_env.options(runtime_env={"conda": "env.yml"})
+        read_env.options(runtime_env={"definitely_unknown_field": 1})
     with pytest.raises(ValueError):
         read_env.options(runtime_env={"env_vars": {"A": 1}})
 
