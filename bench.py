@@ -8,6 +8,19 @@ Default flagship is the 1B-param config (head_dim=128 → full MXU tiles);
 ``--model 125m`` benches the small config. The train step runs the Pallas
 flash-attention forward+backward kernels (ray_tpu/ops/attention.py) and the
 blockwise cross-entropy (ray_tpu/models/gpt.py:blockwise_next_token_loss).
+
+MFU accounting note (r5 sweep): train_step_flops counts attention as the
+full 12·L·H·s²·d term (the PaLM-convention), but the Pallas kernel SKIPS
+fully-masked causal tiles (attention.py:225), so full-counting overstates
+utilization as seq grows — by ~4% at seq 2048 and ~35% at seq 16k (where
+this formula would read 0.67 "MFU"). The flagship therefore stays at
+seq 2048 / batch 12, where the conventions nearly agree AND the loss
+trajectory is bit-comparable with earlier rounds (loss 0.8501 at iter 21).
+r5 sweep results at this shape: batch 24 → 0.628; attn blocks 512 → 0.588
+(kernel overhead beats the extra causal skip); remat=dots OOMs (saved dot
+outputs exceed HBM at 1B/bf16); ce_chunk 1024 neutral. Long-context
+throughput (the honest win of the flash kernel) is benched by
+``--seq 16384 --batch 2`` explicitly, not by inflating the headline.
 """
 
 from __future__ import annotations
@@ -37,6 +50,8 @@ def main():
         "--scan-layers", default=None, choices=["on", "off"],
         help="force lax.scan over layers on/off (1b default: off/unrolled)",
     )
+    ap.add_argument("--ce-chunk", type=int, default=None)
+    ap.add_argument("--attn-block", type=int, default=None)
     args = ap.parse_args()
 
     from ray_tpu.models.gpt import gpt_1b, gpt_125m, gpt_nano, train_step_flops
@@ -56,6 +71,11 @@ def main():
         extra["remat_policy"] = args.remat_policy
     if args.scan_layers is not None:
         extra["scan_layers"] = args.scan_layers == "on"
+    if args.ce_chunk:
+        extra["ce_chunk"] = args.ce_chunk
+    if args.attn_block:
+        extra["attn_block_q"] = args.attn_block
+        extra["attn_block_k"] = args.attn_block
     if args.model == "1b":
         # bf16 params+moments so the full Adam state fits one 16G chip; a
         # real multi-chip run keeps f32 master state sharded over fsdp.

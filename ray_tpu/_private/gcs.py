@@ -13,6 +13,7 @@ tasks (the reference's ScheduleByRaylet default, gcs_actor_scheduler.h:355).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -128,9 +129,12 @@ class GcsServer:
         self._lock = threading.Condition(threading.RLock())
         # bounded executors for actor/pg scheduling (a thread per schedule
         # would mean 10k threads at the reference's 10k-actor envelope);
-        # separate pools because actors may wait on pg commits
+        # separate pools because actors may wait on pg commits. Sized to
+        # the host: 16 threads on a 1-core box is GIL contention, not
+        # parallelism (SCALE_r04 thread census finding)
+        sched_threads = min(16, max(4, (os.cpu_count() or 1) * 4))
         self._actor_sched_pool = ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="gcs-actor-sched"
+            max_workers=sched_threads, thread_name_prefix="gcs-actor-sched"
         )
         self._pg_sched_pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="gcs-pg-sched"
@@ -143,6 +147,11 @@ class GcsServer:
         self._pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self._subscribers: Dict[str, List[ServerConn]] = {}
         self._raylet_clients: Dict[NodeID, RpcClient] = {}
+        # pooled GCS->worker connections for create_actor (LRU-bounded;
+        # entries invalidate on call failure)
+        from collections import OrderedDict as _OD
+
+        self._worker_clients: "_OD[Tuple[str, int], RpcClient]" = _OD()
         self._task_events: List[Dict[str, Any]] = []
         self._stopped = threading.Event()
         if self._storage is not None:
@@ -538,6 +547,31 @@ class GcsServer:
             k = max(1, int(len(pool) * GlobalConfig.scheduler_top_k_fraction))
             return _random.choice(pool[:k])
 
+    def _worker_client(self, addr: Tuple[str, int]) -> RpcClient:
+        with self._lock:
+            client = self._worker_clients.get(addr)
+            if client is not None and not client.closed:
+                self._worker_clients.move_to_end(addr)
+                return client
+        client = RpcClient(addr, connect_timeout=5.0)
+        with self._lock:
+            racer = self._worker_clients.get(addr)
+            if racer is not None and not racer.closed:
+                client.close()
+                return racer
+            self._worker_clients[addr] = client
+            # LRU bound: evictions (and failure drops below) only FORGET
+            # the client — close() would fail concurrent in-flight
+            # create_actor calls sharing it; the transport reclaims the fd
+            # when the worker side goes away (closed event)
+            while len(self._worker_clients) > 512:
+                self._worker_clients.popitem(last=False)
+        return client
+
+    def _drop_worker_client(self, addr: Tuple[str, int]):
+        with self._lock:
+            self._worker_clients.pop(addr, None)
+
     def _raylet_client(self, node: NodeInfo) -> RpcClient:
         with self._lock:
             client = self._raylet_clients.get(node.node_id)
@@ -565,6 +599,7 @@ class GcsServer:
                 continue
             lease = None
             client = None
+            worker_addr = None
             try:
                 client = self._raylet_client(node)
                 lease = client.call(
@@ -584,19 +619,21 @@ class GcsServer:
                     time.sleep(0.05)
                     continue
                 worker_addr = tuple(lease["address"])
-                wclient = RpcClient(worker_addr)
-                try:
-                    wclient.call(
-                        "create_actor",
-                        {
-                            "actor_id": info.actor_id,
-                            "spec": spec,
-                            "num_restarts": info.num_restarts,
-                        },
-                        timeout=GlobalConfig.gcs_rpc_timeout_s * 10,
-                    )
-                finally:
-                    wclient.close()
+                # pooled connection: a fresh TCP connect + AUTH per actor
+                # was ~2 round-trips of pure overhead in the many_actors
+                # envelope (one create_actor call per worker lifetime is
+                # the common case, but restarts and multi-actor workers
+                # reuse it)
+                wclient = self._worker_client(worker_addr)
+                wclient.call(
+                    "create_actor",
+                    {
+                        "actor_id": info.actor_id,
+                        "spec": spec,
+                        "num_restarts": info.num_restarts,
+                    },
+                    timeout=GlobalConfig.gcs_rpc_timeout_s * 10,
+                )
                 with self._lock:
                     info.state = ALIVE
                     info.address = worker_addr
@@ -606,6 +643,10 @@ class GcsServer:
                 self._publish("actors", info.public_view())
                 return
             except Exception as e:  # noqa: BLE001
+                if worker_addr is not None:
+                    # the pooled connection may be mid-teardown: drop it so
+                    # the retry (or the next actor) dials fresh
+                    self._drop_worker_client(worker_addr)
                 # return the lease so a failed creation doesn't leak resources
                 if lease is not None and client is not None:
                     try:
@@ -1067,6 +1108,8 @@ class GcsServer:
         self._pg_sched_pool.shutdown(wait=False)
         with self._lock:
             for c in self._raylet_clients.values():
+                c.close()
+            for c in self._worker_clients.values():
                 c.close()
         if self._storage is not None:
             self._storage.close()

@@ -69,6 +69,8 @@ def plugin_fields() -> List[str]:
 
 
 _setup_cache: Dict[Tuple[str, str], Any] = {}
+_setup_locks: Dict[Tuple[str, str], Any] = {}
+_setup_guard = __import__("threading").Lock()
 
 
 def _value_key(name: str, value: Any) -> Tuple[str, str]:
@@ -93,7 +95,13 @@ def apply_plugins(
         value = runtime_env[plugin.name]
         key = _value_key(plugin.name, value)
         if key not in _setup_cache:
-            _setup_cache[key] = plugin.setup(value, session_dir)
+            # one setup per (plugin, value) even under concurrent spawns:
+            # a second `conda env create` on the same prefix would fail
+            with _setup_guard:
+                lock = _setup_locks.setdefault(key, __import__("threading").Lock())
+            with lock:
+                if key not in _setup_cache:
+                    _setup_cache[key] = plugin.setup(value, session_dir)
         try:
             env, argv = plugin.modify_worker(
                 _setup_cache[key], env, argv, runtime_env=runtime_env

@@ -25,6 +25,8 @@ from ray_tpu.data.read_api import (
     read_parquet,
     read_tfrecords,
     read_images,
+    read_sql,
+    read_webdataset,
     from_jax,
 )
 
@@ -53,5 +55,7 @@ __all__ = [
     "read_parquet",
     "read_tfrecords",
     "read_images",
+    "read_sql",
+    "read_webdataset",
     "from_jax",
 ]

@@ -102,7 +102,17 @@ def block_num_rows(block: Block) -> int:
 
 
 def block_rows(block: Block) -> List[Dict[str, Any]]:
-    return block.to_pylist()
+    rows = block.to_pylist()
+    # tensor columns come out of to_pylist as flat lists: restore each
+    # row's element shape from the field metadata
+    for idx, name in enumerate(block.column_names):
+        meta = block.schema.field(idx).metadata or {}
+        if b"tensor_shape" in meta:
+            shape = tuple(json.loads(meta[b"tensor_shape"].decode()))
+            for row in rows:
+                if row.get(name) is not None:
+                    row[name] = np.asarray(row[name]).reshape(shape)
+    return rows
 
 
 def block_slice(block: Block, start: int, end: int) -> Block:

@@ -328,3 +328,157 @@ def from_jax(arrays, *, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
         arrays = {"data": arrays}
     host = {k: np.asarray(v) for k, v in arrays.items()}
     return from_numpy(host, parallelism=parallelism)
+
+
+def read_sql(
+    sql: str,
+    connection_factory,
+    *,
+    order_by: Optional[str] = None,
+    parallelism: int = DEFAULT_PARALLELISM,
+) -> Dataset:
+    """Rows of a SQL query -> Dataset (reference:
+    python/ray/data/read_api.py read_sql over a DBAPI connection factory).
+
+    ``connection_factory`` is a zero-arg callable returning a DBAPI
+    connection — it must be picklable (module-level function or
+    functools.partial over picklable args) because it runs INSIDE read
+    tasks. Sharding: LIMIT/OFFSET slices are only deterministic when the
+    engine sees a total order, so parallel reads REQUIRE ``order_by`` (a
+    column/expression of the query); without it the whole result reads as
+    one task — correct on every engine, just not parallel (the reference
+    makes the same single-task default for exactly this reason)."""
+    if order_by is None:
+        pairs = [
+            _read_sql_task.options(num_returns=2).remote(
+                sql, connection_factory, None, None, None
+            )
+        ]
+        return Dataset([p[0] for p in pairs], [p[1] for p in pairs],
+                       [("read_sql", 0.0)])
+    probe = connection_factory()
+    try:
+        cur = probe.cursor()
+        cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS __raytpu_q")
+        total = cur.fetchone()[0]
+    finally:
+        probe.close()
+    parallelism = max(1, min(parallelism, total or 1))
+    pairs = []
+    for i in builtins.range(parallelism):
+        lo = total * i // parallelism
+        hi = total * (i + 1) // parallelism
+        if lo < hi:
+            pairs.append(
+                _read_sql_task.options(num_returns=2).remote(
+                    sql, connection_factory, order_by, lo, hi - lo
+                )
+            )
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs], [("read_sql", 0.0)])
+
+
+@ray_tpu.remote
+def _read_sql_task(sql, connection_factory, order_by, offset, limit):
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        if order_by is None:
+            cur.execute(sql)
+        else:
+            cur.execute(
+                f"SELECT * FROM ({sql}) AS __raytpu_q "
+                f"ORDER BY {order_by} LIMIT {limit} OFFSET {offset}"
+            )
+        names = [d[0] for d in cur.description]
+        rows = cur.fetchall()
+    finally:
+        conn.close()
+    blk = B.block_from_rows([dict(zip(names, r)) for r in rows])
+    return blk, _meta_of(blk)
+
+
+@ray_tpu.remote
+def _read_webdataset_task(path, decode):
+    import tarfile
+
+    samples: Dict[str, Dict[str, Any]] = {}
+    raw: Dict[str, Dict[str, bytes]] = {}
+    order: List[str] = []
+    with tarfile.open(path) as tar:
+        for member in tar:
+            if not member.isfile():
+                continue
+            name = member.name
+            key, _, ext = name.partition(".")
+            data = tar.extractfile(member).read()
+            if key not in samples:
+                samples[key] = {"__key__": key}
+                raw[key] = {}
+                order.append(key)
+            raw[key][ext] = data
+            samples[key][ext] = _decode_wds_field(ext, data) if decode else data
+    # columnar assembly: uniform-shape ndarray fields become tensor
+    # columns; a RAGGED decoded field falls back to its raw bytes (arrow
+    # blocks hold rectangles, not arbitrary per-row shapes)
+    fields: List[str] = []
+    for key in order:
+        for f in samples[key]:
+            if f not in fields:
+                fields.append(f)
+    import pyarrow as pa
+
+    arrays = []
+    schema_fields = []
+    for f in fields:
+        values = [samples[k].get(f) for k in order]
+        if any(isinstance(v, np.ndarray) and v.ndim >= 1 for v in values):
+            shapes = {v.shape for v in values if isinstance(v, np.ndarray)}
+            if len(shapes) == 1 and all(isinstance(v, np.ndarray) for v in values):
+                stacked = np.stack(values)
+                tensor_tbl = B.block_from_batch({f: stacked})
+                arrays.append(tensor_tbl.column(0))
+                schema_fields.append(tensor_tbl.schema.field(0))
+                continue
+            values = [raw[k].get(f) for k in order]  # ragged: raw bytes
+        col = pa.array(values)  # handles dicts (struct), strs, bytes, ints
+        arrays.append(col)
+        schema_fields.append(pa.field(f, col.type))
+    blk = pa.Table.from_arrays(arrays, schema=pa.schema(schema_fields))
+    return blk, _meta_of(blk)
+
+
+def _decode_wds_field(ext: str, data: bytes):
+    if ext in ("txt", "text"):
+        return data.decode("utf-8")
+    if ext in ("cls", "index"):
+        return int(data)
+    if ext == "json":
+        import json as _json
+
+        return _json.loads(data)
+    if ext in ("jpg", "jpeg", "png", "ppm"):
+        import io as _io
+
+        from PIL import Image
+
+        return np.asarray(Image.open(_io.BytesIO(data)))
+    if ext in ("npy",):
+        import io as _io
+
+        return np.load(_io.BytesIO(data), allow_pickle=False)
+    return data  # unknown extension: raw bytes
+
+
+def read_webdataset(paths, *, decode: bool = True,
+                    parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    """WebDataset tar shards -> one row per sample key (reference:
+    python/ray/data/read_api.py read_webdataset). Files sharing a basename
+    before the first dot group into one sample; known extensions decode
+    (txt/cls/json/images/npy), the rest stay bytes."""
+    files = _expand_paths(paths)
+    pairs = [
+        _read_webdataset_task.options(num_returns=2).remote(p, decode)
+        for p in files
+    ]
+    return Dataset([p[0] for p in pairs], [p[1] for p in pairs],
+                   [("read_webdataset", 0.0)])

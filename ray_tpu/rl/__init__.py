@@ -32,7 +32,16 @@ from ray_tpu.rl.connectors import (
     UnsquashActions,
 )
 from ray_tpu.rl.td3 import DDPG, DDPGConfig, TD3, TD3Config, TD3RolloutWorker
-from ray_tpu.rl.dqn import DQN, DQNConfig, DQNLearner, DQNRolloutWorker, QNetwork
+from ray_tpu.rl.dqn import (
+    DQN,
+    DQNConfig,
+    DQNLearner,
+    DQNRolloutWorker,
+    NoisyDense,
+    QNetwork,
+    RainbowDQNConfig,
+)
+from ray_tpu.rl.pg import PG, PGConfig, PGLearner
 from ray_tpu.rl.env import CartPole, Pendulum, VectorEnv, make_env
 from ray_tpu.rl.apex import ApexDQN, ApexDQNConfig, ReplayShardActor
 from ray_tpu.rl.impala import Impala, ImpalaConfig, ImpalaLearner, vtrace
@@ -100,6 +109,11 @@ __all__ = [
     "CartPole",
     "DQN",
     "DQNConfig",
+    "NoisyDense",
+    "PG",
+    "PGConfig",
+    "PGLearner",
+    "RainbowDQNConfig",
     "DQNLearner",
     "DQNRolloutWorker",
     "DiscretePolicyModule",

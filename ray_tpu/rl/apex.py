@@ -199,6 +199,11 @@ class ApexDQN:
         losses: List[float] = []
         self._kick_workers()
         while len(losses) < cfg.updates_per_iteration:
+            # hard per-iteration bailout: shards that can NEVER serve a
+            # batch (capacity < train_batch_size, dead worker fleet) must
+            # end the iteration, not spin train() forever
+            if time.perf_counter() - t0 > 60:
+                break
             self._reap_workers(timeout=0.0)
             self._kick_workers()
             shard = self.shards[self._shard_rr % len(self.shards)]
@@ -210,10 +215,6 @@ class ApexDQN:
             if mb is None:
                 # shard not warm yet: give sampling the core for a moment
                 self._reap_workers(timeout=0.25)
-                if self._env_steps >= cfg.learning_starts:
-                    continue
-                if time.perf_counter() - t0 > 30:
-                    break
                 continue
             loss, td = self.learner.update(mb)
             shard.update_priorities.remote(mb["batch_indexes"], td)

@@ -25,36 +25,106 @@ from ray_tpu.rl.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rl.sample_batch import SampleBatch
 
 
+class NoisyDense(nn.Module):
+    """Factorized-Gaussian noisy linear layer (Fortunato et al.; reference:
+    rllib's noisy nets in the rainbow-configured DQN). Exploration comes
+    from learned weight noise instead of epsilon-greedy: pass a fresh
+    ``rng`` per step to resample, or ``rng=None`` for the deterministic
+    (mean-weight) policy at evaluation."""
+
+    features: int
+    sigma0: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
+        in_dim = x.shape[-1]
+        bound = 1.0 / jnp.sqrt(in_dim)
+        w_mu = self.param(
+            "w_mu", nn.initializers.uniform(scale=2 * bound), (in_dim, self.features)
+        )
+        b_mu = self.param(
+            "b_mu", nn.initializers.uniform(scale=2 * bound), (self.features,)
+        )
+        w_sigma = self.param(
+            "w_sigma",
+            nn.initializers.constant(self.sigma0 * bound),
+            (in_dim, self.features),
+        )
+        b_sigma = self.param(
+            "b_sigma", nn.initializers.constant(self.sigma0 * bound), (self.features,)
+        )
+        if rng is None:
+            return x @ w_mu + b_mu
+        def f(e):
+            return jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+        rin, rout = jax.random.split(rng)
+        eps_in = f(jax.random.normal(rin, (in_dim,)))
+        eps_out = f(jax.random.normal(rout, (self.features,)))
+        w = w_mu + w_sigma * jnp.outer(eps_in, eps_out)
+        b = b_mu + b_sigma * eps_out
+        return x @ w + b
+
+
 class QNetwork(nn.Module):
-    """MLP mapping observations to one Q-value per action."""
+    """MLP mapping observations to one Q-value per action.
+
+    Rainbow knobs (reference: rllib/algorithms/dqn — the reference's DQN
+    becomes Rainbow through config): ``dueling`` splits value/advantage
+    streams (Wang et al.); ``noisy`` replaces the output layers with
+    NoisyDense (rng-driven exploration)."""
 
     num_actions: int
     hidden: Sequence[int] = (64, 64)
+    dueling: bool = False
+    noisy: bool = False
 
     @nn.compact
-    def __call__(self, obs: jax.Array) -> jax.Array:
+    def __call__(self, obs: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
         x = obs
         for i, h in enumerate(self.hidden):
             x = nn.relu(nn.Dense(h, name=f"torso_{i}")(x))
-        return nn.Dense(self.num_actions, name="q_head")(x)
+
+        def head(features, name):
+            if self.noisy:
+                layer_rng = None
+                if rng is not None:
+                    layer_rng = jax.random.fold_in(rng, hash(name) % (1 << 31))
+                return NoisyDense(features, name=name)(x, layer_rng)
+            return nn.Dense(features, name=name)(x)
+
+        if self.dueling:
+            value = head(1, "v_head")
+            adv = head(self.num_actions, "a_head")
+            return value + adv - adv.mean(axis=-1, keepdims=True)
+        return head(self.num_actions, "q_head")
 
 
 @ray_tpu.remote
 class DQNRolloutWorker:
-    """Epsilon-greedy transition collection on a vectorized env."""
+    """Epsilon-greedy (or noisy-net) transition collection on a vectorized
+    env, with optional n-step return accumulation (rainbow knobs)."""
 
     def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
-                 hidden: Tuple[int, ...] = (64, 64)):
+                 hidden: Tuple[int, ...] = (64, 64), dueling: bool = False,
+                 noisy: bool = False, n_step: int = 1, gamma: float = 0.99):
         self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
         probe = make_env(env_name)
-        self.net = QNetwork(probe.num_actions, tuple(hidden))
+        self.net = QNetwork(
+            probe.num_actions, tuple(hidden), dueling=dueling, noisy=noisy
+        )
         self.num_actions = probe.num_actions
+        self.noisy = noisy
+        self.n_step = max(1, int(n_step))
+        self.gamma = gamma
         self.params = self.net.init(
             jax.random.PRNGKey(seed),
             jnp.zeros((1, probe.observation_size), jnp.float32),
         )["params"]
-        self._fwd = jax.jit(lambda p, o: self.net.apply({"params": p}, o))
+        self._fwd = jax.jit(
+            lambda p, o, r=None: self.net.apply({"params": p}, o, r)
+        )
         self._rng = np.random.default_rng(seed + 1)
+        self._jrng = jax.random.PRNGKey(seed + 2)
         self._episodes = EpisodeReturnTracker(num_envs)
 
     def set_weights(self, params) -> bool:
@@ -62,21 +132,29 @@ class DQNRolloutWorker:
         return True
 
     def sample(self, num_steps: int, epsilon: float) -> SampleBatch:
-        """Collect ``num_steps`` transitions per env: (s, a, r, s', done).
+        """Collect ``num_steps`` transitions per env: (s, a, R_n, s_{t+n},
+        done), where R_n is the n-step discounted return (n=1 reduces to
+        the classic tuple).
 
         Time-limit truncations are stored with done=False — the target must
         still bootstrap from s' there, exactly like the reference separates
         terminated from truncated when building Q targets."""
         n = self.envs.num_envs
-        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        obs_l, act_l, rew_l, next_l, done_l, ended_l = [], [], [], [], [], []
         for _ in range(num_steps):
             obs = self.envs.observations
-            q = np.asarray(self._fwd(self.params, jnp.asarray(obs)))
-            actions = q.argmax(axis=-1)
-            explore = self._rng.random(n) < epsilon
-            actions = np.where(
-                explore, self._rng.integers(0, self.num_actions, n), actions
-            ).astype(np.int32)
+            if self.noisy:
+                # exploration comes from resampled weight noise
+                self._jrng, sub = jax.random.split(self._jrng)
+                q = np.asarray(self._fwd(self.params, jnp.asarray(obs), sub))
+                actions = q.argmax(axis=-1).astype(np.int32)
+            else:
+                q = np.asarray(self._fwd(self.params, jnp.asarray(obs)))
+                actions = q.argmax(axis=-1)
+                explore = self._rng.random(n) < epsilon
+                actions = np.where(
+                    explore, self._rng.integers(0, self.num_actions, n), actions
+                ).astype(np.int32)
             next_obs, rewards, terms, truncs, finals = self.envs.step(actions)
             obs_l.append(obs)
             act_l.append(actions)
@@ -84,13 +162,60 @@ class DQNRolloutWorker:
             # s' is the PRE-reset state for ended episodes
             next_l.append(finals)
             done_l.append(terms)  # truncation is not a terminal for targets
+            ended_l.append(terms | truncs)  # but it DOES break n-step chains
             self._episodes.track(rewards, terms | truncs)
+        if self.n_step > 1:
+            return self._nstep_batch(obs_l, act_l, rew_l, next_l, done_l, ended_l)
         return SampleBatch(
             obs=np.concatenate(obs_l),
             actions=np.concatenate(act_l),
             rewards=np.concatenate(rew_l),
             new_obs=np.concatenate(next_l),
             dones=np.concatenate(done_l),
+        )
+
+    def _nstep_batch(self, obs_l, act_l, rew_l, next_l, done_l, ended_l) -> SampleBatch:
+        """Fold T timesteps into n-step transitions: R = sum gamma^k r_{t+k}
+        with the chain broken at episode end (terminal OR truncation — a
+        reset must never leak the next episode's rewards in); the bootstrap
+        state is s_{t+n} or the chain-ending state. Emitted for every t
+        whose full window fits in this fragment (the reference's n-step
+        postprocessing drops the tail the same way). A chain ended early by
+        truncation bootstraps with gamma^n instead of gamma^{k+1} — the
+        standard small bias of fixed-exponent n-step replay."""
+        T = len(obs_l)
+        nstep, gamma = self.n_step, self.gamma
+        obs = np.stack(obs_l)          # (T, E, ...)
+        actions = np.stack(act_l)
+        rewards = np.stack(rew_l)
+        new_obs = np.stack(next_l)
+        dones = np.stack(done_l)
+        ended = np.stack(ended_l)
+        out_obs, out_act, out_rew, out_next, out_done = [], [], [], [], []
+        valid_T = T - nstep + 1
+        for t in range(valid_T):
+            ret = np.zeros(rewards.shape[1], np.float32)
+            discount = np.ones(rewards.shape[1], np.float32)
+            alive = np.ones(rewards.shape[1], bool)
+            boot_next = new_obs[t].copy()
+            boot_done = dones[t].copy()
+            for k in range(nstep):
+                ret += discount * rewards[t + k] * alive
+                boot_next[alive] = new_obs[t + k][alive]
+                boot_done[alive] = dones[t + k][alive]
+                alive = alive & ~ended[t + k]
+                discount *= gamma
+            out_obs.append(obs[t])
+            out_act.append(actions[t])
+            out_rew.append(ret)
+            out_next.append(boot_next)
+            out_done.append(boot_done)
+        return SampleBatch(
+            obs=np.concatenate(out_obs),
+            actions=np.concatenate(out_act),
+            rewards=np.concatenate(out_rew),
+            new_obs=np.concatenate(out_next),
+            dones=np.concatenate(out_done),
         )
 
     def episode_returns(self, clear: bool = True) -> List[float]:
@@ -102,8 +227,12 @@ class DQNLearner:
 
     def __init__(self, observation_size: int, num_actions: int, *,
                  hidden: Sequence[int] = (64, 64), lr: float = 1e-3,
-                 gamma: float = 0.99, grad_clip: float = 10.0, seed: int = 0):
-        self.net = QNetwork(num_actions, tuple(hidden))
+                 gamma: float = 0.99, grad_clip: float = 10.0, seed: int = 0,
+                 dueling: bool = False, noisy: bool = False, n_step: int = 1):
+        self.net = QNetwork(
+            num_actions, tuple(hidden), dueling=dueling, noisy=noisy
+        )
+        self.noisy = noisy
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(grad_clip), optax.adam(lr)
         )
@@ -113,19 +242,28 @@ class DQNLearner:
         )["params"]
         self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
         self.opt_state = self.optimizer.init(self.params)
-        gamma_ = gamma
+        self._update_rng = jax.random.PRNGKey(seed + 11)
+        # n-step transitions bootstrap with gamma^n (the worker folded the
+        # intermediate rewards into batch["rewards"])
+        gamma_ = gamma ** max(1, int(n_step))
         net = self.net
         optimizer = self.optimizer
 
-        def loss_fn(params, target_params, batch):
-            q = net.apply({"params": params}, batch["obs"])
+        def loss_fn(params, target_params, batch, rng):
+            r_online = r_pick = r_target = None
+            if noisy:
+                # independent noise per pass, as in the rainbow paper
+                r_online, r_pick, r_target = jax.random.split(rng, 3)
+            q = net.apply({"params": params}, batch["obs"], r_online)
             q_taken = jnp.take_along_axis(
                 q, batch["actions"][:, None].astype(jnp.int32), axis=-1
             )[:, 0]
             # double-Q: online net picks the argmax, target net evaluates it
-            q_next_online = net.apply({"params": params}, batch["new_obs"])
+            q_next_online = net.apply({"params": params}, batch["new_obs"], r_pick)
             best = jnp.argmax(q_next_online, axis=-1)
-            q_next_target = net.apply({"params": target_params}, batch["new_obs"])
+            q_next_target = net.apply(
+                {"params": target_params}, batch["new_obs"], r_target
+            )
             q_best = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
             not_done = 1.0 - batch["dones"].astype(jnp.float32)
             target = batch["rewards"] + gamma_ * not_done * jax.lax.stop_gradient(q_best)
@@ -135,9 +273,9 @@ class DQNLearner:
             loss = jnp.mean(huber * weights) if weights is not None else jnp.mean(huber)
             return loss, td_error
 
-        def step(params, target_params, opt_state, batch):
+        def step(params, target_params, opt_state, batch, rng):
             (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target_params, batch
+                params, target_params, batch, rng
             )
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -148,8 +286,9 @@ class DQNLearner:
     def update(self, batch: SampleBatch) -> Tuple[float, np.ndarray]:
         jb = {k: jnp.asarray(v) for k, v in batch.items()
               if k != "batch_indexes"}
+        self._update_rng, sub = jax.random.split(self._update_rng)
         self.params, self.opt_state, loss, td = self._step(
-            self.params, self.target_params, self.opt_state, jb
+            self.params, self.target_params, self.opt_state, jb, sub
         )
         return float(loss), np.asarray(td)
 
@@ -181,9 +320,23 @@ class DQNConfig:
     lr: float = 1e-3
     hidden: tuple = (64, 64)
     seed: int = 0
+    # rainbow knobs (reference: rllib DQN config dueling/noisy/n_step)
+    dueling: bool = False
+    noisy: bool = False
+    n_step: int = 1
 
     def build(self) -> "DQN":
         return DQN(self)
+
+
+@dataclasses.dataclass
+class RainbowDQNConfig(DQNConfig):
+    """DQN with the rainbow defaults on (reference configures rainbow
+    through the same DQN surface: dueling + noisy + n-step + PER)."""
+
+    dueling: bool = True
+    noisy: bool = True
+    n_step: int = 3
 
 
 class DQN:
@@ -198,13 +351,18 @@ class DQN:
                 num_envs=config.num_envs_per_worker,
                 seed=config.seed + 1000 * i,
                 hidden=config.hidden,
+                dueling=config.dueling,
+                noisy=config.noisy,
+                n_step=config.n_step,
+                gamma=config.gamma,
             )
             for i in range(config.num_rollout_workers)
         ]
         self.learner = DQNLearner(
             probe.observation_size, probe.num_actions,
             hidden=config.hidden, lr=config.lr, gamma=config.gamma,
-            seed=config.seed,
+            seed=config.seed, dueling=config.dueling, noisy=config.noisy,
+            n_step=config.n_step,
         )
         if config.prioritized_replay:
             self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
@@ -226,6 +384,8 @@ class DQN:
     @property
     def epsilon(self) -> float:
         cfg = self.config
+        if cfg.noisy:
+            return 0.0  # exploration comes from the weight noise
         frac = min(1.0, self._env_steps / max(1, cfg.epsilon_decay_steps))
         return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
 

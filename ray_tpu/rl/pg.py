@@ -1,0 +1,166 @@
+"""Vanilla policy gradient (REINFORCE with a batch-mean baseline).
+
+Reference: rllib/algorithms/pg — the minimal on-policy algorithm: collect
+full-trajectory discounted returns, ascend logp-weighted returns. No
+critic is trained; the variance-reduction baseline is the batch mean
+(classic REINFORCE-with-baseline). Shares the generic RolloutWorker
+(rollout_worker.py), whose discounted "returns" column is exactly what PG
+consumes (its GAE advantages are ignored).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.rl.rl_module import DiscretePolicyModule
+from ray_tpu.rl.rollout_worker import RolloutWorker
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class PGLearner:
+    def __init__(self, observation_size: int, num_actions: int, *,
+                 hidden: Sequence[int] = (64, 64), lr: float = 1e-3,
+                 entropy_coeff: float = 0.0, grad_clip: float = 10.0,
+                 seed: int = 0):
+        self.net = DiscretePolicyModule(num_actions, tuple(hidden))
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(grad_clip), optax.adam(lr)
+        )
+        self.params = self.net.init(
+            jax.random.PRNGKey(seed),
+            jnp.zeros((1, observation_size), jnp.float32),
+        )["params"]
+        self.opt_state = self.optimizer.init(self.params)
+        net = self.net
+
+        def loss_fn(params, batch):
+            logits, _values = net.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            returns = batch["returns"]
+            # batch-mean baseline: unbiased, no trained critic
+            centered = returns - jnp.mean(returns)
+            policy_loss = -jnp.mean(logp * centered)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = policy_loss - entropy_coeff * entropy
+            return total, {
+                "policy_loss": policy_loss,
+                "entropy": entropy,
+                "total_loss": total,
+            }
+
+        def step(params, opt_state, batch):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, metrics
+
+        self._step = jax.jit(step)
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._step(
+            self.params, self.opt_state, jb
+        )
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+
+@dataclasses.dataclass
+class PGConfig:
+    env: str = "CartPole-v1"
+    num_rollout_workers: int = 2
+    num_envs_per_worker: int = 4
+    rollout_fragment_length: int = 64
+    lr: float = 2e-3
+    gamma: float = 0.99
+    entropy_coeff: float = 0.0
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "PG":
+        return PG(self)
+
+
+class PG:
+    """Iteration driver: sample -> single gradient step -> broadcast."""
+
+    def __init__(self, config: PGConfig):
+        self.config = config
+        from ray_tpu.rl.env import make_env
+
+        probe = make_env(config.env)
+        self.workers = [
+            RolloutWorker.remote(
+                config.env,
+                num_envs=config.num_envs_per_worker,
+                seed=config.seed + 1000 * i,
+                gamma=config.gamma,
+                lam=1.0,  # plain discounted returns
+            )
+            for i in range(config.num_rollout_workers)
+        ]
+        self.learner = PGLearner(
+            probe.observation_size, probe.num_actions,
+            hidden=config.hidden, lr=config.lr,
+            entropy_coeff=config.entropy_coeff, seed=config.seed,
+        )
+        self._iteration = 0
+        self._env_steps = 0
+        self._broadcast()
+
+    def _broadcast(self):
+        weights = self.learner.get_weights()
+        ray_tpu.get(
+            [w.set_weights.remote(weights) for w in self.workers], timeout=120
+        )
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        cfg = self.config
+        batches = ray_tpu.get(
+            [
+                w.sample.remote(cfg.rollout_fragment_length)
+                for w in self.workers
+            ],
+            timeout=600,
+        )
+        batch = SampleBatch.concat(batches)
+        self._env_steps += len(batch)
+        metrics = self.learner.update(batch)
+        self._broadcast()
+        episode_returns: List[float] = []
+        for w in self.workers:
+            episode_returns.extend(
+                ray_tpu.get(w.episode_returns.remote(), timeout=60)
+            )
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "env_steps": self._env_steps,
+            **metrics,
+            "episode_return_mean": float(np.mean(episode_returns))
+            if episode_returns else float("nan"),
+            "episodes_this_iter": len(episode_returns),
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def stop(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass

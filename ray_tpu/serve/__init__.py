@@ -78,6 +78,25 @@ class Application:
         self.init_args = init_args
         self.init_kwargs = init_kwargs
 
+    def __getattr__(self, name):
+        # dotted method binding for the deployment-graph DAG API
+        # (serve/dag.py): ``app.method.bind(args)`` builds a MethodNode.
+        # Defined on the class itself so behavior never depends on whether
+        # dag.py was imported. Private/dunder names raise normally (pickle
+        # and hasattr-probing code paths stay sane); a public name that is
+        # NOT a method of the wrapped class also raises, so typos fail at
+        # authoring time instead of surfacing as broken graph nodes.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        target = self.deployment.func_or_class
+        if not callable(getattr(target, name, None)):
+            raise AttributeError(
+                f"{target!r} has no method {name!r} to bind"
+            )
+        from ray_tpu.serve.dag import _MethodBinder
+
+        return _MethodBinder(self, name)
+
 
 def deployment(
     _func_or_class=None,

@@ -67,26 +67,6 @@ class _MethodBinder:
         return MethodNode(self._app, self._method, args)
 
 
-def _install_application_binder():
-    """Give Application dotted method binding (app.method.bind(...)) without
-    touching its own attributes."""
-    from ray_tpu.serve import Application
-
-    if getattr(Application, "_dag_binder_installed", False):
-        return
-
-    def __getattr__(self, name):  # noqa: N807 - class patch
-        if name.startswith("_"):
-            raise AttributeError(name)
-        return _MethodBinder(self, name)
-
-    Application.__getattr__ = __getattr__
-    Application._dag_binder_installed = True
-
-
-_install_application_binder()
-
-
 # ---------------------------------------------------------------------------
 # build: node graph -> serializable plan
 # ---------------------------------------------------------------------------

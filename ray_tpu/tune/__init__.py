@@ -18,6 +18,7 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    BayesOptSearcher,
     ConcurrencyLimiter,
     Repeater,
     Searcher,
@@ -50,6 +51,7 @@ __all__ = [
     "AsyncHyperBandScheduler",
     "Checkpoint",
     "BasicVariantGenerator",
+    "BayesOptSearcher",
     "ConcurrencyLimiter",
     "FIFOScheduler",
     "HyperBandScheduler",

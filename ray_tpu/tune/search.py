@@ -459,3 +459,135 @@ class Repeater(Searcher):
                 {self.metric: sum(values) / len(values)} if values else None
             )
             self.searcher.on_trial_complete(leader, mean)
+
+
+class BayesOptSearcher(Searcher):
+    """Native Gaussian-process Bayesian optimization (expected improvement).
+
+    Capability analogue of the reference's skopt / bayesopt / hebo
+    integrations (reference: python/ray/tune/search/bayesopt/
+    bayesopt_search.py behind the Searcher ABC): numeric dimensions embed
+    in the unit cube, an exact RBF GP fits the (normalized) observations,
+    and suggestions maximize expected improvement over random candidates.
+    Choice dimensions fall back to uniform sampling (the reference's
+    bayesopt integration rejects them outright; sampling keeps mixed
+    spaces usable).
+    """
+
+    def __init__(
+        self,
+        param_space: Dict[str, Any],
+        metric: Optional[str] = None,
+        mode: str = "max",
+        n_startup: int = 6,
+        n_candidates: int = 256,
+        lengthscale: float = 0.25,
+        num_samples: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(metric, mode)
+        self._rng = random.Random(seed)
+        self.param_space = dict(param_space)
+        for k, v in self.param_space.items():
+            if _is_grid(v):
+                raise ValueError(
+                    f"grid_search axis {k!r} in a model-based searcher; "
+                    "use BasicVariantGenerator for exhaustive axes"
+                )
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.lengthscale = lengthscale
+        self.num_samples = num_samples
+        self._numeric = [
+            k for k, v in sorted(self.param_space.items())
+            if isinstance(v, (Uniform, LogUniform, RandInt))
+        ]
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._suggested = 0
+
+    # -- unit-cube embedding -------------------------------------------
+
+    def _to_unit(self, key: str, value: float) -> float:
+        import math
+
+        dom = self.param_space[key]
+        if isinstance(dom, LogUniform):
+            return (math.log(value) - dom._lo) / max(dom._hi - dom._lo, 1e-12)
+        lo, hi = float(dom.low), float(dom.high)
+        return (value - lo) / max(hi - lo, 1e-12)
+
+    def _from_unit(self, key: str, u: float):
+        import math
+
+        dom = self.param_space[key]
+        if isinstance(dom, LogUniform):
+            return math.exp(dom._lo + u * (dom._hi - dom._lo))
+        lo, hi = float(dom.low), float(dom.high)
+        if isinstance(dom, RandInt):
+            # randrange semantics: high is exclusive
+            return min(int(dom.high) - 1, int(dom.low) + int(u * (hi - lo)))
+        return lo + u * (hi - lo)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self.num_samples is not None and self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        cfg: Dict[str, Any] = {}
+        # non-numeric dims sample uniformly
+        for key, dom in self.param_space.items():
+            if key in self._numeric:
+                continue
+            cfg[key] = dom.sample(self._rng) if isinstance(dom, Domain) else dom
+        if len(self._obs_x) < self.n_startup or not self._numeric:
+            for key in self._numeric:
+                cfg[key] = self.param_space[key].sample(self._rng)
+        else:
+            import numpy as np
+
+            X = np.asarray(self._obs_x, dtype=np.float64)
+            y = np.asarray(self._obs_y, dtype=np.float64)
+            y_std = y.std() or 1.0
+            yn = (y - y.mean()) / y_std
+            ls, noise = self.lengthscale, 1e-4
+            d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+            K = np.exp(-d2 / (2 * ls * ls)) + noise * np.eye(len(X))
+            try:
+                L = np.linalg.cholesky(K)
+                alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+            except np.linalg.LinAlgError:
+                alpha = L = None
+            rng = np.random.default_rng(self._rng.randrange(1 << 31))
+            cands = rng.random((self.n_candidates, len(self._numeric)))
+            if alpha is None:
+                best = cands[0]
+            else:
+                dc2 = ((cands[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+                Kc = np.exp(-dc2 / (2 * ls * ls))
+                mu = Kc @ alpha
+                v = np.linalg.solve(L, Kc.T)
+                var = np.maximum(1.0 + noise - (v * v).sum(0), 1e-12)
+                sigma = np.sqrt(var)
+                f_best = yn.max()
+                z = (mu - f_best) / sigma
+                # expected improvement via the standard normal
+                from math import erf, pi
+
+                pdf = np.exp(-0.5 * z * z) / np.sqrt(2 * pi)
+                cdf = 0.5 * (1.0 + np.vectorize(erf)(z / np.sqrt(2.0)))
+                ei = (mu - f_best) * cdf + sigma * pdf
+                best = cands[int(np.argmax(ei))]
+            for i, key in enumerate(self._numeric):
+                cfg[key] = self._from_unit(key, float(best[i]))
+        self._pending[trial_id] = cfg
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None):
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or not result or self.metric not in result:
+            return
+        sign = 1.0 if self.mode == "max" else -1.0
+        vec = [self._to_unit(k, cfg[k]) for k in self._numeric]
+        self._obs_x.append(vec)
+        self._obs_y.append(sign * float(result[self.metric]))

@@ -35,7 +35,7 @@ def main():
             r = result.get("episode_return_mean", float("nan"))
             if np.isfinite(r):
                 best = max(best, r)
-            steps0 = result.get("env_steps", steps0)
+            steps0 = result.get("env_steps_total") or result.get("env_steps") or steps0
             if best >= 80.0 and time.perf_counter() - t0 > 30:
                 break
         dt = time.perf_counter() - t0

@@ -45,9 +45,11 @@ def run_workload(name: str, spec: dict) -> dict:
             cwd=REPO,
         )
         out = proc.stdout
+        err_tail = "\n".join((proc.stderr or "").splitlines()[-12:])
         rc = proc.returncode
     except subprocess.TimeoutExpired as e:
         out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err_tail = ""
         rc = -1
     duration = time.perf_counter() - t0
 
@@ -68,6 +70,8 @@ def run_workload(name: str, spec: dict) -> dict:
     failures = []
     if rc != 0:
         failures.append(f"exit code {rc}" if rc != -1 else "TIMEOUT")
+        if err_tail:
+            failures.append(f"stderr tail:\n{err_tail}")
     for metric, bounds in (spec.get("criteria") or {}).items():
         value = metrics.get(metric)
         if value is None:

@@ -178,3 +178,71 @@ def test_tensor_column_pipeline_to_jax(ray_start_regular):
     # order-independent content check: row i must equal base[i] * 2
     order = np.argsort(got_idx)
     np.testing.assert_allclose(got_imgs[order], base * 2.0)
+
+
+def test_read_sql(ray_start_regular):
+    import sqlite3
+
+    db = "/tmp/raytpu_test_readers.db"
+    conn = sqlite3.connect(db)
+    conn.execute("DROP TABLE IF EXISTS t")
+    conn.execute("CREATE TABLE t (id INTEGER, name TEXT, score REAL)")
+    conn.executemany(
+        "INSERT INTO t VALUES (?, ?, ?)",
+        [(i, f"row{i}", i * 1.5) for i in range(57)],
+    )
+    conn.commit()
+    conn.close()
+
+    import functools
+
+    factory = functools.partial(sqlite3.connect, db)
+    ds = rd.read_sql("SELECT * FROM t", factory, order_by="id", parallelism=4)
+    rows = ds.take_all()
+    assert len(rows) == 57
+    assert sorted(r["id"] for r in rows) == list(range(57))
+    assert rows[0]["name"].startswith("row")
+    assert len(ds._block_refs) == 4  # ordered reads shard
+
+    # without order_by: single-task read (deterministic on every engine)
+    ds1 = rd.read_sql("SELECT * FROM t", factory)
+    assert len(ds1._block_refs) == 1
+    assert ds1.count() == 57
+
+
+def test_read_webdataset(ray_start_regular, tmp_path):
+    import io
+    import json
+    import tarfile
+
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for shard in range(2):
+        with tarfile.open(tmp_path / f"shard{shard}.tar", "w") as tar:
+            for i in range(4):
+                key = f"s{shard}_{i}"
+                img = rng.integers(0, 255, (6, 5, 3), dtype=np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(img).save(buf, format="PNG")
+                for ext, data in (
+                    ("png", buf.getvalue()),
+                    ("cls", str(i).encode()),
+                    ("json", json.dumps({"k": key}).encode()),
+                    ("txt", f"caption {i}".encode()),
+                ):
+                    raw = data
+                    info = tarfile.TarInfo(f"{key}.{ext}")
+                    info.size = len(raw)
+                    tar.addfile(info, io.BytesIO(raw))
+
+    ds = rd.read_webdataset(str(tmp_path / "*.tar"))
+    rows = ds.take_all()
+    assert len(rows) == 8
+    by_key = {r["__key__"]: r for r in rows}
+    r = by_key["s0_2"]
+    assert r["cls"] == 2
+    assert r["json"]["k"] == "s0_2"
+    assert r["txt"] == "caption 2"
+    img = np.asarray(r["png"])
+    assert img.shape == (6, 5, 3)
