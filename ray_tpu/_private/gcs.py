@@ -560,17 +560,28 @@ class GcsServer:
                 client.close()
                 return racer
             self._worker_clients[addr] = client
-            # LRU bound: evictions (and failure drops below) only FORGET
-            # the client — close() would fail concurrent in-flight
-            # create_actor calls sharing it; the transport reclaims the fd
-            # when the worker side goes away (closed event)
+            # LRU bound: evictions (and failure drops below) close on a
+            # DELAY — an immediate close() would fail concurrent in-flight
+            # create_actor calls sharing the client; the grace period
+            # exceeds the longest create timeout, after which closing a
+            # still-open socket reclaims the fd instead of leaking it at
+            # the 10k-actor envelope
             while len(self._worker_clients) > 512:
-                self._worker_clients.popitem(last=False)
+                _, victim = self._worker_clients.popitem(last=False)
+                self._deferred_close(victim)
         return client
+
+    def _deferred_close(self, client: RpcClient):
+        delay = GlobalConfig.gcs_rpc_timeout_s * 10 + 5
+        timer = threading.Timer(delay, client.close)
+        timer.daemon = True
+        timer.start()
 
     def _drop_worker_client(self, addr: Tuple[str, int]):
         with self._lock:
-            self._worker_clients.pop(addr, None)
+            client = self._worker_clients.pop(addr, None)
+        if client is not None:
+            self._deferred_close(client)
 
     def _raylet_client(self, node: NodeInfo) -> RpcClient:
         with self._lock:

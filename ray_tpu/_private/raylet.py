@@ -570,15 +570,25 @@ class Raylet:
             }
 
     def _find_spill_node(
-        self, resources: Dict[str, float], against: str
+        self, resources: Dict[str, float], against: str, fresh: bool = False
     ) -> Optional[Tuple[str, int]]:
         """Pick another node that fits the request, preferring the gossiped
         resource view (bounded staleness <= 3 broadcast periods) over a
         synchronous GCS round-trip (the reference's spillback reply,
-        direct_task_transport.cc:501, fed by the ray_syncer view)."""
+        direct_task_transport.cc:501, fed by the ray_syncer view).
+
+        ``fresh=True`` forces the synchronous fetch: callers about to make
+        a CORRECTNESS decision (declaring a request globally infeasible)
+        must not do it from a stale cache — a node registered milliseconds
+        ago may be missing from the last broadcast, and "infeasible" is a
+        user-visible error, not a routing hint."""
         view = self._peer_view
         max_age = GlobalConfig.resource_broadcast_period_s * 3
-        if view["nodes"] and time.monotonic() - view["at"] <= max_age:
+        if (
+            not fresh
+            and view["nodes"]
+            and time.monotonic() - view["at"] <= max_age
+        ):
             nodes = view["nodes"]
         else:
             try:
@@ -613,7 +623,9 @@ class Raylet:
                     if allow_spill:
                         self._res_cv.release()
                         try:
-                            spill = self._find_spill_node(resources, against="total")
+                            spill = self._find_spill_node(
+                                resources, against="total", fresh=True
+                            )
                         finally:
                             self._res_cv.acquire()
                         if spill is not None:

@@ -64,17 +64,21 @@ class ApexRolloutWorker(DQNRolloutWorker._cls):
     locally-computed initial TD priorities (the Ape-X worker contract)."""
 
     def __init__(self, env_name: str, *, gamma: float = 0.99, **kw):
-        super().__init__(env_name, **kw)
-        self.gamma = gamma
+        super().__init__(env_name, gamma=gamma, **kw)
+        # n-step batches fold intermediate rewards into `rewards`, so the
+        # worker-side initial-priority TD bootstraps with gamma^n too
+        gamma_boot = gamma ** self.n_step
 
         def td_error(params, obs, actions, rewards, new_obs, dones):
+            # rng=None -> mean weights for noisy nets (deterministic
+            # priority estimates)
             q = self.net.apply({"params": params}, obs)
             q_taken = jnp.take_along_axis(
                 q, actions[:, None].astype(jnp.int32), axis=-1
             )[:, 0]
             q_next = self.net.apply({"params": params}, new_obs)
             best = jnp.max(q_next, axis=-1)
-            target = rewards + self.gamma * (1.0 - dones) * best
+            target = rewards + gamma_boot * (1.0 - dones) * best
             return q_taken - target
 
         self._td = jax.jit(td_error)
@@ -114,12 +118,24 @@ class ApexDQN:
     """Driver: pipelined sampling into shards + continuous learner pulls."""
 
     def __init__(self, config: ApexDQNConfig):
+        if getattr(config, "num_atoms", 1) > 1:
+            raise ValueError(
+                "ApexDQN does not support distributional (num_atoms>1) "
+                "learning: worker-side initial TD priorities assume scalar "
+                "Q targets"
+            )
+        if config.rollout_fragment_length < config.n_step:
+            raise ValueError(
+                f"rollout_fragment_length ({config.rollout_fragment_length}) "
+                f"must be >= n_step ({config.n_step})"
+            )
         self.config = config
         probe = make_env(config.env)
         self.learner = DQNLearner(
             probe.observation_size, probe.num_actions,
             hidden=config.hidden, lr=config.lr, gamma=config.gamma,
-            seed=config.seed,
+            seed=config.seed, dueling=config.dueling, noisy=config.noisy,
+            n_step=config.n_step,
         )
         self.shards = [
             ReplayShardActor.remote(
@@ -136,6 +152,9 @@ class ApexDQN:
                 num_envs=config.num_envs_per_worker,
                 seed=config.seed + 1000 * i,
                 hidden=config.hidden,
+                dueling=config.dueling,
+                noisy=config.noisy,
+                n_step=config.n_step,
             )
             for i in range(config.num_rollout_workers)
         ]

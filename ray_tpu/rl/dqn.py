@@ -39,12 +39,13 @@ class NoisyDense(nn.Module):
     def __call__(self, x: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
         in_dim = x.shape[-1]
         bound = 1.0 / jnp.sqrt(in_dim)
-        w_mu = self.param(
-            "w_mu", nn.initializers.uniform(scale=2 * bound), (in_dim, self.features)
-        )
-        b_mu = self.param(
-            "b_mu", nn.initializers.uniform(scale=2 * bound), (self.features,)
-        )
+
+        def centered_uniform(key, shape, dtype=jnp.float32):
+            # U[-bound, +bound] (flax's uniform() samples [0, scale) only)
+            return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+        w_mu = self.param("w_mu", centered_uniform, (in_dim, self.features))
+        b_mu = self.param("b_mu", centered_uniform, (self.features,))
         w_sigma = self.param(
             "w_sigma",
             nn.initializers.constant(self.sigma0 * bound),
@@ -77,6 +78,10 @@ class QNetwork(nn.Module):
     hidden: Sequence[int] = (64, 64)
     dueling: bool = False
     noisy: bool = False
+    # C51 (Bellemare et al.): >1 atoms -> the network outputs a categorical
+    # return distribution per action; __call__ then returns LOGITS of shape
+    # (batch, actions, atoms) instead of Q-values
+    num_atoms: int = 1
 
     @nn.compact
     def __call__(self, obs: jax.Array, rng: Optional[jax.Array] = None) -> jax.Array:
@@ -88,15 +93,67 @@ class QNetwork(nn.Module):
             if self.noisy:
                 layer_rng = None
                 if rng is not None:
-                    layer_rng = jax.random.fold_in(rng, hash(name) % (1 << 31))
+                    import zlib
+
+                    # stable fold-in constant: hash() is salted per process
+                    # (PYTHONHASHSEED), which would break seed reproducibility
+                    # and learner/worker noise agreement
+                    layer_rng = jax.random.fold_in(
+                        rng, zlib.crc32(name.encode()) & 0x7FFFFFFF
+                    )
                 return NoisyDense(features, name=name)(x, layer_rng)
             return nn.Dense(features, name=name)(x)
 
+        atoms = max(1, self.num_atoms)
         if self.dueling:
-            value = head(1, "v_head")
-            adv = head(self.num_actions, "a_head")
+            value = head(atoms, "v_head")
+            adv = head(self.num_actions * atoms, "a_head")
+            if atoms > 1:
+                value = value[:, None, :]
+                adv = adv.reshape(adv.shape[0], self.num_actions, atoms)
+                out = value + adv - adv.mean(axis=1, keepdims=True)
+                return out
             return value + adv - adv.mean(axis=-1, keepdims=True)
-        return head(self.num_actions, "q_head")
+        out = head(self.num_actions * atoms, "q_head")
+        if atoms > 1:
+            return out.reshape(out.shape[0], self.num_actions, atoms)
+        return out
+
+
+def atom_support(v_min: float, v_max: float, num_atoms: int) -> jnp.ndarray:
+    return jnp.linspace(v_min, v_max, num_atoms)
+
+
+def expected_q(logits: jax.Array, z: jax.Array) -> jax.Array:
+    """(B, A, N) distribution logits -> (B, A) expected Q values."""
+    return (jax.nn.softmax(logits, axis=-1) * z).sum(-1)
+
+
+def categorical_projection(
+    next_dist: jax.Array, rewards: jax.Array, not_done: jax.Array,
+    gamma_n: float, z: jax.Array,
+) -> jax.Array:
+    """Project the Bellman-shifted support back onto the fixed atoms
+    (the C51 target distribution, Bellemare et al. alg. 1), vectorized."""
+    num_atoms = z.shape[0]
+    v_min, v_max = z[0], z[-1]
+    dz = (v_max - v_min) / (num_atoms - 1)
+    tz = jnp.clip(
+        rewards[:, None] + gamma_n * not_done[:, None] * z[None, :],
+        v_min, v_max,
+    )
+    b = (tz - v_min) / dz                     # (B, N) fractional atom index
+    lower = jnp.floor(b)
+    upper = jnp.ceil(b)
+    # when b is integral, put all mass on the lower atom
+    w_upper = b - lower
+    w_lower = 1.0 - w_upper
+    m = jnp.zeros_like(next_dist)
+    onehot_l = jax.nn.one_hot(lower.astype(jnp.int32), num_atoms)  # (B,N,N)
+    onehot_u = jax.nn.one_hot(upper.astype(jnp.int32), num_atoms)
+    m = (next_dist[:, :, None] * (w_lower[:, :, None] * onehot_l
+                                  + w_upper[:, :, None] * onehot_u)).sum(1)
+    return m
 
 
 @ray_tpu.remote
@@ -106,11 +163,13 @@ class DQNRolloutWorker:
 
     def __init__(self, env_name: str, *, num_envs: int = 4, seed: int = 0,
                  hidden: Tuple[int, ...] = (64, 64), dueling: bool = False,
-                 noisy: bool = False, n_step: int = 1, gamma: float = 0.99):
+                 noisy: bool = False, n_step: int = 1, gamma: float = 0.99,
+                 num_atoms: int = 1, v_min: float = 0.0, v_max: float = 200.0):
         self.envs = VectorEnv(lambda: make_env(env_name), num_envs, seed=seed)
         probe = make_env(env_name)
         self.net = QNetwork(
-            probe.num_actions, tuple(hidden), dueling=dueling, noisy=noisy
+            probe.num_actions, tuple(hidden), dueling=dueling, noisy=noisy,
+            num_atoms=num_atoms,
         )
         self.num_actions = probe.num_actions
         self.noisy = noisy
@@ -120,9 +179,17 @@ class DQNRolloutWorker:
             jax.random.PRNGKey(seed),
             jnp.zeros((1, probe.observation_size), jnp.float32),
         )["params"]
-        self._fwd = jax.jit(
-            lambda p, o, r=None: self.net.apply({"params": p}, o, r)
-        )
+        if num_atoms > 1:
+            z = atom_support(v_min, v_max, num_atoms)
+            self._fwd = jax.jit(
+                lambda p, o, r=None: expected_q(
+                    self.net.apply({"params": p}, o, r), z
+                )
+            )
+        else:
+            self._fwd = jax.jit(
+                lambda p, o, r=None: self.net.apply({"params": p}, o, r)
+            )
         self._rng = np.random.default_rng(seed + 1)
         self._jrng = jax.random.PRNGKey(seed + 2)
         self._episodes = EpisodeReturnTracker(num_envs)
@@ -228,11 +295,15 @@ class DQNLearner:
     def __init__(self, observation_size: int, num_actions: int, *,
                  hidden: Sequence[int] = (64, 64), lr: float = 1e-3,
                  gamma: float = 0.99, grad_clip: float = 10.0, seed: int = 0,
-                 dueling: bool = False, noisy: bool = False, n_step: int = 1):
+                 dueling: bool = False, noisy: bool = False, n_step: int = 1,
+                 num_atoms: int = 1, v_min: float = 0.0, v_max: float = 200.0):
         self.net = QNetwork(
-            num_actions, tuple(hidden), dueling=dueling, noisy=noisy
+            num_actions, tuple(hidden), dueling=dueling, noisy=noisy,
+            num_atoms=num_atoms,
         )
         self.noisy = noisy
+        self.num_atoms = num_atoms
+        z = atom_support(v_min, v_max, num_atoms) if num_atoms > 1 else None
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(grad_clip), optax.adam(lr)
         )
@@ -254,10 +325,39 @@ class DQNLearner:
             if noisy:
                 # independent noise per pass, as in the rainbow paper
                 r_online, r_pick, r_target = jax.random.split(rng, 3)
+            actions = batch["actions"].astype(jnp.int32)
+            not_done = 1.0 - batch["dones"].astype(jnp.float32)
+            weights = batch.get("weights")
+            if num_atoms > 1:
+                # C51: cross-entropy to the projected target distribution
+                logits = net.apply({"params": params}, batch["obs"], r_online)
+                logits_taken = jnp.take_along_axis(
+                    logits, actions[:, None, None], axis=1
+                )[:, 0]
+                logp_taken = jax.nn.log_softmax(logits_taken, axis=-1)
+                next_online = net.apply(
+                    {"params": params}, batch["new_obs"], r_pick
+                )
+                best = jnp.argmax(expected_q(next_online, z), axis=-1)
+                next_target = net.apply(
+                    {"params": target_params}, batch["new_obs"], r_target
+                )
+                next_dist = jax.nn.softmax(
+                    jnp.take_along_axis(
+                        next_target, best[:, None, None], axis=1
+                    )[:, 0],
+                    axis=-1,
+                )
+                m = jax.lax.stop_gradient(
+                    categorical_projection(
+                        next_dist, batch["rewards"], not_done, gamma_, z
+                    )
+                )
+                ce = -(m * logp_taken).sum(-1)  # per-sample CE = KL + const
+                loss = jnp.mean(ce * weights) if weights is not None else jnp.mean(ce)
+                return loss, ce  # CE doubles as the priority signal
             q = net.apply({"params": params}, batch["obs"], r_online)
-            q_taken = jnp.take_along_axis(
-                q, batch["actions"][:, None].astype(jnp.int32), axis=-1
-            )[:, 0]
+            q_taken = jnp.take_along_axis(q, actions[:, None], axis=-1)[:, 0]
             # double-Q: online net picks the argmax, target net evaluates it
             q_next_online = net.apply({"params": params}, batch["new_obs"], r_pick)
             best = jnp.argmax(q_next_online, axis=-1)
@@ -265,10 +365,8 @@ class DQNLearner:
                 {"params": target_params}, batch["new_obs"], r_target
             )
             q_best = jnp.take_along_axis(q_next_target, best[:, None], axis=-1)[:, 0]
-            not_done = 1.0 - batch["dones"].astype(jnp.float32)
             target = batch["rewards"] + gamma_ * not_done * jax.lax.stop_gradient(q_best)
             td_error = q_taken - target
-            weights = batch.get("weights")
             huber = optax.huber_loss(q_taken, target, delta=1.0)
             loss = jnp.mean(huber * weights) if weights is not None else jnp.mean(huber)
             return loss, td_error
@@ -320,23 +418,35 @@ class DQNConfig:
     lr: float = 1e-3
     hidden: tuple = (64, 64)
     seed: int = 0
-    # rainbow knobs (reference: rllib DQN config dueling/noisy/n_step)
+    # rainbow knobs (reference: rllib DQN config dueling/noisy/n_step/
+    # num_atoms — >1 atoms switches to C51 distributional learning)
     dueling: bool = False
     noisy: bool = False
     n_step: int = 1
+    num_atoms: int = 1
+    v_min: float = 0.0
+    v_max: float = 200.0
 
     def build(self) -> "DQN":
+        if self.rollout_fragment_length < self.n_step:
+            raise ValueError(
+                f"rollout_fragment_length ({self.rollout_fragment_length}) "
+                f"must be >= n_step ({self.n_step}): every n-step window "
+                "must fit inside one collected fragment"
+            )
         return DQN(self)
 
 
 @dataclasses.dataclass
 class RainbowDQNConfig(DQNConfig):
     """DQN with the rainbow defaults on (reference configures rainbow
-    through the same DQN surface: dueling + noisy + n-step + PER)."""
+    through the same DQN surface: dueling + noisy + n-step + C51 + PER).
+    v_min/v_max default to a CartPole-class return range; retune per env."""
 
     dueling: bool = True
     noisy: bool = True
     n_step: int = 3
+    num_atoms: int = 51
 
 
 class DQN:
@@ -355,6 +465,9 @@ class DQN:
                 noisy=config.noisy,
                 n_step=config.n_step,
                 gamma=config.gamma,
+                num_atoms=config.num_atoms,
+                v_min=config.v_min,
+                v_max=config.v_max,
             )
             for i in range(config.num_rollout_workers)
         ]
@@ -362,7 +475,8 @@ class DQN:
             probe.observation_size, probe.num_actions,
             hidden=config.hidden, lr=config.lr, gamma=config.gamma,
             seed=config.seed, dueling=config.dueling, noisy=config.noisy,
-            n_step=config.n_step,
+            n_step=config.n_step, num_atoms=config.num_atoms,
+            v_min=config.v_min, v_max=config.v_max,
         )
         if config.prioritized_replay:
             self.buffer: ReplayBuffer = PrioritizedReplayBuffer(

@@ -509,6 +509,7 @@ def test_pb2_beats_static_search_on_drifting_surface(ray_start_regular, tmp_path
     # scheduling the seed cannot pin on a 1-core host: give the stochastic
     # side two attempts — the claim is comparative, not single-shot
     pb2_best = float("-inf")
+    any_perturbed = False
     for attempt in range(2):
         pb2 = tune.PB2(
             perturbation_interval=4,
@@ -527,10 +528,11 @@ def test_pb2_beats_static_search_on_drifting_surface(ray_start_regular, tmp_path
                 name=f"pb2d{attempt}", storage_path=str(tmp_path)),
         ).fit()
         assert not pop.errors
+        any_perturbed = any_perturbed or pb2.num_perturbations >= 1
         pb2_best = max(pb2_best, pop.get_best_result().metrics["score"])
-        if pb2.num_perturbations >= 1 and pb2_best > tpe_best:
+        if any_perturbed and pb2_best > tpe_best:
             break
-    assert pb2.num_perturbations >= 1, "PB2 never exploited/explored"
+    assert any_perturbed, "PB2 never exploited/explored in any attempt"
     assert pb2_best > tpe_best, (pb2_best, tpe_best)
 
 
