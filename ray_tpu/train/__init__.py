@@ -3,7 +3,13 @@
 (reference: python/ray/train + python/ray/air — SURVEY.md §3.4.)
 """
 
-from ray_tpu.train.backend_executor import BackendExecutor, JaxConfig, TrainingFailedError
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    JaxConfig,
+    TensorflowConfig,
+    TorchConfig,
+    TrainingFailedError,
+)
 from ray_tpu.train.batch_predictor import BatchPredictor, Predictor
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.checkpoint_manager import CheckpointManager
@@ -22,6 +28,16 @@ from ray_tpu.train.gbdt_trainer import (
     XGBoostTrainer,
 )
 from ray_tpu.train.result import Result
+from ray_tpu.train.tensorflow_trainer import (
+    TensorflowTrainer,
+    prepare_dataset_shard,
+)
+from ray_tpu.train.torch_trainer import (
+    TorchTrainer,
+    get_device,
+    prepare_data_loader,
+    prepare_model,
+)
 from ray_tpu.train.session import (
     get_checkpoint,
     get_dataset_shard,
@@ -52,6 +68,10 @@ __all__ = [
     "LightGBMTrainer",
     "SklearnPredictor",
     "SklearnTrainer",
+    "TensorflowConfig",
+    "TensorflowTrainer",
+    "TorchConfig",
+    "TorchTrainer",
     "XGBoostTrainer",
     "Result",
     "RunConfig",
@@ -65,5 +85,9 @@ __all__ = [
     "get_trial_id",
     "get_world_rank",
     "get_world_size",
+    "prepare_data_loader",
+    "prepare_dataset_shard",
+    "prepare_model",
+    "get_device",
     "report",
 ]

@@ -43,6 +43,26 @@ class JaxConfig:
         self.local_device_count = local_device_count
 
 
+class TorchConfig:
+    """Backend config for torch.distributed gangs (reference:
+    python/ray/train/torch/config.py TorchConfig): every rank joins a
+    process group over the gang coordinator before the user loop runs.
+    ``backend="gloo"`` for CPU workers (nccl on GPU hosts)."""
+
+    def __init__(self, backend: str = "gloo"):
+        self.torch_backend = backend
+
+
+class TensorflowConfig:
+    """Backend config for tf.distribute MultiWorkerMirroredStrategy gangs
+    (reference: python/ray/train/tensorflow/config.py): every rank gets a
+    TF_CONFIG naming all ranks' addresses and its own index; the user loop
+    then constructs the strategy."""
+
+    def __init__(self):
+        self.tf_config = True
+
+
 class TrainingFailedError(RuntimeError):
     pass
 
@@ -98,12 +118,23 @@ class BackendExecutor:
         group_name = f"{self.collective_group}-{time.monotonic_ns()}"
         self.group.execute("setup_collective", group_name, timeout=120.0)
         self.active_collective_group = group_name
-        if self.backend.init_jax_distributed:
+        if getattr(self.backend, "tf_config", False):
+            # every rank needs its OWN serving address (tf multi-worker),
+            # gathered with the rank-ordered parallel fan-out
+            addrs = self.group.execute("make_coordinator", timeout=120.0)
+            self.group.execute("set_tf_config", addrs, timeout=120.0)
+        if getattr(self.backend, "torch_backend", None):
+            # the dist.init_process_group moment for torch gangs
+            self.group.execute(
+                "init_torch_distributed", self.backend.torch_backend,
+                timeout=300.0,
+            )
+        if getattr(self.backend, "init_jax_distributed", False):
             # every rank joins the jax.distributed world NOW (before any
             # other jax call in the worker) — the init_process_group moment
             self.group.execute(
                 "init_jax_distributed",
-                self.backend.local_device_count,
+                getattr(self.backend, "local_device_count", None),
                 timeout=300.0,
             )
 

@@ -1,0 +1,38 @@
+"""TensorflowTrainer: MultiWorkerMirroredStrategy over ray_tpu gangs.
+
+Reference surface: python/ray/train/tensorflow/tensorflow_trainer.py +
+train/tensorflow/train_loop_utils.py (prepare_dataset_shard). The gang
+executor exports TF_CONFIG (all ranks' addresses + own index) before the
+loop runs; the user constructs ``tf.distribute.MultiWorkerMirroredStrategy``
+inside ``train_loop_per_worker`` exactly as with the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ray_tpu.train.backend_executor import TensorflowConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose gang carries TF_CONFIG for
+    MultiWorkerMirroredStrategy (the TensorflowTrainer counterpart of
+    JaxTrainer/TorchTrainer)."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        kwargs.setdefault("backend_config", TensorflowConfig())
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+def prepare_dataset_shard(dataset):
+    """Disable tf.data auto-sharding for a dataset that is ALREADY a
+    per-worker shard (reference: train/tensorflow/train_loop_utils.py) —
+    MultiWorkerMirrored would otherwise re-shard it by worker count."""
+    import tensorflow as tf
+
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.OFF
+    )
+    return dataset.with_options(options)

@@ -1,0 +1,100 @@
+"""TorchTrainer: torch-DDP training over ray_tpu worker gangs.
+
+Reference surface: python/ray/train/torch/torch_trainer.py (+ train/torch/
+train_loop_utils.py prepare_model/prepare_data_loader/get_device). The
+framework is TPU-first — JaxTrainer is the flagship — but torch-cpu ships
+in the image and the reference's dominant trainer is torch, so migration
+parity demands the same loop contract: the user's ``train_loop_per_worker``
+calls ``prepare_model`` to wrap DDP over the gang's gloo process group and
+reports through the same session as every other trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ray_tpu.train.backend_executor import TorchConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer whose gang runs a torch.distributed (gloo)
+    process group; the TorchTrainer counterpart of JaxTrainer."""
+
+    def __init__(self, train_loop_per_worker: Callable, **kwargs):
+        kwargs.setdefault("backend_config", TorchConfig())
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+def get_device():
+    """The rank's torch device (reference: train/torch/train_loop_utils.py
+    get_device). CPU workers return cpu; a CUDA host returns the worker's
+    LOCAL rank's device (TrainWorker exports LOCAL_RANK; one worker per
+    host in this framework's gangs, so it is the per-host index)."""
+    import torch
+
+    if torch.cuda.is_available():  # pragma: no cover - no GPUs in image
+        import os
+
+        local = os.environ.get(
+            "LOCAL_RANK", os.environ.get("RAYTPU_TRAIN_LOCAL_RANK", "0")
+        )
+        return torch.device("cuda", int(local))
+    return torch.device("cpu")
+
+
+def prepare_model(model, *, ddp: Optional[bool] = None):
+    """Move the model to the rank's device and wrap DistributedDataParallel
+    when the gang spans >1 rank (reference: train_loop_utils.py
+    prepare_model)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    model = model.to(get_device())
+    wrap = ddp if ddp is not None else (
+        dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1
+    )
+    if wrap:
+        model = DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Shard a DataLoader across ranks with a DistributedSampler
+    (reference: train_loop_utils.py prepare_data_loader), preserving the
+    loader's own settings: shuffle carries over (inferred from the
+    original sampler — a DataLoader(shuffle=False) stays ordered so eval
+    predictions align), as do num_workers/pin_memory/collate/drop_last.
+    Loaders built with a custom batch_sampler can't be re-sharded
+    faithfully and pass through unchanged with a warning."""
+    import logging
+
+    import torch.distributed as dist
+    from torch.utils.data import (
+        DataLoader,
+        DistributedSampler,
+        RandomSampler,
+    )
+
+    if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
+        return data_loader
+    if data_loader.batch_size is None:
+        logging.getLogger(__name__).warning(
+            "prepare_data_loader: custom batch_sampler loaders cannot be "
+            "re-sharded; returning the loader unchanged (shard the dataset "
+            "yourself or use batch_size=)"
+        )
+        return data_loader
+    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=data_loader.num_workers,
+        collate_fn=data_loader.collate_fn,
+        pin_memory=data_loader.pin_memory,
+        drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
+        worker_init_fn=data_loader.worker_init_fn,
+    )

@@ -30,6 +30,10 @@ class TrainWorker:
         self.rank = rank
         os.environ["RAYTPU_TRAIN_WORLD_SIZE"] = str(world_size)
         os.environ["RAYTPU_TRAIN_RANK"] = str(rank)
+        # one gang worker per host in this framework, so local rank is 0;
+        # torch get_device and tooling read the standard LOCAL_RANK name
+        os.environ["RAYTPU_TRAIN_LOCAL_RANK"] = "0"
+        os.environ.setdefault("LOCAL_RANK", "0")
         for k, v in (coordinator or {}).items():
             os.environ[k] = str(v)
         self._session = None
@@ -118,6 +122,46 @@ class TrainWorker:
             return train_fn(config or {}) if params else train_fn()
         finally:
             self._session.finished.set()
+
+    def init_torch_distributed(self, backend: str = "gloo") -> bool:
+        """torch.distributed bring-up over the gang's coordinator
+        (reference: train/torch/config.py _setup_torch_process_group):
+        rank 0's host:port becomes the TCP rendezvous; gloo rides CPU
+        workers, nccl would ride GPU hosts. Must precede any collective
+        in the user loop."""
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            return True
+        address = os.environ["RAYTPU_COORDINATOR_ADDRESS"]
+        host, _, port = address.rpartition(":")
+        os.environ["MASTER_ADDR"] = host
+        os.environ["MASTER_PORT"] = port
+        dist.init_process_group(
+            backend,
+            init_method=f"tcp://{address}",
+            rank=self.rank,
+            world_size=self.world_size,
+        )
+        return True
+
+    def set_tf_config(self, worker_addresses: List[str]) -> bool:
+        """Export TF_CONFIG for MultiWorkerMirroredStrategy (reference:
+        train/tensorflow/config.py _setup_tensorflow_environment): the full
+        worker list plus this rank's index. Must precede the tf import in
+        the user loop. The per-rank ports are probe-then-release (same
+        scheme as the reference's get_free_port): a small window exists
+        between probing and the strategy's gRPC bind — collisions surface
+        as a bind error and a retried fit()."""
+        import json as _json
+
+        os.environ["TF_CONFIG"] = _json.dumps(
+            {
+                "cluster": {"worker": list(worker_addresses)},
+                "task": {"type": "worker", "index": self.rank},
+            }
+        )
+        return True
 
     def poll_reports(self, start: int) -> List[Dict[str, Any]]:
         s = self._session

@@ -1,0 +1,78 @@
+"""TensorflowTrainer: MultiWorkerMirroredStrategy over ray_tpu gangs.
+
+(reference surface: python/ray/train/tests/test_tensorflow_trainer.py —
+multi-worker synchronized keras training through TF_CONFIG.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import RunConfig, ScalingConfig, TensorflowTrainer
+
+
+def test_tensorflow_trainer_multiworker(ray_start_regular, tmp_path):
+    """Two ranks form a MultiWorkerMirroredStrategy cluster from TF_CONFIG;
+    synchronized training descends the loss; replica count checks out."""
+
+    def loop(config):
+        import json
+        import os
+
+        import tensorflow as tf
+
+        from ray_tpu import train
+
+        tf_config = json.loads(os.environ["TF_CONFIG"])
+        assert len(tf_config["cluster"]["worker"]) == 2
+        assert tf_config["task"]["index"] == train.get_world_rank()
+
+        strategy = tf.distribute.MultiWorkerMirroredStrategy()
+        assert strategy.num_replicas_in_sync == 2
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(128, 4)).astype(np.float32)
+        y = (X @ np.asarray([[1.0], [-2.0], [3.0], [0.5]], np.float32)).astype(
+            np.float32
+        )
+        # keras 3 dropped model.fit-over-MWMS: use the tf.distribute custom
+        # loop (strategy.run + gradient tape), which is version-stable
+        with strategy.scope():
+            w = tf.Variable(tf.zeros((4, 1)))
+            b = tf.Variable(tf.zeros((1,)))
+            opt = tf.keras.optimizers.SGD(0.1)
+
+        ds = tf.data.Dataset.from_tensor_slices((X, y)).batch(32)
+        dist_ds = strategy.experimental_distribute_dataset(ds)
+
+        @tf.function
+        def step(batch):
+            bx, by = batch
+
+            def replica_step(bx, by):
+                with tf.GradientTape() as tape:
+                    pred = bx @ w + b
+                    loss = tf.reduce_mean((pred - by) ** 2)
+                grads = tape.gradient(loss, [w, b])
+                opt.apply_gradients(zip(grads, [w, b]))
+                return loss
+
+            per_replica = strategy.run(replica_step, args=(bx, by))
+            return strategy.reduce(
+                tf.distribute.ReduceOp.MEAN, per_replica, axis=None
+            )
+
+        losses = []
+        for _epoch in range(8):
+            epoch_losses = [float(step(batch)) for batch in dist_ds]
+            losses.append(float(np.mean(epoch_losses)))
+        train.report({"first_loss": losses[0], "last_loss": losses[-1]})
+
+    trainer = TensorflowTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["last_loss"] < 0.2 * result.metrics["first_loss"]
