@@ -6,6 +6,7 @@ _private/updater.py + command_runner.py (node bootstrap).
 """
 
 from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, StandardAutoscaler
+from ray_tpu.autoscaler.aws import AwsEc2NodeProvider, Ec2Api
 from ray_tpu.autoscaler.command_runner import (
     CommandRunner,
     CommandRunnerError,
@@ -23,6 +24,8 @@ from ray_tpu.autoscaler.updater import BootstrappingNodeProvider, NodeUpdater
 
 __all__ = [
     "AutoscalerConfig",
+    "AwsEc2NodeProvider",
+    "Ec2Api",
     "BootstrappingNodeProvider",
     "CommandRunner",
     "CommandRunnerError",

@@ -366,9 +366,25 @@ class Dataset:
         return None
 
     def stats(self) -> str:
+        """Per-op wall times + materialized totals (reference:
+        data/_internal/stats.py DatasetStats summary — op table with
+        wall time and output rows/bytes)."""
+        total_ms = sum(dt for _, dt in self._stats) * 1000
         lines = [f"Dataset({self.num_blocks()} blocks)"]
         for op, dt in self._stats:
-            lines.append(f"  {op}: {dt * 1000:.1f}ms")
+            share = (dt * 1000 / total_ms * 100) if total_ms else 0.0
+            lines.append(f"  {op}: {dt * 1000:.1f}ms ({share:.0f}%)")
+        try:
+            self._fetch_metas()
+            rows = sum(m.num_rows for m in self._metas if m is not None)
+            size = sum(m.size_bytes for m in self._metas if m is not None)
+            lines.append(
+                f"  output: {rows} rows, {size / 1e6:.2f} MB over "
+                f"{self.num_blocks()} blocks "
+                f"(mean {rows / max(self.num_blocks(), 1):.0f} rows/block)"
+            )
+        except Exception:
+            pass  # metas unavailable mid-teardown: times alone still help
         return "\n".join(lines)
 
     def __repr__(self) -> str:

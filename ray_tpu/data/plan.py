@@ -248,11 +248,22 @@ class LazyDataset:
         )
 
     def materialize(self) -> Dataset:
+        t0 = time.perf_counter()
         blocks, metas = [], []
         for blk_ref, meta_ref in self._stream():
             blocks.append(blk_ref)
             metas.append(meta_ref)
-        return Dataset(blocks, metas)
+        # the fused chain is ONE op from the stats' point of view
+        fused = "+".join(op.name for op in self._ops) or "scan"
+        return Dataset(
+            blocks, metas, [(f"fused({fused})", time.perf_counter() - t0)]
+        )
+
+    def stats(self) -> str:
+        """Plan + executed stats: the logical chain, its physical fusion,
+        then the materialized per-op table (reference: DatasetStats for
+        streaming plans)."""
+        return self.explain() + "\n" + self._ensure_materialized().stats()
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",

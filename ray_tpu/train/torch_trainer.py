@@ -59,14 +59,42 @@ def prepare_model(model, *, ddp: Optional[bool] = None):
     return model
 
 
+class _EpochAdvancingLoader:
+    """DataLoader wrapper that bumps DistributedSampler.set_epoch on every
+    __iter__ — without it, torch reuses seed+epoch=0 and a shuffled loader
+    yields the SAME permutation every epoch (the reference's wrapper
+    advances the epoch the same way)."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
 def prepare_data_loader(data_loader):
     """Shard a DataLoader across ranks with a DistributedSampler
     (reference: train_loop_utils.py prepare_data_loader), preserving the
-    loader's own settings: shuffle carries over (inferred from the
-    original sampler — a DataLoader(shuffle=False) stays ordered so eval
-    predictions align), as do num_workers/pin_memory/collate/drop_last.
-    Loaders built with a custom batch_sampler can't be re-sharded
-    faithfully and pass through unchanged with a warning."""
+    loader's settings: shuffle carries over (inferred from the original
+    sampler — DataLoader(shuffle=False) stays ordered so eval predictions
+    align), as do num_workers/pin_memory/collate/drop_last/generator/
+    persistent_workers/prefetch_factor; the returned loader advances the
+    sampler epoch per iteration so shuffles differ between epochs.
+
+    Loaders this can't re-shard faithfully pass through UNCHANGED with a
+    warning: custom batch_samplers, and custom samplers (Subset/Weighted/
+    user-defined) whose row selection a DistributedSampler would silently
+    override."""
     import logging
 
     import torch.distributed as dist
@@ -74,20 +102,35 @@ def prepare_data_loader(data_loader):
         DataLoader,
         DistributedSampler,
         RandomSampler,
+        SequentialSampler,
     )
 
+    log = logging.getLogger(__name__)
     if not (dist.is_available() and dist.is_initialized() and dist.get_world_size() > 1):
         return data_loader
-    if data_loader.batch_size is None:
-        logging.getLogger(__name__).warning(
+    if data_loader.batch_size is None and data_loader.batch_sampler is not None:
+        log.warning(
             "prepare_data_loader: custom batch_sampler loaders cannot be "
             "re-sharded; returning the loader unchanged (shard the dataset "
             "yourself or use batch_size=)"
         )
         return data_loader
+    if not isinstance(data_loader.sampler, (RandomSampler, SequentialSampler)):
+        log.warning(
+            "prepare_data_loader: loader uses a custom sampler (%s) whose "
+            "row selection a DistributedSampler would override; returning "
+            "unchanged — shard inside your sampler or pre-split the dataset",
+            type(data_loader.sampler).__name__,
+        )
+        return data_loader
     shuffle = isinstance(data_loader.sampler, RandomSampler)
     sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
-    return DataLoader(
+    extra = {}
+    if data_loader.num_workers > 0:
+        # only valid alongside worker processes
+        extra["prefetch_factor"] = data_loader.prefetch_factor
+        extra["persistent_workers"] = data_loader.persistent_workers
+    loader = DataLoader(
         data_loader.dataset,
         batch_size=data_loader.batch_size,
         sampler=sampler,
@@ -97,4 +140,7 @@ def prepare_data_loader(data_loader):
         drop_last=data_loader.drop_last,
         timeout=data_loader.timeout,
         worker_init_fn=data_loader.worker_init_fn,
+        generator=data_loader.generator,
+        **extra,
     )
+    return _EpochAdvancingLoader(loader, sampler)

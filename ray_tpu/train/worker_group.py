@@ -134,9 +134,6 @@ class TrainWorker:
         if dist.is_initialized():
             return True
         address = os.environ["RAYTPU_COORDINATOR_ADDRESS"]
-        host, _, port = address.rpartition(":")
-        os.environ["MASTER_ADDR"] = host
-        os.environ["MASTER_PORT"] = port
         dist.init_process_group(
             backend,
             init_method=f"tcp://{address}",

@@ -1,0 +1,118 @@
+"""AWS EC2 provider: mock-driven lifecycle (reference:
+python/ray/tests/test_autoscaler_aws.py style — the provider's state
+machine against canned EC2 JSON shapes; no boto3/egress here)."""
+
+import pytest
+
+from ray_tpu.autoscaler import AwsEc2NodeProvider, Ec2Api
+
+
+class MockEc2(Ec2Api):
+    """Replays EC2's instance JSON shapes; instances advance
+    pending->running after `settle_polls` describe calls."""
+
+    def __init__(self, settle_polls=2):
+        self.instances = {}
+        self.counter = 0
+        self.describe_calls = 0
+        self.settle_polls = settle_polls
+        self.terminated = []
+
+    def run_instances(self, image_id, instance_type, count, tags):
+        out = []
+        for _ in range(count):
+            self.counter += 1
+            iid = f"i-{self.counter:08x}"
+            inst = {
+                "InstanceId": iid,
+                "State": {"Name": "pending"},
+                "PrivateIpAddress": f"10.0.0.{self.counter}",
+                "Tags": list(tags),
+                "_born_at": self.describe_calls,
+            }
+            self.instances[iid] = inst
+            out.append(dict(inst))
+        return out
+
+    def terminate_instances(self, instance_ids):
+        self.terminated.extend(instance_ids)
+        for iid in instance_ids:
+            if iid in self.instances:
+                self.instances[iid]["State"] = {"Name": "terminated"}
+
+    def describe_instances(self, filters):
+        self.describe_calls += 1
+        assert filters[0]["Name"] == "tag:raytpu-cluster-name"
+        cluster = filters[0]["Values"][0]
+        out = []
+        for inst in self.instances.values():
+            if not any(
+                t["Key"] == "raytpu-cluster-name" and t["Value"] == cluster
+                for t in inst["Tags"]
+            ):
+                continue
+            if (
+                inst["State"]["Name"] == "pending"
+                and self.describe_calls - inst["_born_at"] >= self.settle_polls
+            ):
+                inst["State"] = {"Name": "running"}
+            out.append({k: v for k, v in inst.items() if k != "_born_at"})
+        return out
+
+
+def test_ec2_create_waits_for_running():
+    api = MockEc2(settle_polls=2)
+    p = AwsEc2NodeProvider(
+        "clusterA", image_id="ami-123", api=api, poll_interval_s=0.01
+    )
+    ids = p.create_nodes(2)
+    assert len(ids) == 2
+    assert sorted(p.non_terminated_nodes()) == sorted(ids)
+    assert p.node_ip(ids[0]).startswith("10.0.0.")
+    assert p.node_resources()["CPU"] == 16.0
+
+
+def test_ec2_terminate_and_reconcile():
+    api = MockEc2(settle_polls=0)
+    p = AwsEc2NodeProvider(
+        "clusterB", image_id="ami-123", api=api, poll_interval_s=0.01
+    )
+    ids = p.create_nodes(3)
+    p.terminate_node(ids[0])
+    assert api.terminated == [ids[0]]
+    assert sorted(p.non_terminated_nodes()) == sorted(ids[1:])
+    # out-of-band termination disappears on reconcile
+    api.terminate_instances([ids[1]])
+    assert p.non_terminated_nodes() == [ids[2]]
+
+
+def test_ec2_cluster_tag_isolation():
+    api = MockEc2(settle_polls=0)
+    pa = AwsEc2NodeProvider("clusA", image_id="ami-1", api=api, poll_interval_s=0.01)
+    pb = AwsEc2NodeProvider("clusB", image_id="ami-1", api=api, poll_interval_s=0.01)
+    a = pa.create_nodes(1)
+    b = pb.create_nodes(2)
+    assert pa.non_terminated_nodes() == a
+    assert sorted(pb.non_terminated_nodes()) == sorted(b)
+
+
+def test_ec2_provision_failure_raises():
+    class DyingEc2(MockEc2):
+        def describe_instances(self, filters):
+            out = super().describe_instances(filters)
+            for inst in out:
+                inst["State"] = {"Name": "terminated"}
+            for inst in self.instances.values():
+                inst["State"] = {"Name": "terminated"}
+            return out
+
+    p = AwsEc2NodeProvider(
+        "clusterC", image_id="ami-bad", api=DyingEc2(), poll_interval_s=0.01
+    )
+    with pytest.raises(RuntimeError, match="died during provisioning"):
+        p.create_nodes(1)
+
+
+def test_ec2_requires_injected_client():
+    with pytest.raises(ValueError, match="Ec2Api"):
+        AwsEc2NodeProvider("c", image_id="ami-1")

@@ -322,3 +322,16 @@ def test_groupby_std_aggregate_and_unique(ray_start_regular):
     assert out[1]["total"] == 1 + 3 + 5 + 7 + 9
     assert out[0]["spread"] > 0
     assert ds.unique("k") == [0, 1]
+
+
+def test_dataset_stats_per_op(ray_start_regular):
+    """stats() reports per-op wall times with shares and output totals
+    (reference: data/_internal/stats.py summary table)."""
+    ds = rd.range(100, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 2}, batch_size=50
+    )
+    out = ds.stats()
+    assert "map_batches" in out
+    assert "ms" in out and "%" in out
+    assert "100 rows" in out
+    assert "blocks" in out

@@ -76,6 +76,10 @@ def test_streaming_ndjson_response(serve_cluster):
 
     serve.run(tokens.bind(), name="tokens")
     proxy = serve.start_http_proxy()
+    # pre-warm the replica + route (first request under full-suite load can
+    # pay worker cold-start; the streaming path should measure streaming)
+    h = serve.get_deployment_handle("tokens")
+    ray_tpu.get(h.stream(1).ref, timeout=60)
     status, body = _post(f"{proxy.address}/tokens/stream", 5)
     assert status == 200
     lines = [json.loads(l) for l in body.decode().strip().splitlines()]
