@@ -938,6 +938,40 @@ class Dataset:
         (ray_tpu/data/tfrecord.py); read_tfrecords round-trips it."""
         return self._write(path, "tfrecords")
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device=None, **kw):
+        """iter_batches with torch tensor conversion (reference:
+        data/iterator.py iter_torch_batches): each numpy column becomes a
+        torch tensor, optionally cast/moved."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            out = {}
+            for name, col in batch.items():
+                arr = np.asarray(col)
+                if not arr.flags.writeable:
+                    # block-backed arrays are read-only views; torch would
+                    # alias them and in-place ops on the yielded tensor
+                    # would be undefined behavior on shared buffers
+                    arr = arr.copy()
+                t = torch.as_tensor(arr)
+                want = None
+                if dtypes is not None:
+                    want = dtypes.get(name) if isinstance(dtypes, dict) else dtypes
+                if want is not None or device is not None:
+                    t = t.to(device=device, dtype=want)
+                out[name] = t
+            yield out
+
+    def iter_tf_batches(self, *, batch_size: Optional[int] = 256, **kw):
+        """iter_batches as tf tensors (reference: iter_tf_batches)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            yield {k: tf.convert_to_tensor(v) for k, v in batch.items()}
+
     def to_jax(self, *, columns: Optional[List[str]] = None, device=None):
         """Materialize as a dict of jax.Arrays (device_put once over the
         gathered columns — the inverse of read_api.from_jax)."""

@@ -33,6 +33,7 @@ from ray_tpu.tune.search import (
 )
 from ray_tpu.tune.progress import ProgressReporter
 from ray_tpu.tune.tuner import (
+    run,
     ResultGrid,
     Trial,
     TuneConfig,
@@ -59,6 +60,7 @@ __all__ = [
     "PB2",
     "PopulationBasedTraining",
     "Repeater",
+    "run",
     "ProgressReporter",
     "Searcher",
     "ResultGrid",

@@ -528,3 +528,42 @@ def with_parameters(fn: Callable, **heavy_kwargs) -> Callable:
         return fn(config, **resolved)
 
     return wrapped
+
+
+def run(
+    trainable: Any,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    metric: Optional[str] = None,
+    mode: str = "max",
+    num_samples: int = 1,
+    scheduler: Any = None,
+    search_alg: Any = None,
+    name: Optional[str] = None,
+    storage_path: Optional[str] = None,
+    max_concurrent_trials: Optional[int] = None,
+    **tune_config_kwargs,
+) -> "ResultGrid":
+    """The classic ``tune.run`` entry point (reference: tune/tune.py run —
+    the pre-Tuner API the reference still ships for migration). A thin
+    composition over :class:`Tuner`; ``config`` is the param space."""
+    tc = TuneConfig(
+        metric=metric,
+        mode=mode,
+        num_samples=num_samples,
+        scheduler=scheduler,
+        search_alg=search_alg,
+        max_concurrent_trials=max_concurrent_trials,
+        **tune_config_kwargs,
+    )
+    rc_kwargs = {}
+    if name is not None:
+        rc_kwargs["name"] = name
+    if storage_path is not None:
+        rc_kwargs["storage_path"] = storage_path
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=tc,
+        run_config=RunConfig(**rc_kwargs),
+    ).fit()

@@ -246,3 +246,32 @@ def test_read_webdataset(ray_start_regular, tmp_path):
     assert r["txt"] == "caption 2"
     img = np.asarray(r["png"])
     assert img.shape == (6, 5, 3)
+
+
+def test_iter_torch_and_tf_batches(ray_start_regular):
+    """Framework-tensor iteration (reference: iter_torch_batches /
+    iter_tf_batches): numpy columns arrive as torch/tf tensors with
+    shapes and dtype casts intact."""
+    torch = pytest.importorskip("torch")
+
+    ds = rd.from_numpy(
+        {"x": np.arange(12, dtype=np.float64).reshape(6, 2),
+         "y": np.arange(6)},
+        parallelism=2,
+    )
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=4,
+                                       dtypes={"x": torch.float32}):
+        assert isinstance(batch["x"], torch.Tensor)
+        assert batch["x"].dtype == torch.float32
+        assert batch["x"].shape[1] == 2
+        seen += len(batch["y"])
+    assert seen == 6
+
+    tf = pytest.importorskip("tensorflow")
+
+    total = 0
+    for batch in ds.iter_tf_batches(batch_size=3):
+        assert isinstance(batch["x"], tf.Tensor)
+        total += int(batch["y"].shape[0])
+    assert total == 6

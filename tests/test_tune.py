@@ -590,3 +590,24 @@ def test_bayesopt_mixed_space_and_exhaustion():
         assert cfg["act"] in ("relu", "tanh")
     with pytest.raises(ValueError, match="grid_search"):
         tune.BayesOptSearcher({"x": tune.grid_search([1, 2])}, metric="m")
+
+
+def test_tune_run_classic_api(ray_start_regular, tmp_path):
+    """The pre-Tuner tune.run entry point (reference: tune/tune.py run)."""
+
+    def objective(config):
+        tune.report({"score": -(config["x"] - 0.5) ** 2})
+
+    grid = tune.run(
+        objective,
+        config={"x": tune.grid_search([0.0, 0.5, 1.0])},
+        metric="score",
+        mode="max",
+        storage_path=str(tmp_path),
+        name="classic",
+    )
+    assert len(grid) == 3 and not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["score"] == pytest.approx(0.0)
+    winner = [t for t in grid.trials if t.config["x"] == 0.5]
+    assert winner and winner[0].last_result["score"] == pytest.approx(0.0)
