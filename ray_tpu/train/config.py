@@ -55,6 +55,10 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    # stopping criteria for Tune experiments (reference: air.RunConfig.stop):
+    # dict {metric: threshold} | callable(trial_id, result) -> bool |
+    # ray_tpu.tune.Stopper instance
+    stop: Any = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -32,6 +32,16 @@ from ray_tpu.tune.search import (
     uniform,
 )
 from ray_tpu.tune.progress import ProgressReporter
+from ray_tpu.tune.stopper import (
+    CombinedStopper,
+    ExperimentPlateauStopper,
+    FunctionStopper,
+    MaximumIterationStopper,
+    MetricThresholdStopper,
+    Stopper,
+    TimeoutStopper,
+    TrialPlateauStopper,
+)
 from ray_tpu.tune.tuner import (
     run,
     ResultGrid,
@@ -59,7 +69,15 @@ __all__ = [
     "MedianStoppingRule",
     "PB2",
     "PopulationBasedTraining",
+    "CombinedStopper",
+    "ExperimentPlateauStopper",
+    "FunctionStopper",
+    "MaximumIterationStopper",
+    "MetricThresholdStopper",
     "Repeater",
+    "Stopper",
+    "TimeoutStopper",
+    "TrialPlateauStopper",
     "run",
     "ProgressReporter",
     "Searcher",

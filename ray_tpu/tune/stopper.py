@@ -1,0 +1,186 @@
+"""Stoppers: declarative trial/experiment stopping criteria.
+
+Reference: python/ray/tune/stopper/ (Stopper ABC with __call__ per result
++ stop_all; MaximumIterationStopper, TrialPlateauStopper,
+ExperimentPlateauStopper, TimeoutStopper, FunctionStopper,
+CombinedStopper). Wired through ``RunConfig(stop=...)``: a dict means
+"stop the trial when result[key] >= value" (the reference's classic
+``stop={"training_iteration": 100}`` shape), a callable wraps as
+FunctionStopper, a Stopper instance is used as-is.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class Stopper:
+    def __call__(self, trial_id: str, result: Dict[str, Any]) -> bool:
+        """True -> stop THIS trial."""
+        raise NotImplementedError
+
+    def stop_all(self) -> bool:
+        """True -> stop the whole experiment (no new launches; running
+        trials stop at their next report)."""
+        return False
+
+
+class MaximumIterationStopper(Stopper):
+    """Stop each trial at ``max_iter`` training iterations. Reads
+    ``result["training_iteration"]`` (the tuner synthesizes it), so counts
+    survive pause/resume replays; falls back to an invocation counter for
+    results without the field."""
+
+    def __init__(self, max_iter: int):
+        self.max_iter = max_iter
+        self._count: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        it = result.get("training_iteration")
+        if it is None:
+            self._count[trial_id] += 1
+            it = self._count[trial_id]
+        return it >= self.max_iter
+
+
+class FunctionStopper(Stopper):
+    """Wrap ``fn(trial_id, result) -> bool``."""
+
+    def __init__(self, fn: Callable[[str, Dict[str, Any]], bool]):
+        self.fn = fn
+
+    def __call__(self, trial_id, result):
+        return bool(self.fn(trial_id, result))
+
+
+class MetricThresholdStopper(Stopper):
+    """The classic dict form: stop a trial when ANY named metric reaches
+    its threshold (always >=, independent of optimization mode — matching
+    the reference's ``stop={"training_iteration": 100, "acc": 0.99}``
+    whichever-first semantics)."""
+
+    def __init__(self, thresholds: Dict[str, float]):
+        self.thresholds = dict(thresholds)
+
+    def __call__(self, trial_id, result):
+        for key, bound in self.thresholds.items():
+            value = result.get(key)
+            if value is not None and value >= bound:
+                return True
+        return False
+
+
+class TrialPlateauStopper(Stopper):
+    """Stop a trial whose metric stopped moving: the last ``num_results``
+    values span less than ``std`` (reference: stopper/trial_plateau.py)."""
+
+    def __init__(self, metric: str, *, std: float = 0.01, num_results: int = 4,
+                 grace_period: int = 4):
+        self.metric = metric
+        self.std = std
+        self.num_results = num_results
+        self.grace_period = grace_period
+        self._window: Dict[str, collections.deque] = {}
+        self._seen: Dict[str, int] = collections.defaultdict(int)
+
+    def __call__(self, trial_id, result):
+        value = result.get(self.metric)
+        if value is None:
+            return False
+        self._seen[trial_id] += 1
+        window = self._window.setdefault(
+            trial_id, collections.deque(maxlen=self.num_results)
+        )
+        window.append(float(value))
+        if self._seen[trial_id] < self.grace_period or len(window) < self.num_results:
+            return False
+        import statistics
+
+        return statistics.pstdev(window) < self.std
+
+
+class ExperimentPlateauStopper(Stopper):
+    """Stop the whole experiment when the best seen metric stops improving
+    for ``patience`` consecutive results (reference:
+    stopper/experiment_plateau.py)."""
+
+    def __init__(self, metric: str, *, mode: str = "max", top: int = 10,
+                 std: float = 0.001, patience: int = 0):
+        self.metric = metric
+        self.mode = mode
+        self.top = top
+        self.std = std
+        self.patience = patience
+        self._tops: list = []
+        self._stale = 0
+        self._stop_all = False
+
+    def __call__(self, trial_id, result):
+        value = result.get(self.metric)
+        if value is None:
+            return self._stop_all
+        value = float(value)
+        sign = 1.0 if self.mode == "max" else -1.0
+        self._tops.append(sign * value)
+        self._tops = sorted(self._tops, reverse=True)[: self.top]
+        import statistics
+
+        if len(self._tops) == self.top and statistics.pstdev(self._tops) < self.std:
+            self._stale += 1
+        else:
+            self._stale = 0
+        if self._stale > self.patience:
+            self._stop_all = True
+        return self._stop_all
+
+    def stop_all(self):
+        return self._stop_all
+
+
+class TimeoutStopper(Stopper):
+    """Stop the experiment after a wall-clock budget. The clock starts at
+    the FIRST consultation (i.e. when fit() begins), not at construction —
+    setup time before the experiment must not consume the budget."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self._t0: Optional[float] = None
+
+    def _elapsed(self) -> float:
+        if self._t0 is None:
+            self._t0 = time.monotonic()
+        return time.monotonic() - self._t0
+
+    def __call__(self, trial_id, result):
+        return self.stop_all()
+
+    def stop_all(self):
+        return self._elapsed() > self.timeout_s
+
+
+class CombinedStopper(Stopper):
+    """OR-composition of stoppers."""
+
+    def __init__(self, *stoppers: Stopper):
+        self.stoppers = list(stoppers)
+
+    def __call__(self, trial_id, result):
+        return any(s(trial_id, result) for s in self.stoppers)
+
+    def stop_all(self):
+        return any(s.stop_all() for s in self.stoppers)
+
+
+def resolve_stopper(stop: Any) -> Optional[Stopper]:
+    """RunConfig.stop -> Stopper (dict/callable/instance/None)."""
+    if stop is None:
+        return None
+    if isinstance(stop, Stopper):
+        return stop
+    if isinstance(stop, dict):
+        return MetricThresholdStopper(stop)
+    if callable(stop):
+        return FunctionStopper(stop)
+    raise TypeError(f"stop must be a dict, callable, or Stopper; got {stop!r}")

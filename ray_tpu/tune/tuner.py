@@ -394,6 +394,8 @@ class Tuner:
                 d = scheduler.on_result(trial.trial_id, metrics)
                 if d != sched_mod.CONTINUE:
                     return d
+                if stopper is not None and stopper(trial.trial_id, metrics):
+                    return sched_mod.STOP
             return None
 
         resume_queue: List[str] = []
@@ -420,8 +422,21 @@ class Tuner:
                 if tid in paused:
                     _resume(paused.pop(tid))
 
+        from ray_tpu.tune.stopper import resolve_stopper
+
+        stopper = resolve_stopper(getattr(self.run_config, "stop", None))
+
         search_done = searcher is None
         while pending or running or paused or not search_done:
+            if stopper is not None and stopper.stop_all():
+                # experiment-wide stop: cease launches, finalize parked
+                # trials (the anti-deadlock path would otherwise RESUME
+                # them after the budget is spent); running trials stop at
+                # their next report
+                search_done = True
+                pending.clear()
+                for tid in list(paused):
+                    _finalize(paused.pop(tid), None, early=True)
             # top up from the search algorithm (lazy suggestion)
             while not search_done and len(running) + len(pending) < limit:
                 t = _suggest_trial()
@@ -539,6 +554,7 @@ def run(
     num_samples: int = 1,
     scheduler: Any = None,
     search_alg: Any = None,
+    stop: Any = None,
     name: Optional[str] = None,
     storage_path: Optional[str] = None,
     max_concurrent_trials: Optional[int] = None,
@@ -561,6 +577,8 @@ def run(
         rc_kwargs["name"] = name
     if storage_path is not None:
         rc_kwargs["storage_path"] = storage_path
+    if stop is not None:
+        rc_kwargs["stop"] = stop
     return Tuner(
         trainable,
         param_space=config,

@@ -603,6 +603,7 @@ def test_tune_run_classic_api(ray_start_regular, tmp_path):
         config={"x": tune.grid_search([0.0, 0.5, 1.0])},
         metric="score",
         mode="max",
+        stop={"training_iteration": 50},
         storage_path=str(tmp_path),
         name="classic",
     )
@@ -611,3 +612,60 @@ def test_tune_run_classic_api(ray_start_regular, tmp_path):
     assert best.metrics["score"] == pytest.approx(0.0)
     winner = [t for t in grid.trials if t.config["x"] == 0.5]
     assert winner and winner[0].last_result["score"] == pytest.approx(0.0)
+
+
+def test_stopper_units():
+    """Stopper classes (reference: tune/stopper/): iteration cap, plateau
+    detection, threshold dict resolution, OR-composition."""
+    from ray_tpu.tune.stopper import (
+        CombinedStopper,
+        MaximumIterationStopper,
+        MetricThresholdStopper,
+        TrialPlateauStopper,
+        resolve_stopper,
+    )
+
+    s = MaximumIterationStopper(3)
+    assert [s("t", {})for _ in range(4)] == [False, False, True, True]
+
+    p = TrialPlateauStopper("loss", std=0.01, num_results=3, grace_period=3)
+    flat = [p("t", {"loss": 1.0}) for _ in range(5)]
+    assert flat[-1] is True and flat[0] is False
+    moving = TrialPlateauStopper("loss", std=0.01, num_results=3, grace_period=3)
+    assert not any(moving("t", {"loss": float(i)}) for i in range(6))
+
+    d = resolve_stopper({"score": 10.0})
+    assert isinstance(d, MetricThresholdStopper)
+    assert not d("t", {"score": 5})
+    assert d("t", {"score": 10})
+
+    c = CombinedStopper(MaximumIterationStopper(2), MetricThresholdStopper({"s": 1}))
+    assert c("t", {"s": 5})  # threshold fires first
+    # classic dict semantics: ANY key reaching its bound stops (>= always)
+    multi = MetricThresholdStopper({"training_iteration": 100, "acc": 0.99})
+    assert multi("t", {"training_iteration": 100, "acc": 0.1})
+    assert multi("t", {"training_iteration": 3, "acc": 0.995})
+    assert not multi("t", {"training_iteration": 3, "acc": 0.5})
+
+
+def test_run_config_stop_ends_trials(ray_start_regular, tmp_path):
+    """RunConfig(stop={...}) stops each trial at the threshold instead of
+    letting it run its full loop (reference: air.RunConfig.stop)."""
+
+    def objective(config):
+        for step in range(50):
+            tune.report({"score": float(step), "training_iteration": step + 1})
+
+    grid = Tuner(
+        objective,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=ray_tpu.train.RunConfig(
+            storage_path=str(tmp_path), name="stopd",
+            stop={"score": 5.0},
+        ),
+    ).fit()
+    assert not grid.errors
+    for t in grid.trials:
+        # stopped well before the 50-step loop finished
+        assert t.last_result["score"] < 15, t.last_result
