@@ -38,10 +38,21 @@ from ray_tpu.api import (
     wait,
 )
 
+def timeline(filename=None, *, address=None):
+    """Chrome-tracing dump of all task execution — always on, no
+    ``tracing_enabled`` opt-in needed (reference: ray.timeline). Lazy
+    import: util.state pulls the RPC layer, which drivers that only
+    ``import ray_tpu`` must not pay for."""
+    from ray_tpu.util.state import timeline as _timeline
+
+    return _timeline(filename, address=address)
+
+
 __all__ = [
     "init",
     "shutdown",
     "is_initialized",
+    "timeline",
     "remote",
     "get",
     "put",

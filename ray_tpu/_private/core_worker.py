@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -956,6 +957,7 @@ class CoreWorker:
         runtime_env: Optional[Dict[str, Any]] = None,
         template: Optional[Tuple[bytes, Dict[str, Any]]] = None,
     ) -> List[ObjectID]:
+        submit_t0 = time.perf_counter()
         task_id = self._next_task_id()
         payload, deps, nested = self._serialize_args(args, kwargs)
         # num_returns="dynamic": one top-level return holding an
@@ -1020,8 +1022,17 @@ class CoreWorker:
                     with self._lease_lock:
                         lease["_out"] = lease.get("_out", 0) + 1
                     self._push_batch([spec], sig, lease, lease_raylet, client)
+                    internal_metrics.inc("ray_tpu_tasks_submitted_total")
+                    internal_metrics.observe(
+                        "ray_tpu_task_submit_latency_seconds",
+                        time.perf_counter() - submit_t0,
+                    )
                     return return_ids
         self._submit_queue.put(spec)
+        internal_metrics.inc("ray_tpu_tasks_submitted_total")
+        internal_metrics.observe(
+            "ray_tpu_task_submit_latency_seconds", time.perf_counter() - submit_t0
+        )
         return return_ids
 
     # -- lease caching / scheduling keys --------------------------------
@@ -1588,6 +1599,11 @@ class CoreWorker:
                             self._lineage.pop(child, None)
         with self._pending_lock:
             self._pending.pop(task_id, None)
+        internal_metrics.inc(
+            "ray_tpu_tasks_finished_total"
+            if reply["status"] == "ok"
+            else "ray_tpu_tasks_failed_total"
+        )
         self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"], spec.get("trace"))
 
     def _fail_task(self, spec: Dict[str, Any], exc: BaseException):
@@ -1601,6 +1617,7 @@ class CoreWorker:
             self.memory_store.put(ObjectID.for_task_return(task_id, i + 1), err)
         with self._pending_lock:
             self._pending.pop(task_id, None)
+        internal_metrics.inc("ray_tpu_tasks_failed_total")
         self._emit_event(task_id, "FAILED", spec["name"], spec.get("trace"))
 
     # ------------------------------------------------------------------
@@ -1897,6 +1914,10 @@ class CoreWorker:
                 except IndexError:
                     break
             if batch:
+                # node identity attached at flush time (node_id may register
+                # after the thread starts): timeline() buckets pid lanes by
+                # node and tid rows by worker
+                nid = self.node_id.hex() if self.node_id is not None else ""
                 out = []
                 for task_id, state, name, ts, trace in batch:
                     ev = {
@@ -1905,6 +1926,7 @@ class CoreWorker:
                         "name": name,
                         "ts": ts,
                         "worker_id": wid,
+                        "node_id": nid,
                     }
                     if trace:
                         ev["trace_id"] = trace.get("trace_id")

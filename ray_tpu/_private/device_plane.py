@@ -22,7 +22,40 @@ from __future__ import annotations
 
 import pickle
 import sys
+import time
 from typing import Any, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import internal_metrics
+
+# duty-cycle state: end timestamp of the previous transfer, per process.
+# duty = time-in-DMA / wall-time-since-last-DMA-ended — a per-step measure
+# of how transfer-bound the process is (1.0 == back-to-back transfers).
+_last_transfer_end = 0.0
+
+
+def _record_transfer(direction: str, nbytes: int, seconds: float) -> None:
+    """Account one device-plane DMA. Never raises (hot path)."""
+    global _last_transfer_end
+    try:
+        internal_metrics.inc(
+            "ray_tpu_device_transfer_bytes_total",
+            float(nbytes),
+            tags={"direction": direction},
+        )
+        internal_metrics.inc(
+            "ray_tpu_device_transfer_seconds_total",
+            seconds,
+            tags={"direction": direction},
+        )
+        now = time.monotonic()
+        gap = now - _last_transfer_end
+        _last_transfer_end = now
+        if gap > 0:
+            internal_metrics.set_gauge(
+                "ray_tpu_device_duty_cycle", min(1.0, seconds / gap)
+            )
+    except Exception:
+        pass
 
 
 def jax_loaded() -> bool:
@@ -81,6 +114,7 @@ def reduce_jax_array(arr) -> Tuple[Any, tuple]:
             "cannot serialize a non-fully-addressable jax.Array; "
             "gather it or save per-host shards"
         )
+    transfer_t0 = time.perf_counter()
     shards = sorted(
         arr.addressable_shards, key=lambda sh: sh.device.id
     )
@@ -119,6 +153,11 @@ def reduce_jax_array(arr) -> Tuple[Any, tuple]:
         "sharding": _sharding_descriptor(arr),
         "shards": shard_meta,
     }
+    _record_transfer(
+        "device_to_host",
+        sum(b.raw().nbytes for b in buffers),
+        time.perf_counter() - transfer_t0,
+    )
     return rebuild_jax_array, (meta, buffers)
 
 
@@ -178,6 +217,7 @@ def rebuild_jax_array(meta: dict, buffers: Sequence[Any]):
     import jax
     import numpy as np
 
+    transfer_t0 = time.perf_counter()
     dtype = _np_dtype(meta["dtype"])
     views = [
         np.frombuffer(b, dtype=dtype).reshape(sm["shape"])
@@ -185,32 +225,38 @@ def rebuild_jax_array(meta: dict, buffers: Sequence[Any]):
     ]
     shape = tuple(meta["shape"])
     sharding = _rebuild_sharding(meta["sharding"], len(shape))
-    if sharding is not None:
-        try:
-            # block index -> devices that need that block (replication makes
-            # this one-to-many)
-            want: dict = {}
-            for d, idx in sharding.devices_indices_map(shape).items():
-                want.setdefault(_norm_index(idx, shape), []).append(d)
-            by_key = {}
-            for v, sm in zip(views, meta["shards"]):
-                key = _norm_index(
-                    tuple(slice(*t) for t in sm["index"]), shape
-                )
-                by_key[key] = v
-            if set(want) == set(by_key):
-                arrays = [
-                    jax.device_put(by_key[key], d)
-                    for key, devs in want.items()
-                    for d in devs
-                ]
-                return jax.make_array_from_single_device_arrays(
-                    shape, sharding, arrays
-                )
-            return jax.device_put(_assemble(meta, views), sharding)
-        except Exception:
-            pass  # topology changed under us: fall through to default
-    return jax.device_put(_assemble(meta, views))
+    nbytes = int(sum(v.nbytes for v in views))
+    try:
+        if sharding is not None:
+            try:
+                # block index -> devices that need that block (replication
+                # makes this one-to-many)
+                want: dict = {}
+                for d, idx in sharding.devices_indices_map(shape).items():
+                    want.setdefault(_norm_index(idx, shape), []).append(d)
+                by_key = {}
+                for v, sm in zip(views, meta["shards"]):
+                    key = _norm_index(
+                        tuple(slice(*t) for t in sm["index"]), shape
+                    )
+                    by_key[key] = v
+                if set(want) == set(by_key):
+                    arrays = [
+                        jax.device_put(by_key[key], d)
+                        for key, devs in want.items()
+                        for d in devs
+                    ]
+                    return jax.make_array_from_single_device_arrays(
+                        shape, sharding, arrays
+                    )
+                return jax.device_put(_assemble(meta, views), sharding)
+            except Exception:
+                pass  # topology changed under us: fall through to default
+        return jax.device_put(_assemble(meta, views))
+    finally:
+        _record_transfer(
+            "host_to_device", nbytes, time.perf_counter() - transfer_t0
+        )
 
 
 def _assemble(meta: dict, views) -> Any:

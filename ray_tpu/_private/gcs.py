@@ -153,6 +153,11 @@ class GcsServer:
 
         self._worker_clients: "_OD[Tuple[str, int], RpcClient]" = _OD()
         self._task_events: List[Dict[str, Any]] = []
+        # structured cluster event log (node up/down, actor restarts,
+        # OOM/spill, autoscaler decisions); reference: gcs_event_manager +
+        # the dashboard's event_agent. Ring-buffered, queryable via
+        # rpc_list_cluster_events, live via the "cluster_events" channel.
+        self._cluster_events: List[Dict[str, Any]] = []
         self._stopped = threading.Event()
         if self._storage is not None:
             self._reload_from_storage()
@@ -350,6 +355,12 @@ class GcsServer:
             self._nodes[node_id] = info
         conn.meta["node_id"] = node_id
         self._publish("nodes", {"event": "added", "node": self._node_view(info)})
+        self._record_cluster_event(
+            "NODE_ADDED",
+            f"node {node_id.hex()[:8]} registered at {address[0]}:{address[1]} "
+            f"resources={resources}",
+            node_id=node_id.hex(),
+        )
         logger.info("node %s registered at %s resources=%s", node_id.hex()[:8], address, resources)
         return True
 
@@ -382,6 +393,11 @@ class GcsServer:
                 return False
             info.alive = False
         self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
+        self._record_cluster_event(
+            "NODE_REMOVED",
+            f"node {node_id.hex()[:8]} drained (graceful unregister)",
+            node_id=node_id.hex(),
+        )
         self._handle_node_death(node_id)
         return True
 
@@ -436,6 +452,13 @@ class GcsServer:
             for info in dead:
                 logger.warning("node %s failed health check", info.node_id.hex()[:8])
                 self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
+                self._record_cluster_event(
+                    "NODE_DIED",
+                    f"node {info.node_id.hex()[:8]} failed health check "
+                    f"(no heartbeat for {period * threshold:.1f}s)",
+                    severity="ERROR",
+                    node_id=info.node_id.hex(),
+                )
                 self._handle_node_death(info.node_id)
 
     # ------------------------------------------------------------------
@@ -723,6 +746,13 @@ class GcsServer:
         self._publish(f"actor:{actor_id.hex()}", info.public_view())
         self._publish("actors", info.public_view())
         if restart:
+            self._record_cluster_event(
+                "ACTOR_RESTARTED",
+                f"actor {actor_id.hex()[:8]} restarting "
+                f"({info.num_restarts}/{info.max_restarts}): {cause}",
+                severity="WARNING",
+                actor_id=actor_id.hex(),
+            )
             logger.info(
                 "restarting actor %s (%d/%s)",
                 actor_id.hex()[:8],
@@ -730,6 +760,14 @@ class GcsServer:
                 info.max_restarts,
             )
             self._actor_sched_pool.submit(self._schedule_actor, info)
+        else:
+            self._record_cluster_event(
+                "ACTOR_DEAD",
+                f"actor {actor_id.hex()[:8]} dead (restarts exhausted): "
+                f"{cause}",
+                severity="ERROR",
+                actor_id=actor_id.hex(),
+            )
 
     def _handle_node_death(self, node_id: NodeID):
         with self._lock:
@@ -1030,6 +1068,51 @@ class GcsServer:
     def rpc_get_jobs(self, conn, payload=None):
         with self._lock:
             return list(self._jobs.values())
+
+    # ------------------------------------------------------------------
+    # cluster event log
+    # ------------------------------------------------------------------
+
+    def _record_cluster_event(
+        self, type: str, message: str, severity: str = "INFO", **fields
+    ):
+        """Append one structured event; raylets/autoscalers report theirs
+        via rpc_report_cluster_event, GCS-internal transitions call this
+        directly."""
+        event = {
+            "type": type,
+            "severity": severity,
+            "message": message,
+            "ts": time.time(),
+            **fields,
+        }
+        with self._lock:
+            self._cluster_events.append(event)
+            if len(self._cluster_events) > 10_000:
+                del self._cluster_events[: len(self._cluster_events) - 10_000]
+        self._publish("cluster_events", event)
+
+    def rpc_report_cluster_event(self, conn, payload):
+        event = dict(payload)
+        self._record_cluster_event(
+            event.pop("type", "UNKNOWN"),
+            event.pop("message", ""),
+            event.pop("severity", "INFO"),
+            **event,
+        )
+        return True
+
+    def rpc_list_cluster_events(self, conn, payload=None):
+        with self._lock:
+            events = list(self._cluster_events)
+        if isinstance(payload, dict):
+            etype = payload.get("type")
+            if etype:
+                events = [e for e in events if e["type"] == etype]
+            limit = payload.get("limit")
+            if limit:
+                events = events[-int(limit):]
+        return events
 
     def rpc_add_task_events(self, conn, payload):
         with self._lock:

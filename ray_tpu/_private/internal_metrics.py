@@ -1,0 +1,154 @@
+"""Always-on runtime instrumentation: internal ``ray_tpu_*`` metrics.
+
+The reference runtime ships ~100 built-in Prometheus metrics (scheduler
+queue depths, object-store usage, serve QPS — reference:
+src/ray/stats/metric_defs.cc + dashboard/modules/metrics/). Here the
+runtime's hot paths report through the same process-local registry user
+code uses (``ray_tpu.util.metrics``), under a reserved ``ray_tpu_``
+namespace, so one reporter thread, one GCS aggregation path, and one
+``/metrics`` exposition endpoint serve both.
+
+Design constraints:
+
+- **Lazy + idempotent**: metric objects are created on first touch per
+  process (workers, drivers, and the head's in-process raylet each get
+  their own instance; the GCS merges by reporter key). Importing this
+  module costs nothing — no registry entries, no reporter thread.
+- **Never throws on the hot path**: the ``inc``/``observe``/``set_gauge``
+  helpers swallow everything. A metrics bug must not fail a task push.
+- **Catalog-driven**: every family is declared once in ``CATALOG`` so the
+  docs table, the dashboard, and the tests share one source of truth.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+#: latency boundaries tuned for RPC-scale (sub-ms) through task-scale (s)
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+#: name -> (type, description, tag_keys)
+CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
+    # -- core worker / task lifecycle ---------------------------------
+    "ray_tpu_tasks_submitted_total": (
+        "counter", "tasks submitted by this process (normal tasks)", ()),
+    "ray_tpu_tasks_finished_total": (
+        "counter", "task replies received with status=ok", ()),
+    "ray_tpu_tasks_failed_total": (
+        "counter", "tasks that terminally failed (after retries)", ()),
+    "ray_tpu_task_submit_latency_seconds": (
+        "histogram", "submit_task() wall time (serialize + route/push)", ()),
+    "ray_tpu_tasks_executed_total": (
+        "counter", "tasks executed on this worker", ("kind",)),
+    "ray_tpu_task_exec_latency_seconds": (
+        "histogram", "user-function execution wall time", ("kind",)),
+    # -- raylet / scheduler -------------------------------------------
+    "ray_tpu_scheduler_queue_depth": (
+        "gauge", "lease requests parked in the raylet's wait loop", ()),
+    "ray_tpu_worker_pool_size": (
+        "gauge", "workers registered with this raylet", ()),
+    "ray_tpu_workers_idle": (
+        "gauge", "registered workers currently idle in the pool", ()),
+    "ray_tpu_worker_leases_granted_total": (
+        "counter", "worker leases granted by this raylet", ()),
+    # -- object store -------------------------------------------------
+    "ray_tpu_object_store_objects": (
+        "gauge", "objects resident in the local plasma store", ()),
+    "ray_tpu_object_store_allocated_bytes": (
+        "gauge", "bytes allocated in the local plasma arena", ()),
+    "ray_tpu_object_store_bytes_written_total": (
+        "counter", "bytes of new objects created in the local store", ()),
+    "ray_tpu_object_store_spills_total": (
+        "counter", "objects spilled to disk under memory pressure", ()),
+    "ray_tpu_object_store_spilled_bytes_total": (
+        "counter", "bytes spilled to disk under memory pressure", ()),
+    # -- device plane / collectives -----------------------------------
+    "ray_tpu_device_transfer_bytes_total": (
+        "counter", "device plane DMA volume", ("direction",)),
+    "ray_tpu_device_transfer_seconds_total": (
+        "counter", "wall time spent in device plane DMA", ("direction",)),
+    "ray_tpu_device_duty_cycle": (
+        "gauge", "fraction of the last step spent in device transfers", ()),
+    "ray_tpu_collective_ops_total": (
+        "counter", "collective operations issued from this process", ("op",)),
+    "ray_tpu_collective_bytes_total": (
+        "counter", "bytes contributed to collectives", ("op",)),
+    "ray_tpu_collective_latency_seconds": (
+        "histogram", "collective op wall time (rendezvous round trip)", ("op",)),
+    "ray_tpu_collective_duty_cycle": (
+        "gauge", "fraction of the last step spent inside collectives", ()),
+    # -- serve --------------------------------------------------------
+    "ray_tpu_serve_requests_total": (
+        "counter", "requests handled by replicas", ("deployment",)),
+    "ray_tpu_serve_request_latency_seconds": (
+        "histogram", "replica request handling wall time", ("deployment",)),
+    "ray_tpu_serve_queue_depth": (
+        "gauge", "in-flight requests on the replica", ("deployment",)),
+    "ray_tpu_serve_proxy_requests_total": (
+        "counter", "HTTP requests through the ingress proxy", ("route", "status")),
+    "ray_tpu_serve_proxy_latency_seconds": (
+        "histogram", "end-to-end HTTP request latency at the proxy", ("route",)),
+    "ray_tpu_serve_dag_node_latency_seconds": (
+        "histogram", "per-node latency inside DAGDriver graphs",
+        ("deployment", "method")),
+    # -- rpc ----------------------------------------------------------
+    "ray_tpu_rpc_pump_failures": (
+        "counter", "native poller pump-thread crashes (streams torn down)", ()),
+}
+
+_lock = threading.Lock()
+_metrics: Dict[str, Any] = {}
+
+
+def get(name: str):
+    """The process-local metric object for a catalog family (lazy)."""
+    m = _metrics.get(name)
+    if m is not None:
+        return m
+    with _lock:
+        m = _metrics.get(name)
+        if m is None:
+            from ray_tpu.util import metrics as user_metrics
+
+            kind, desc, tag_keys = CATALOG[name]
+            if kind == "counter":
+                m = user_metrics.Counter(name, desc, tag_keys=tag_keys)
+            elif kind == "gauge":
+                m = user_metrics.Gauge(name, desc, tag_keys=tag_keys)
+            else:
+                m = user_metrics.Histogram(
+                    name, desc, boundaries=LATENCY_BUCKETS, tag_keys=tag_keys
+                )
+            _metrics[name] = m
+    return m
+
+
+# -- hot-path helpers: cheap, and never let metrics break the runtime --
+
+
+def inc(name: str, value: float = 1.0,
+        tags: Optional[Dict[str, str]] = None) -> None:
+    try:
+        get(name).inc(value, tags=tags)
+    except Exception:
+        pass
+
+
+def observe(name: str, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+    try:
+        get(name).observe(value, tags=tags)
+    except Exception:
+        pass
+
+
+def set_gauge(name: str, value: float,
+              tags: Optional[Dict[str, str]] = None) -> None:
+    try:
+        get(name).set(value, tags=tags)
+    except Exception:
+        pass

@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ObjectID
@@ -401,6 +402,9 @@ class PlasmaStore:
                     f"cannot allocate {size} bytes (capacity {self.capacity})"
                 )
             self._entries[object_id] = _Entry(offset, size, creating_worker)
+            internal_metrics.inc(
+                "ray_tpu_object_store_bytes_written_total", float(size)
+            )
             return offset
 
     def put_bytes(self, object_id: ObjectID, data: bytes, creating_worker=None):
@@ -518,6 +522,10 @@ class PlasmaStore:
         arena, write synchronously (bounded memory beats bounded latency
         when producers outrun the disk)."""
         self._spilled_bytes_total += e.size
+        internal_metrics.inc("ray_tpu_object_store_spills_total")
+        internal_metrics.inc(
+            "ray_tpu_object_store_spilled_bytes_total", float(e.size)
+        )
         if self._spill_pending_bytes > self.capacity // 2:
             os.makedirs(self._spill_dir, exist_ok=True)
             path = os.path.join(self._spill_dir, object_id.hex())

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import internal_metrics
 from ray_tpu._private import object_store
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, NodeID, WorkerID
@@ -326,6 +327,9 @@ class Raylet:
         # heartbeats as the autoscaler's demand signal (the reference's
         # resource_load via ray_syncer)
         self._demand: Dict[int, Dict[str, float]] = {}
+        # spill watermark: heartbeats diff against it to report OBJECT_SPILL
+        # cluster events exactly once per spill burst
+        self._spill_event_bytes = 0
         self._stopped = threading.Event()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
@@ -680,6 +684,7 @@ class Raylet:
                 idle.lease_resources = dict(effective)
                 if actor_id is not None:
                     idle.actor_ids.append(actor_id)
+                internal_metrics.inc("ray_tpu_worker_leases_granted_total")
                 return {"worker_id": idle.worker_id, "address": idle.address}
             if have_resources and idle is None:
                 self._reap_dead_locked()
@@ -975,12 +980,58 @@ class Raylet:
         self._heartbeat_now()
         return True
 
+    def _report_store_gauges(self):
+        """Mirror plasma stats into gauges and surface spill bursts as
+        cluster events (one event per burst, diffed against a watermark)."""
+        try:
+            stats = self.store.stats()
+        except Exception:
+            return
+        internal_metrics.set_gauge(
+            "ray_tpu_object_store_objects", float(stats.get("num_objects", 0))
+        )
+        internal_metrics.set_gauge(
+            "ray_tpu_object_store_allocated_bytes",
+            float(stats.get("allocated_bytes", 0)),
+        )
+        spilled = int(stats.get("spilled_bytes_total", 0))
+        if spilled > self._spill_event_bytes:
+            delta, self._spill_event_bytes = (
+                spilled - self._spill_event_bytes,
+                spilled,
+            )
+            try:
+                self.gcs.call(
+                    "report_cluster_event",
+                    {
+                        "type": "OBJECT_SPILL",
+                        "severity": "WARNING",
+                        "node_id": self.node_id.hex(),
+                        "message": f"spilled {delta} bytes to disk "
+                        f"({spilled} total on this node)",
+                        "spilled_bytes": delta,
+                    },
+                    timeout=5.0,
+                )
+            except Exception:
+                pass  # event log is best-effort; never block heartbeats
+
     def _heartbeat_now(self):
         try:
             with self._res_cv:
                 available = dict(self.available)
                 total = dict(self.total_resources)
                 demand = [dict(d) for d in self._demand.values()]
+                num_workers = len(self._workers)
+                num_idle = sum(1 for h in self._workers.values() if h.idle)
+            internal_metrics.set_gauge(
+                "ray_tpu_scheduler_queue_depth", float(len(demand))
+            )
+            internal_metrics.set_gauge(
+                "ray_tpu_worker_pool_size", float(num_workers)
+            )
+            internal_metrics.set_gauge("ray_tpu_workers_idle", float(num_idle))
+            self._report_store_gauges()
             ok = self.gcs.call(
                 "heartbeat", (self.node_id, available, total, demand), timeout=5.0
             )
@@ -1320,6 +1371,21 @@ class Raylet:
         # hard kill: the worker is presumed wedged in allocation; the
         # disconnect path reports the death and frees its lease
         victim.proc.kill()
+        try:
+            self.gcs.call(
+                "report_cluster_event",
+                {
+                    "type": "WORKER_OOM_KILLED",
+                    "severity": "WARNING",
+                    "node_id": self.node_id.hex(),
+                    "worker_id": victim.worker_id.hex(),
+                    "message": f"memory pressure at {usage * 100:.0f}%: "
+                    f"killed worker {victim.worker_id.hex()[:8]}",
+                },
+                timeout=5.0,
+            )
+        except Exception:
+            pass
         return True
 
     # -- log monitor ---------------------------------------------------

@@ -596,6 +596,40 @@ class _NativePoller:
     # -- the pump -------------------------------------------------------
 
     def _pump(self):
+        try:
+            self._pump_inner()
+        except Exception as e:  # noqa: BLE001
+            # the pump thread IS the process's RPC data plane: if it dies
+            # silently every stream it owned wedges forever. Tear the
+            # streams down loudly instead so callers see ConnectionLost
+            # and can retry/reconnect.
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "native RPC pump thread crashed: %s", e
+            )
+            try:
+                from ray_tpu._private import internal_metrics
+
+                internal_metrics.inc("ray_tpu_rpc_pump_failures")
+            except Exception:
+                pass
+            with self._lock:
+                doomed = list(self._streams.items())
+                self._streams.clear()
+                self._cid_by_sock.clear()
+            exc = ConnectionLost(f"rpc pump thread crashed: {e!r}")
+            for cid, stream in doomed:
+                try:
+                    self.loop.remove(cid)
+                except Exception:
+                    pass
+                try:
+                    stream.on_closed(exc)
+                except Exception:
+                    pass
+
+    def _pump_inner(self):
         loop = self.loop
         streams = self._streams
         while True:

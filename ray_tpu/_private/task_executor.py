@@ -16,6 +16,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.core_worker import CoreWorker, PLASMA_MARKER, TaskError
@@ -411,8 +412,17 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001
             value, is_exc = TaskError(e, spec["name"], traceback.format_exc()), True
         else:
+            exec_t0 = time.perf_counter()
             value, is_exc = self._run(
                 fn, args, kwargs, task_id, spec["name"], trace=spec.get("trace")
+            )
+            internal_metrics.inc(
+                "ray_tpu_tasks_executed_total", tags={"kind": "normal"}
+            )
+            internal_metrics.observe(
+                "ray_tpu_task_exec_latency_seconds",
+                time.perf_counter() - exec_t0,
+                tags={"kind": "normal"},
             )
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc)
@@ -455,9 +465,18 @@ class TaskExecutor:
                     if inspect.iscoroutinefunction(getattr(method, "__func__", method))
                     else None
                 )
+                exec_t0 = time.perf_counter()
                 value, is_exc = self._run(
                     method, args, kwargs, task_id, spec["name"], loop=loop,
                     trace=spec.get("trace"),
+                )
+                internal_metrics.inc(
+                    "ray_tpu_tasks_executed_total", tags={"kind": "actor"}
+                )
+                internal_metrics.observe(
+                    "ray_tpu_task_exec_latency_seconds",
+                    time.perf_counter() - exec_t0,
+                    tags={"kind": "actor"},
                 )
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc)

@@ -54,7 +54,12 @@ def ensure_virtual_devices(n_devices: int) -> None:
         jeb.clear_backends()
     set_virtual_cpu_env(n_devices)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # older jax: XLA_FLAGS --xla_force_host_platform_device_count
+        # (set above, read at backend (re)initialization) applies instead
+        pass
     assert len(jax.devices()) >= n_devices, (
         f"virtual CPU mesh bring-up failed: need {n_devices}, "
         f"have {len(jax.devices())}"

@@ -108,6 +108,12 @@ class StandardAutoscaler:
                     "autoscaler: %d unmet demand shapes -> launching %d "
                     "unit(s) %s", len(unmet), to_launch, created,
                 )
+                self._report_event(
+                    "AUTOSCALER_LAUNCH",
+                    f"{len(unmet)} unmet demand shape(s): launching "
+                    f"{to_launch} unit(s) {created}",
+                    launched=list(created),
+                )
 
         # ---- scale down: terminate units idle past the timeout
         # (a unit is idle when every resource is fully available and it
@@ -148,7 +154,24 @@ class StandardAutoscaler:
             self.provider.terminate_node(nid)
             self._idle_since.pop(nid, None)
             report["terminated"] += 1
+            self._report_event(
+                "AUTOSCALER_TERMINATE",
+                f"terminating unit {nid} "
+                f"(idle > {self.config.idle_timeout_s:.0f}s)",
+                node=nid,
+            )
         return report
+
+    def _report_event(self, type: str, message: str, **fields):
+        try:
+            self._gcs.call(
+                "report_cluster_event",
+                {"type": type, "severity": "INFO", "message": message,
+                 **fields},
+                timeout=5.0,
+            )
+        except Exception:
+            pass  # the event log must never fail a reconcile round
 
     # -- helpers -----------------------------------------------------------
 

@@ -9,7 +9,9 @@ no build step, no extra deps.
 APIs:
   GET /api/nodes | /api/actors | /api/tasks | /api/jobs | /api/objects
       /api/placement_groups | /api/summary | /api/cluster
+  GET /api/events        (structured cluster event log)
   GET /metrics           (Prometheus exposition)
+  GET /events            (event log view)
   GET /                  (the UI)
 """
 
@@ -38,6 +40,8 @@ _PAGE = """<!doctype html>
 <h2>Jobs</h2><div id="jobs"></div>
 <h2>Task summary</h2><div id="summary"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
+<h2>Events <a href="/events" style="font-size:.75rem">(full log)</a></h2>
+<div id="events"></div>
 <script>
 function table(rows, cols){
   if(!rows || !rows.length) return '<em>none</em>';
@@ -70,10 +74,10 @@ function spark(hist, key, label, color){
 async function refresh(){
   const get = async p => (await fetch(p)).json();
   try{
-    const [cluster,nodes,actors,jobs,summary,pgs,hist] = await Promise.all([
+    const [cluster,nodes,actors,jobs,summary,pgs,hist,events] = await Promise.all([
       get('/api/cluster'), get('/api/nodes'), get('/api/actors'),
       get('/api/jobs'), get('/api/summary'), get('/api/placement_groups'),
-      get('/api/metrics_history')]);
+      get('/api/metrics_history'), get('/api/events?limit=15')]);
     document.getElementById('charts').innerHTML =
       spark(hist,'cpu_used','CPU in use','#2563eb') +
       spark(hist,'running_tasks','running tasks','#0a7d2c') +
@@ -89,6 +93,55 @@ async function refresh(){
       Object.entries(summary).map(([name,states])=>({name, ...states})));
     document.getElementById('pgs').innerHTML = table(pgs,
       ['placement_group_id','name','strategy','state']);
+    document.getElementById('events').innerHTML = table(
+      events.slice().reverse().map(e=>({
+        time:new Date(e.ts*1000).toLocaleTimeString(),
+        type:e.type, severity:e.severity, message:e.message})),
+      ['time','type','severity','message']);
+    document.getElementById('updated').textContent =
+      'updated '+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+_EVENTS_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu events</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0}
+ .INFO{color:#0a7d2c} .WARNING{color:#b45309} .ERROR{color:#c0232c}
+ #updated{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>cluster events <a href="/" style="font-size:.8rem">dashboard</a>
+<span id="updated"></span></h1>
+<select id="type"><option value="">all types</option></select>
+<div id="log"></div>
+<script>
+async function refresh(){
+  const t = document.getElementById('type').value;
+  const url = '/api/events' + (t ? '?type='+t : '');
+  try{
+    const events = (await (await fetch(url)).json()).slice().reverse();
+    const types = [...new Set(events.map(e=>e.type))].sort();
+    const sel = document.getElementById('type');
+    for(const ty of types)
+      if(![...sel.options].some(o=>o.value===ty))
+        sel.add(new Option(ty, ty));
+    let h = '<table><tr><th>time</th><th>type</th><th>severity</th>'+
+            '<th>message</th><th>detail</th></tr>';
+    for(const e of events){
+      const {ts,type,severity,message,...rest} = e;
+      h += `<tr><td>${new Date(ts*1000).toLocaleTimeString()}</td>`+
+           `<td>${type}</td><td class="${severity}">${severity}</td>`+
+           `<td>${message}</td><td>${JSON.stringify(rest)}</td></tr>`;
+    }
+    document.getElementById('log').innerHTML = h+'</table>';
     document.getElementById('updated').textContent =
       'updated '+new Date().toLocaleTimeString();
   }catch(e){
@@ -312,6 +365,8 @@ class DashboardServer:
                 return prometheus_text().encode(), "text/plain; version=0.0.4"
             except RuntimeError:
                 return b"", "text/plain"
+        if base0 == "/events":
+            return _EVENTS_PAGE.encode(), "text/html; charset=utf-8"
         routes = {
             "/api/nodes": lambda: s.list_nodes(address=a),
             "/api/actors": lambda: s.list_actors(address=a),
@@ -323,6 +378,22 @@ class DashboardServer:
             "/api/cluster": lambda: self._cluster_overview(),
         }
         base, _, query = path.partition("?")
+        if base == "/api/events":
+            from urllib.parse import parse_qs
+
+            q = parse_qs(query)
+            payload: dict = {}
+            if q.get("type"):
+                payload["type"] = q["type"][0]
+            if q.get("limit"):
+                payload["limit"] = int(q["limit"][0])
+            events = s._gcs_call(
+                "list_cluster_events", payload or None, address=a
+            )
+            return (
+                json.dumps(_to_jsonable(events)).encode(),
+                "application/json",
+            )
         if base == "/api/logs":
             if "file=" in query:
                 return (

@@ -409,7 +409,12 @@ def _read_webdataset_task(path, decode):
             if not member.isfile():
                 continue
             name = member.name
-            key, _, ext = name.partition(".")
+            # split the extension on the basename only: a dotted directory
+            # ("v1.0/img001.jpg") must not truncate the key to "v1" and
+            # silently merge unrelated samples
+            dirname, _, base = name.rpartition("/")
+            stem, _, ext = base.partition(".")
+            key = f"{dirname}/{stem}" if dirname else stem
             data = tar.extractfile(member).read()
             if key not in samples:
                 samples[key] = {"__key__": key}

@@ -23,10 +23,12 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu._private import internal_metrics
 from ray_tpu._private.ids import ObjectRefGenerator
 from ray_tpu.serve.handle import DeploymentHandle
 
@@ -162,11 +164,13 @@ class AsyncHTTPProxy:
             self._reply(writer, 404, b'{"error": "not found"}')
             return
         name = segments[0]
+        route_t0 = time.perf_counter()
         stream = len(segments) > 1 and segments[-1] == "stream"
         try:
             payload = json.loads(body or b"null")
         except ValueError:
             self._reply(writer, 400, b'{"error": "invalid JSON body"}')
+            self._record_proxy(name, 400, route_t0)
             return
         handle = self._handles.get(name)
         if handle is None:
@@ -200,13 +204,28 @@ class AsyncHTTPProxy:
                 writer, 500,
                 json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
             )
+            self._record_proxy(name, 500, route_t0)
             return
         if isinstance(value, ObjectRefGenerator) or (
             stream and isinstance(value, (list, tuple))
         ):
             await self._stream_items(writer, value)
+            self._record_proxy(name, 200, route_t0)
             return
         self._reply(writer, 200, json.dumps({"result": value}).encode())
+        self._record_proxy(name, 200, route_t0)
+
+    @staticmethod
+    def _record_proxy(route: str, status: int, t0: float) -> None:
+        internal_metrics.inc(
+            "ray_tpu_serve_proxy_requests_total",
+            tags={"route": route, "status": str(status)},
+        )
+        internal_metrics.observe(
+            "ray_tpu_serve_proxy_latency_seconds",
+            time.perf_counter() - t0,
+            tags={"route": route},
+        )
 
     async def _stream_items(self, writer, items):
         """Chunked NDJSON: one line per yielded item, flushed as each

@@ -169,34 +169,71 @@ class _DAGDriverImpl:
         }
 
     def __call__(self, request):
+        import time as _time
+
+        from ray_tpu._private import internal_metrics
+
         values: Dict[int, Any] = {}
         pending: Dict[int, Any] = {}  # node id -> DeploymentResponse
-
-        def resolved(nid):
-            if nid in values:
-                return True
-            if nid in pending:
-                values[nid] = pending.pop(nid).result(timeout=60.0)
-                return True
-            return False
-
-        # topological order is construction order (build() appends children
-        # before parents)
+        started: Dict[int, float] = {}  # node id -> launch timestamp
+        by_id = {n["id"]: n for n in self.plan["nodes"]}
+        unlaunched: List[Dict[str, Any]] = []
         for n in self.plan["nodes"]:
             if n["type"] == "input":
                 values[n["id"]] = request
-                continue
-            args = []
-            for a in n["args"]:
-                if isinstance(a, dict) and "node" in a:
-                    resolved(a["node"])
-                    args.append(values[a["node"]])
+            else:
+                unlaunched.append(n)
+
+        def ready(n) -> bool:
+            return all(
+                a["node"] in values
+                for a in n["args"]
+                if isinstance(a, dict) and "node" in a
+            )
+
+        def launch_ready():
+            # fire EVERY node whose inputs are resolved, not just the next
+            # one in topological order — this is what lets independent
+            # branches genuinely run concurrently
+            i = 0
+            while i < len(unlaunched):
+                n = unlaunched[i]
+                if ready(n):
+                    unlaunched.pop(i)
+                    args = [
+                        values[a["node"]]
+                        if isinstance(a, dict) and "node" in a
+                        else a
+                        for a in n["args"]
+                    ]
+                    handle = self.handles[n["deployment"]]
+                    started[n["id"]] = _time.perf_counter()
+                    pending[n["id"]] = getattr(handle, n["method"]).remote(
+                        *args
+                    )
                 else:
-                    args.append(a)
-            handle = self.handles[n["deployment"]]
-            pending[n["id"]] = getattr(handle, n["method"]).remote(*args)
+                    i += 1
+
+        def resolve(nid):
+            values[nid] = pending.pop(nid).result(timeout=60.0)
+            n = by_id[nid]
+            internal_metrics.observe(
+                "ray_tpu_serve_dag_node_latency_seconds",
+                _time.perf_counter() - started[nid],
+                tags={"deployment": n["deployment"], "method": n["method"]},
+            )
+
+        launch_ready()
         out_id = self.plan["output_id"]
-        resolved(out_id)
+        while out_id not in values:
+            # resolve the topologically-first in-flight node; its arrival
+            # can only unlock nodes later in the plan. One always exists:
+            # every unlaunched node waits (transitively) on a pending one.
+            nid = next(
+                n["id"] for n in self.plan["nodes"] if n["id"] in pending
+            )
+            resolve(nid)
+            launch_ready()
         return values[out_id]
 
 

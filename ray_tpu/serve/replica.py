@@ -12,6 +12,7 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu._private import internal_metrics
 
 
 @ray_tpu.remote(max_concurrency=8)
@@ -54,6 +55,9 @@ class Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            ongoing = self._ongoing
+        self._record_request_start(ongoing)
+        req_t0 = time.perf_counter()
         token = _current_model_id.set(model_id or "")
         try:
             target = self._callable if method is None else getattr(self._callable, method)
@@ -62,6 +66,8 @@ class Replica:
             _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+                ongoing = self._ongoing
+            self._record_request_end(ongoing, time.perf_counter() - req_t0)
 
     def handle_request_stream(self, method: Optional[str], args, kwargs,
                               model_id: Optional[str] = None):
@@ -74,6 +80,9 @@ class Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+            ongoing = self._ongoing
+        self._record_request_start(ongoing)
+        req_t0 = time.perf_counter()
         token = _current_model_id.set(model_id or "")
         try:
             target = self._callable if method is None else getattr(self._callable, method)
@@ -89,6 +98,25 @@ class Replica:
             _current_model_id.reset(token)
             with self._lock:
                 self._ongoing -= 1
+                ongoing = self._ongoing
+            self._record_request_end(ongoing, time.perf_counter() - req_t0)
+
+    def _record_request_start(self, ongoing: int) -> None:
+        internal_metrics.set_gauge(
+            "ray_tpu_serve_queue_depth",
+            float(ongoing),
+            tags={"deployment": self._name},
+        )
+
+    def _record_request_end(self, ongoing: int, seconds: float) -> None:
+        tags = {"deployment": self._name}
+        internal_metrics.inc("ray_tpu_serve_requests_total", tags=tags)
+        internal_metrics.observe(
+            "ray_tpu_serve_request_latency_seconds", seconds, tags=tags
+        )
+        internal_metrics.set_gauge(
+            "ray_tpu_serve_queue_depth", float(ongoing), tags=tags
+        )
 
     def get_metrics(self) -> Dict[str, Any]:
         with self._lock:

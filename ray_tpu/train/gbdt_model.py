@@ -101,6 +101,16 @@ def eval_metric(name: str, y: np.ndarray, pred: np.ndarray) -> float:
     raise ValueError(f"unknown eval metric {name!r}")
 
 
+#: metrics whose numerator sums across shards (metric_numerator below);
+#: anything else (auc: needs a global rank over all predictions) must be
+#: computed driver-side on a materialized eval set
+SHARD_METRICS = ("rmse", "mae", "logloss", "error")
+
+
+def is_shard_decomposable(name: str) -> bool:
+    return name in SHARD_METRICS
+
+
 def metric_numerator(name: str, y: np.ndarray, pred: np.ndarray) -> float:
     """The summable-across-shards numerator of a metric (see
     GBDTShard.evaluate). auc has no per-shard sufficient statistic of this

@@ -181,13 +181,33 @@ class GBDTTrainer(BaseTrainer):
                 elif report_cb is not None:
                     report_cb(metrics)
 
+            # train-set metrics are computed on the shards via summable
+            # numerators, which only exist for shard-decomposable metrics;
+            # driver-only ones (auc needs a global rank) are still computed
+            # in on_round over the driver-side eval sets (ADVICE r5 —
+            # previously params={"eval_metric": "auc"} raised at round 1)
+            shard_metrics = [
+                m for m in self.eval_metrics if G.is_shard_decomposable(m)
+            ]
+            driver_only = [
+                m for m in self.eval_metrics if not G.is_shard_decomposable(m)
+            ]
+            if driver_only:
+                logger.info(
+                    "eval metric(s) %s are not shard-decomposable: skipping "
+                    "train-set evaluation for them%s",
+                    driver_only,
+                    ""
+                    if eval_sets
+                    else " (pass an eval dataset to see them at all)",
+                )
             model = G.train_rounds(
                 caller,
                 self.params,
                 self.num_boost_round,
                 resume_model=resume_model,
                 on_round=on_round,
-                eval_metrics=self.eval_metrics,
+                eval_metrics=shard_metrics,
             )
         finally:
             for a in actors:

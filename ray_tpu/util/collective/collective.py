@@ -203,15 +203,37 @@ def _to_host(tensor: Any):
     return value, lambda out: out
 
 
+# duty-cycle state: when the previous collective on this process finished
+_last_collective_end = 0.0
+
+
 def _exchange(group: _Group, tag: str, value: np.ndarray) -> List[np.ndarray]:
     """All ranks contribute; returns the full list ordered by rank."""
+    global _last_collective_end
     import ray_tpu
+    from ray_tpu._private import internal_metrics
 
     key = f"{group.name}:{tag}:{group.next_seq()}"
+    t0 = time.perf_counter()
     gathered = ray_tpu.get(
         group.store.exchange.remote(key, group.rank, value),
         timeout=120.0,
     )
+    dt = time.perf_counter() - t0
+    internal_metrics.inc("ray_tpu_collective_ops_total", tags={"op": tag})
+    internal_metrics.inc(
+        "ray_tpu_collective_bytes_total", float(value.nbytes), tags={"op": tag}
+    )
+    internal_metrics.observe(
+        "ray_tpu_collective_latency_seconds", dt, tags={"op": tag}
+    )
+    now = time.monotonic()
+    gap = now - _last_collective_end
+    _last_collective_end = now
+    if gap > 0:
+        internal_metrics.set_gauge(
+            "ray_tpu_collective_duty_cycle", min(1.0, dt / gap)
+        )
     return gathered
 
 

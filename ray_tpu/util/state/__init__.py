@@ -22,6 +22,7 @@ __all__ = [
     "profile_actor",
     "folded_to_text",
     "list_actors",
+    "list_cluster_events",
     "list_jobs",
     "list_nodes",
     "list_objects",
@@ -204,12 +205,35 @@ def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, Any]:
     }
 
 
+def list_cluster_events(
+    *,
+    address: Optional[str] = None,
+    type: Optional[str] = None,
+    limit: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """The structured cluster event log: node up/down, actor restarts,
+    OOM kills, object spills, autoscaler decisions (reference:
+    `ray list cluster-events` over gcs_event_manager). Each event is a dict
+    with at least ``type``, ``severity``, ``message``, ``ts``."""
+    payload: Dict[str, Any] = {}
+    if type is not None:
+        payload["type"] = type
+    if limit is not None:
+        payload["limit"] = limit
+    return _gcs_call(
+        "list_cluster_events", payload or None, address=address
+    )
+
+
 def timeline(
     filename: Optional[str] = None, *, address: Optional[str] = None
 ) -> List[Dict[str, Any]]:
-    """Chrome-tracing dump of task execution (reference:
+    """Chrome-tracing dump of ALL task execution (reference:
     _private/state.py:416 chrome_tracing_dump; view in ui.perfetto.dev).
+    Always on — task events flow to the GCS regardless of the
+    ``tracing_enabled`` opt-in, so this works on any live cluster.
 
+    One ``pid`` lane per node, one ``tid`` row per worker.
     RUNNING→FINISHED/FAILED event pairs become complete ("X") slices on the
     executing worker's row; unpaired events become instants.
     """
@@ -217,14 +241,23 @@ def timeline(
     # GCS arrival order mixes processes; wall-clock order (same host /
     # NTP-synced hosts) reconstructs the lifecycle for pairing
     events = sorted(events, key=lambda e: e["ts"])
+
+    def _lanes(ev: Dict[str, Any]) -> Tuple[str, str]:
+        nid = ev.get("node_id") or ""
+        pid = f"node:{nid[:12]}" if nid else "raytpu"
+        return pid, f"worker:{(ev.get('worker_id') or '?')[:12]}"
+
     running: Dict[str, Dict[str, Any]] = {}
     trace: List[Dict[str, Any]] = []
+    lanes_seen: Dict[Tuple[str, str], None] = {}
     for ev in events:
         tid = ev["task_id"]
         if ev["state"] == "RUNNING":
             running[tid] = ev
         elif ev["state"] in ("FINISHED", "FAILED") and tid in running:
             start = running.pop(tid)
+            pid, lane = _lanes(start)
+            lanes_seen.setdefault((pid, lane))
             trace.append(
                 {
                     "name": ev["name"],
@@ -232,23 +265,31 @@ def timeline(
                     "ph": "X",
                     "ts": start["ts"] * 1e6,
                     "dur": max(0.0, (ev["ts"] - start["ts"]) * 1e6),
-                    "pid": "raytpu",
-                    "tid": start.get("worker_id", "?")[:12],
+                    "pid": pid,
+                    "tid": lane,
                     "args": {"task_id": tid, "state": ev["state"]},
                 }
             )
         else:
+            pid, lane = _lanes(ev)
+            lanes_seen.setdefault((pid, lane))
             trace.append(
                 {
                     "name": f"{ev['name']}:{ev['state']}",
                     "cat": "task_state",
                     "ph": "i",
                     "ts": ev["ts"] * 1e6,
-                    "pid": "raytpu",
-                    "tid": ev.get("worker_id", "?")[:12],
+                    "pid": pid,
+                    "tid": lane,
                     "s": "t",
                 }
             )
+    # metadata records name the lanes in trace viewers
+    for pid, lane in lanes_seen:
+        trace.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": lane,
+             "args": {"name": lane}}
+        )
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Fast observability-layer smoke: internal ray_tpu_* metrics, timeline,
+# cluster events, tracing/profiling — isolated from the full suite so the
+# layer can be verified in ~a minute (CI and pre-PR checks).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
+    tests/test_observability.py tests/test_profiling.py "$@"

@@ -248,6 +248,29 @@ def test_read_webdataset(ray_start_regular, tmp_path):
     assert img.shape == (6, 5, 3)
 
 
+def test_read_webdataset_dotted_dirnames(ray_start_regular, tmp_path):
+    """Samples under a dotted directory ('v1.0/img001.txt') must keep
+    distinct keys — the extension split happens on the basename only, so
+    unrelated samples can't merge into one 'v1' row."""
+    import io
+    import tarfile
+
+    with tarfile.open(tmp_path / "shard.tar", "w") as tar:
+        for i in range(3):
+            for ext in ("txt", "cls"):
+                data = (f"item {i}" if ext == "txt" else str(i)).encode()
+                info = tarfile.TarInfo(f"v1.0/img{i:03d}.{ext}")
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+    rows = rd.read_webdataset(str(tmp_path / "shard.tar")).take_all()
+    assert len(rows) == 3
+    by_key = {r["__key__"]: r for r in rows}
+    assert set(by_key) == {"v1.0/img000", "v1.0/img001", "v1.0/img002"}
+    assert by_key["v1.0/img001"]["txt"] == "item 1"
+    assert by_key["v1.0/img001"]["cls"] == 1
+
+
 def test_iter_torch_and_tf_batches(ray_start_regular):
     """Framework-tensor iteration (reference: iter_torch_batches /
     iter_tf_batches): numpy columns arrive as torch/tf tensors with
