@@ -661,6 +661,7 @@ class CoreWorker:
             spec["retries_left"] = spec.get(
                 "max_retries_initial", GlobalConfig.task_max_retries_default
             )
+            spec["attempt"] = spec.get("attempt", 0) + 1
             spec.pop("locations", None)
             self._pending[task_id] = spec
         with self._locations_lock:
@@ -995,6 +996,7 @@ class CoreWorker:
             nested=nested,
             retries_left=spec["max_retries_initial"],
             resubmits_left=GlobalConfig.lineage_max_resubmits,
+            attempt=0,
             trace=self._trace_ctx(task_id),
         )
         with self._pending_lock:
@@ -1251,7 +1253,7 @@ class CoreWorker:
         # these ride the diff only when the template doesn't pin them
         # (normal tasks decrement retries across pushes and carry per-task
         # names; actor templates pin retries_left=0/name and ship seq_no)
-        for k in ("retries_left", "resubmits_left", "seq_no", "name"):
+        for k in ("retries_left", "resubmits_left", "seq_no", "name", "attempt"):
             if k in spec and k not in tmpl:
                 diff[k] = spec[k]
         for k in ("deps", "nested", "locations", "trace"):
@@ -1336,6 +1338,7 @@ class CoreWorker:
                         continue
                     if spec["retries_left"] > 0:
                         spec["retries_left"] -= 1
+                        spec["attempt"] = spec.get("attempt", 0) + 1
                         logger.warning(
                             "task %s lost worker, retrying (%d left)",
                             spec["name"],

@@ -1126,6 +1126,47 @@ class GcsServer:
         with self._lock:
             return list(self._task_events)
 
+    def rpc_locate_worker(self, conn, payload):
+        """Resolve a task or actor id (full hex or prefix) to the worker and
+        node that execute(d) it — the log plane's ``get_log(task_id=...)``
+        resolution step, answered from GCS-held state instead of shipping
+        the whole event table to the client."""
+        p = payload or {}
+        tid = p.get("task_id")
+        if tid:
+            with self._lock:
+                # RUNNING events carry the *executing* worker's identity
+                # (PENDING/FINISHED are emitted by the owner)
+                events = [
+                    e
+                    for e in self._task_events
+                    if e["state"] == "RUNNING"
+                    and e["task_id"].startswith(tid)
+                    and e.get("worker_id")
+                ]
+            if not events:
+                return None
+            ev = max(events, key=lambda e: e["ts"])
+            return {
+                "task_id": ev["task_id"],
+                "worker_id": ev["worker_id"],
+                "node_id": ev.get("node_id") or "",
+            }
+        aid = p.get("actor_id")
+        if aid:
+            with self._lock:
+                for info in self._actors.values():
+                    if (
+                        info.actor_id.hex().startswith(aid)
+                        and info.worker_id is not None
+                    ):
+                        return {
+                            "actor_id": info.actor_id.hex(),
+                            "worker_id": info.worker_id.hex(),
+                            "node_id": info.node_id.hex() if info.node_id else "",
+                        }
+        return None
+
     def rpc_get_config(self, conn, payload=None):
         return GlobalConfig.dump()
 

@@ -98,6 +98,12 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     # -- rpc ----------------------------------------------------------
     "ray_tpu_rpc_pump_failures": (
         "counter", "native poller pump-thread crashes (streams torn down)", ()),
+    # -- state API ----------------------------------------------------
+    "ray_tpu_state_api_node_errors": (
+        "counter",
+        "per-node raylet failures during cluster-wide state listings "
+        "(partial results)",
+        ("api",)),
 }
 
 _lock = threading.Lock()

@@ -1421,6 +1421,10 @@ class Raylet:
                     batch = raw_lines[:200]
                     published_bytes = sum(len(l) + 1 for l in batch)
                     lines = [l.decode(errors="replace") for l in batch]
+                    # task boundary markers are machine-readable metadata for
+                    # get_log(task_id=...); keep them out of the driver's
+                    # stdout mirror (the offset still advances past them)
+                    lines = [l for l in lines if not l.startswith("::task_")]
                 except OSError:
                     continue
                 if not lines:
@@ -1444,6 +1448,152 @@ class Raylet:
                     self._log_offsets[name] = offset + published_bytes
                 except Exception:
                     pass
+
+    # -- log plane (reference: ray logs / GetLogService: raylet serves its
+    # own session log dir so any node's output is reachable from anywhere) --
+
+    def _log_root(self) -> str:
+        return os.path.join(self.session_dir, "logs", self.node_id.hex()[:12])
+
+    def _resolve_log_path(self, filename: str) -> Optional[str]:
+        """Map a client-supplied filename into this node's log dir, rejecting
+        path traversal (.., absolute paths, symlink escapes)."""
+        root = os.path.realpath(self._log_root())
+        full = os.path.realpath(os.path.join(root, filename))
+        if full != root and not full.startswith(root + os.sep):
+            return None
+        return full
+
+    def rpc_list_logs(self, conn, payload=None):
+        """Enumerate this node's log files: name, size, mtime."""
+        root = self._log_root()
+        files: List[Dict[str, Any]] = []
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            names = []
+        for name in names:
+            try:
+                st = os.stat(os.path.join(root, name))
+            except OSError:
+                continue
+            if not os.path.isfile(os.path.join(root, name)):
+                continue
+            files.append(
+                {"filename": name, "size": st.st_size, "mtime": st.st_mtime}
+            )
+        return {"node_id": self.node_id.hex(), "files": files}
+
+    @staticmethod
+    def _tail_offset(path: str, size: int, n: int) -> int:
+        """Byte offset where the last ``n`` lines of ``path`` begin."""
+        if n <= 0:
+            return size
+        block = 64 * 1024
+        data = b""
+        end = size
+        while end > 0 and data.count(b"\n") <= n:
+            start = max(0, end - block)
+            with open(path, "rb") as f:
+                f.seek(start)
+                data = f.read(end - start) + data
+            end = start
+        lines = data.splitlines(keepends=True)
+        if not lines:
+            return end
+        return size - sum(len(l) for l in lines[-n:])
+
+    def rpc_read_log(self, conn, payload):
+        """Byte-ranged read of one log file; ``follow=True`` long-polls until
+        bytes appear past ``offset`` (or the poll window expires). Dispatch
+        runs on the dynamic pool, so a parked follow call cannot starve
+        other RPCs."""
+        p = payload or {}
+        filename = p.get("filename") or ""
+        full = self._resolve_log_path(filename)
+        if full is None:
+            return {"error": f"invalid log filename {filename!r}"}
+        offset = p.get("offset")
+        max_bytes = min(int(p.get("max_bytes", 1 << 20)), 8 << 20)
+        tail_lines = p.get("tail_lines")
+        follow = bool(p.get("follow"))
+        deadline = time.monotonic() + min(float(p.get("timeout_s", 10.0)), 30.0)
+        while True:
+            try:
+                size = os.path.getsize(full)
+            except OSError:
+                if follow and time.monotonic() < deadline:
+                    # file not created yet (job log registered before first
+                    # write): park until it appears or the window expires
+                    if self._stopped.wait(0.1):
+                        return {"error": f"no such log {filename!r}"}
+                    continue
+                return {"error": f"no such log {filename!r}"}
+            if offset is None:
+                offset = (
+                    self._tail_offset(full, size, int(tail_lines))
+                    if tail_lines is not None and int(tail_lines) >= 0
+                    else 0
+                )
+            if size > offset or not follow:
+                break
+            if time.monotonic() >= deadline or self._stopped.wait(0.1):
+                break
+        data = b""
+        if size > offset:
+            try:
+                with open(full, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(min(size - offset, max_bytes))
+            except OSError as e:
+                return {"error": f"read failed: {e!r}"}
+        return {
+            "node_id": self.node_id.hex(),
+            "filename": filename,
+            "offset": offset,
+            "next_offset": offset + len(data),
+            "size": size,
+            "data": data,
+            "eof": offset + len(data) >= size,
+        }
+
+    def rpc_dump_stacks(self, conn, payload=None):
+        """Fan the per-worker ``profile`` RPC (one short sampling pass ==
+        a stack snapshot) across every registered worker on this node."""
+        p = payload or {}
+        duration = min(float(p.get("duration_s", 0.05)), 2.0)
+        with self._res_cv:
+            targets = [
+                (h.worker_id, tuple(h.address))
+                for h in self._workers.values()
+                # drivers register with a ("", 0) placeholder address and run
+                # no task server — nothing to profile there
+                if h.registered.is_set() and h.address and h.address[1]
+            ]
+        workers: Dict[str, Any] = {}
+
+        def _one(wid: WorkerID, addr: Tuple[str, int]):
+            try:
+                prof = self._peer_client(addr).call(
+                    "profile",
+                    {"duration_s": duration, "interval_s": duration},
+                    timeout=duration + 10.0,
+                )
+                workers[wid.hex()] = {
+                    "pid": prof.get("pid"),
+                    "folded": prof.get("folded", {}),
+                }
+            except Exception as e:
+                workers[wid.hex()] = {"error": repr(e)}
+
+        threads = [
+            threading.Thread(target=_one, args=t, daemon=True) for t in targets
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration + 15.0)
+        return {"node_id": self.node_id.hex(), "workers": workers}
 
     def stop(self, unregister: bool = True):
         object_store.unregister_local_store(self.server.address)

@@ -233,7 +233,8 @@ class TaskExecutor:
             "ref_locations": ref_locations,
         }
 
-    def _run(self, fn, args, kwargs, task_id, name: str, loop=None, trace=None):
+    def _run(self, fn, args, kwargs, task_id, name: str, loop=None, trace=None,
+             attempt: int = 0):
         import asyncio
         import inspect
 
@@ -243,6 +244,12 @@ class TaskExecutor:
         self.core._task_ctx.task_id = task_id
         self.core._task_ctx.task_name = name
         self.core._task_ctx.trace_id = (trace or {}).get("trace_id")
+        # structured boundary markers in the worker log: get_log(task_id=...)
+        # slices the lines between this pair; the raylet log monitor strips
+        # them from the driver's stdout mirror (name goes last — it may
+        # contain spaces)
+        marker = f"task_id={task_id.hex()} attempt={attempt} name={name}"
+        print(f"::task_begin {marker}", flush=True)
         try:
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
@@ -257,6 +264,7 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001
             return TaskError(e, name, traceback.format_exc()), True
         finally:
+            print(f"::task_end {marker}", flush=True)
             self.core._task_ctx.task_id = token_tid
             self.core._task_ctx.task_name = token_name
             self.core._task_ctx.trace_id = token_trace
@@ -414,7 +422,8 @@ class TaskExecutor:
         else:
             exec_t0 = time.perf_counter()
             value, is_exc = self._run(
-                fn, args, kwargs, task_id, spec["name"], trace=spec.get("trace")
+                fn, args, kwargs, task_id, spec["name"], trace=spec.get("trace"),
+                attempt=spec.get("attempt", 0),
             )
             internal_metrics.inc(
                 "ray_tpu_tasks_executed_total", tags={"kind": "normal"}
@@ -468,7 +477,7 @@ class TaskExecutor:
                 exec_t0 = time.perf_counter()
                 value, is_exc = self._run(
                     method, args, kwargs, task_id, spec["name"], loop=loop,
-                    trace=spec.get("trace"),
+                    trace=spec.get("trace"), attempt=spec.get("attempt", 0),
                 )
                 internal_metrics.inc(
                     "ray_tpu_tasks_executed_total", tags={"kind": "actor"}

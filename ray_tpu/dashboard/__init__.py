@@ -10,8 +10,14 @@ APIs:
   GET /api/nodes | /api/actors | /api/tasks | /api/jobs | /api/objects
       /api/placement_groups | /api/summary | /api/cluster
   GET /api/events        (structured cluster event log)
+  GET /api/logs          (local session logs; ?all=1 or ?node=<hex>
+                          [&file=<name>&tail=N] reaches any node through
+                          the raylet log plane)
+  GET /api/stack         (all-workers stack report via dump_stacks)
   GET /metrics           (Prometheus exposition)
   GET /events            (event log view)
+  GET /logs              (cluster log browser)
+  GET /logs/{node}/{file} (one log file, auto-refreshing tail)
   GET /                  (the UI)
 """
 
@@ -149,6 +155,79 @@ async function refresh(){
   }
 }
 refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_LOGS_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu logs</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1rem;font-family:monospace}
+ table{border-collapse:collapse;background:#fff}
+ th,td{border:1px solid #ddd;padding:.3rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} a{text-decoration:none}
+ .err{color:#c0232c}
+</style></head><body>
+<h1>cluster logs <a href="/" style="font-size:.8rem">dashboard</a></h1>
+<div id="out">loading…</div>
+<script>
+async function refresh(){
+  try{
+    const data = await (await fetch('/api/logs?all=1')).json();
+    let h = '';
+    for(const nid of Object.keys(data.nodes||{}).sort()){
+      h += `<h2>node ${nid.slice(0,12)}</h2><table>`+
+           '<tr><th>file</th><th>size</th></tr>';
+      for(const f of data.nodes[nid])
+        h += `<tr><td><a href="/logs/${nid}/${encodeURIComponent(f.filename)}">`+
+             `${f.filename}</a></td><td>${f.size}</td></tr>`;
+      h += '</table>';
+    }
+    for(const e of (data.errors||[]))
+      h += `<div class="err">node ${e.node_id.slice(0,12)} unreachable: `+
+           `${e.error}</div>`;
+    document.getElementById('out').innerHTML = h || '<em>no logs</em>';
+  }catch(e){
+    document.getElementById('out').textContent = 'failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>"""
+
+_LOG_VIEW_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu log</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.1rem;font-family:monospace}
+ pre{background:#fff;border:1px solid #ddd;padding:.8rem;font-size:.8rem;
+     overflow:auto;max-height:80vh;white-space:pre-wrap}
+ #meta{color:#888;font-size:.8rem}
+</style></head><body>
+<h1 id="title"><a href="/logs" style="font-size:.8rem">logs</a></h1>
+<label><input type="checkbox" id="follow" checked> follow</label>
+<span id="meta"></span>
+<pre id="text">loading…</pre>
+<script>
+const parts = location.pathname.split('/').filter(Boolean); // logs/node/file
+const node = parts[1], file = decodeURIComponent(parts.slice(2).join('/'));
+document.getElementById('title').innerHTML =
+  `<a href="/logs" style="font-size:.8rem">logs</a> / ${node.slice(0,12)} / ${file}`;
+async function refresh(){
+  try{
+    const url = `/api/logs?node=${node}&file=${encodeURIComponent(file)}&tail=2000`;
+    const data = await (await fetch(url)).json();
+    if(data.error){ document.getElementById('text').textContent = data.error; return; }
+    const el = document.getElementById('text');
+    el.textContent = data.text;
+    document.getElementById('meta').textContent =
+      ` updated ${new Date().toLocaleTimeString()}`;
+    if(document.getElementById('follow').checked) el.scrollTop = el.scrollHeight;
+  }catch(e){
+    document.getElementById('meta').textContent = ' failed: '+e;
+  }
+}
+refresh();
+setInterval(()=>{ if(document.getElementById('follow').checked) refresh(); }, 2000);
 </script></body></html>"""
 
 
@@ -347,6 +426,42 @@ class DashboardServer:
             "text": data.decode("utf-8", "replace"),
         }
 
+    def _cluster_logs(self, query: str):
+        """Cluster-wide log listing/read through the raylet log plane
+        (``?all=1`` | ``?node=<hex>`` | ``?node=<hex>&file=<name>&tail=N``);
+        the query-less legacy mode serves this head's local session dir."""
+        from urllib.parse import parse_qs, unquote
+
+        q = parse_qs(query)
+        node = unquote((q.get("node") or [""])[0])
+        rel = unquote((q.get("file") or [""])[0])
+        if node and rel:
+            tail = int((q.get("tail") or ["1000"])[0])
+            try:
+                lines = list(
+                    self._state.get_log(
+                        node_id=node, filename=rel, tail=tail,
+                        address=self.gcs_address,
+                    )
+                )
+            except (ValueError, RuntimeError) as e:
+                return {"error": str(e)}
+            return {
+                "node": node,
+                "file": rel,
+                "text": "".join(line + "\n" for line in lines),
+            }
+        try:
+            listing = self._state.list_logs(
+                node_id=node or None, address=self.gcs_address
+            )
+        except ValueError as e:
+            return {"error": str(e)}
+        return {
+            "nodes": dict(listing),
+            "errors": getattr(listing, "errors", []),
+        }
+
     def _route(self, path: str):
         a = self.gcs_address
         s = self._state
@@ -367,6 +482,10 @@ class DashboardServer:
                 return b"", "text/plain"
         if base0 == "/events":
             return _EVENTS_PAGE.encode(), "text/html; charset=utf-8"
+        if base0 == "/logs":
+            return _LOGS_PAGE.encode(), "text/html; charset=utf-8"
+        if base0.startswith("/logs/"):
+            return _LOG_VIEW_PAGE.encode(), "text/html; charset=utf-8"
         routes = {
             "/api/nodes": lambda: s.list_nodes(address=a),
             "/api/actors": lambda: s.list_actors(address=a),
@@ -376,6 +495,7 @@ class DashboardServer:
             "/api/placement_groups": lambda: s.list_placement_groups(address=a),
             "/api/summary": lambda: s.summarize_tasks(address=a),
             "/api/cluster": lambda: self._cluster_overview(),
+            "/api/stack": lambda: s.dump_stacks(address=a),
         }
         base, _, query = path.partition("?")
         if base == "/api/events":
@@ -395,6 +515,11 @@ class DashboardServer:
                 "application/json",
             )
         if base == "/api/logs":
+            if "node=" in query or "all=" in query:
+                return (
+                    json.dumps(_to_jsonable(self._cluster_logs(query))).encode(),
+                    "application/json",
+                )
             if "file=" in query:
                 return (
                     json.dumps(self._tail_log(query)).encode(),

@@ -69,6 +69,30 @@ class JobSupervisor:
             pickle.dumps({"status": status, "message": message, "ts": time.time()}),
         )
 
+    def _open_job_log(self):
+        """Create ``job-<submission_id>.log`` in this node's session log dir
+        and register its location in KV so clients stream it through the
+        cluster log plane. Returns the open file (or None when this process
+        has no session dir — then logs fall back to KV buffering)."""
+        import pickle
+
+        session_dir = os.environ.get("RAYTPU_SESSION_DIR")
+        node_hex = os.environ.get("RAYTPU_NODE_ID", "")
+        if not session_dir or not node_hex:
+            return None
+        log_dir = os.path.join(session_dir, "logs", node_hex[:12])
+        filename = f"job-{self.submission_id}.log"
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            f = open(os.path.join(log_dir, filename), "ab")
+        except OSError:
+            return None
+        self._kv_put(
+            "logmeta",
+            pickle.dumps({"node_id": node_hex, "filename": filename}),
+        )
+        return f
+
     def run(self) -> str:
         """Blocking: returns the terminal status."""
         env = dict(os.environ)
@@ -77,6 +101,7 @@ class JobSupervisor:
         # the job driver must not inherit this worker's claim on the chip
         env.pop("JAX_PLATFORMS", None)
         self._set_status(JobStatus.RUNNING)
+        log_file = self._open_job_log()
         try:
             self.proc = subprocess.Popen(
                 self.entrypoint,
@@ -89,15 +114,28 @@ class JobSupervisor:
                 # the whole tree, not just the `sh -c` wrapper
             )
         except OSError as e:
+            if log_file is not None:
+                log_file.close()
             self._set_status(JobStatus.FAILED, f"spawn failed: {e}")
             return JobStatus.FAILED
         chunks: List[bytes] = []
-        for line in self.proc.stdout:
-            chunks.append(line)
-            if len(chunks) % 20 == 0:
-                self._kv_put("logs", b"".join(chunks))
+        try:
+            for line in self.proc.stdout:
+                if log_file is not None:
+                    # the log plane serves (and follows) this file; flush per
+                    # line so a follow stream sees output promptly
+                    log_file.write(line)
+                    log_file.flush()
+                else:
+                    chunks.append(line)
+                    if len(chunks) % 20 == 0:
+                        self._kv_put("logs", b"".join(chunks))
+        finally:
+            if log_file is not None:
+                log_file.close()
         self.proc.wait()
-        self._kv_put("logs", b"".join(chunks))
+        if log_file is None:
+            self._kv_put("logs", b"".join(chunks))
         if self._stop.is_set():
             status = JobStatus.STOPPED
         elif self.proc.returncode == 0:
@@ -211,9 +249,95 @@ class JobSubmissionClient:
         info.update(pickle.loads(status) if status else {})
         return info
 
+    def _log_location(self, submission_id: str) -> Optional[Dict[str, str]]:
+        import pickle
+
+        raw = self._kv_get(submission_id, "logmeta")
+        return pickle.loads(raw) if raw is not None else None
+
     def get_job_logs(self, submission_id: str) -> str:
+        """The job's full output so far: read live through the cluster log
+        plane from the node running the supervisor; the pre-log-plane KV
+        buffer is the fallback."""
+        meta = self._log_location(submission_id)
+        if meta is not None:
+            from ray_tpu.util import state as state_api
+
+            try:
+                lines = list(
+                    state_api.get_log(
+                        node_id=meta["node_id"], filename=meta["filename"],
+                        tail=-1,
+                    )
+                )
+                return "".join(line + "\n" for line in lines)
+            except Exception:  # noqa: BLE001 - node gone: fall back to KV
+                pass
         raw = self._kv_get(submission_id, "logs")
         return (raw or b"").decode(errors="replace")
+
+    def tail_job_logs(
+        self, submission_id: str, *, timeout: float = 600.0, poll_s: float = 0.2
+    ):
+        """Yield the job's output lines as they are produced (the SDK's
+        ``follow=True`` streaming, reference: JobSubmissionClient.tail_job_logs).
+        Returns once the job reaches a terminal status and the log is fully
+        drained."""
+        from ray_tpu.util import state as state_api
+
+        deadline = time.monotonic() + timeout
+        meta = None
+        while meta is None:
+            meta = self._log_location(submission_id)
+            if meta is not None:
+                break
+            if self.get_job_status(submission_id) in JobStatus.TERMINAL:
+                # terminal before a log file existed (spawn failure or a
+                # supervisor without a session dir): replay the KV copy
+                for line in self.get_job_logs(submission_id).splitlines():
+                    yield line
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {submission_id} produced no log within {timeout}s"
+                )
+            time.sleep(poll_s)
+        offset = 0
+        buf = b""
+        terminal = False
+        while True:
+            chunk = state_api.read_log_chunk(
+                node_id=meta["node_id"],
+                filename=meta["filename"],
+                offset=offset,
+                follow=not terminal,
+                timeout_s=1.0,
+            )
+            if chunk.get("error"):
+                if self.get_job_status(submission_id) in JobStatus.TERMINAL:
+                    return
+                time.sleep(poll_s)
+                continue
+            offset = chunk["next_offset"]
+            buf += chunk["data"]
+            while b"\n" in buf:
+                raw, buf = buf.split(b"\n", 1)
+                yield raw.decode(errors="replace")
+            if chunk.get("eof"):
+                if terminal:
+                    if buf:
+                        yield buf.decode(errors="replace")
+                    return
+                # every write strictly precedes the terminal status, so one
+                # more (non-follow) read after observing it drains anything
+                # written between this read and the status check
+                terminal = (
+                    self.get_job_status(submission_id) in JobStatus.TERMINAL
+                )
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {submission_id} still streaming after {timeout}s"
+                )
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         keys = self._worker.core.gcs.call("kv_keys", (_NS, ""))

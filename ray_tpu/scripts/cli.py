@@ -1,4 +1,4 @@
-"""`ray_tpu` CLI: start/stop/status/list/timeline/submit.
+"""`ray_tpu` CLI: start/stop/status/list/logs/stack/timeline/submit.
 
 The `ray start/stop/...` equivalent (reference: python/ray/scripts/
 scripts.py:529 start, util/state/state_cli.py, job submission CLI).
@@ -212,21 +212,81 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_logs(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    address = _head_address(args.address)
+    targets = [bool(args.task), bool(args.actor), bool(args.file)]
+    if sum(targets) > 1:
+        sys.exit("pass exactly one of --task, --actor, or --node + -f/--file")
+    try:
+        if args.task or args.actor or args.file:
+            if args.file and not args.node:
+                sys.exit("-f/--file needs --node (which node holds the file)")
+            lines = state_api.get_log(
+                node_id=args.node,
+                filename=args.file,
+                task_id=args.task,
+                actor_id=args.actor,
+                tail=args.tail,
+                follow=args.follow,
+                address=address,
+            )
+            try:
+                for line in lines:
+                    print(line, flush=args.follow)
+            except KeyboardInterrupt:
+                pass  # ^C ends a --follow stream cleanly
+            return 0
+        # no file/task/actor: list log files (one node or the whole cluster)
+        listing = state_api.list_logs(node_id=args.node, address=address)
+        for nid in sorted(listing):
+            print(f"=== node {nid[:12]} ===")
+            for f in listing[nid]:
+                print(f"  {f['filename']}  {f['size']} bytes")
+        for err in getattr(listing, "errors", ()):
+            print(f"!! node {err['node_id'][:12]} unreachable: {err['error']}")
+        return 0
+    except (ValueError, RuntimeError) as e:
+        sys.exit(str(e))
+
+
+def cmd_stack(args) -> int:
+    from ray_tpu.util import state as state_api
+
+    report = state_api.dump_stacks(address=_head_address(args.address))
+    print(state_api.format_stack_report(report))
+    for err in getattr(report, "errors", ()):
+        print(f"!! node {err['node_id'][:12]} unreachable: {err['error']}")
+    return 0
+
+
 def cmd_submit(args) -> int:
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
 
     client = JobSubmissionClient(_head_address(args.address))
+    # argparse.REMAINDER keeps the "--" separator itself; the shell would
+    # reject it as an illegal option
+    entrypoint = args.entrypoint
+    if entrypoint and entrypoint[0] == "--":
+        entrypoint = entrypoint[1:]
     sid = client.submit_job(
-        entrypoint=" ".join(args.entrypoint),
+        entrypoint=" ".join(entrypoint),
         runtime_env={"env_vars": dict(kv.split("=", 1) for kv in args.env)},
     )
     print(f"submitted {sid}")
     if args.no_wait:
         print("not waiting (--no-wait); the job dies with this cluster connection")
         return 0
+    # stream the job's output live through the log plane instead of
+    # buffering it all and printing at exit
+    try:
+        for line in client.tail_job_logs(sid, timeout=args.timeout):
+            print(line, flush=True)
+    except KeyboardInterrupt:
+        return 130
     status = client.wait_until_finish(sid, timeout=args.timeout)
     print(f"status: {status}")
-    print(client.get_job_logs(sid), end="")
     return 0 if status == JobStatus.SUCCEEDED else 1
 
 
@@ -311,6 +371,34 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("summary", help="task counts by name and state")
     s.add_argument("--address")
     s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser(
+        "logs",
+        help="list or fetch cluster log files",
+        description="List every node's log files, stream one file "
+        "(--node NODE -f FILE [--follow]), or slice exactly one task's "
+        "output (--task TASK_ID) from whichever node ran it.",
+    )
+    s.add_argument("--address")
+    s.add_argument("--node", help="node id (hex prefix ok)")
+    s.add_argument("-f", "--file", help="log filename on --node")
+    s.add_argument("--task", help="task id: print only that task's output")
+    s.add_argument("--actor", help="actor id: print its worker's log")
+    s.add_argument("--tail", type=int, default=1000,
+                   help="start N lines from the end (-1 = whole file)")
+    s.add_argument("--follow", action="store_true",
+                   help="keep streaming appended lines (Ctrl-C to stop)")
+    s.set_defaults(fn=cmd_logs)
+
+    s = sub.add_parser(
+        "stack",
+        help="dump python stacks of every alive worker",
+        description="One-shot all-workers stack report: fans the per-worker "
+        "profile RPC out through every alive raylet (the `ray stack` "
+        "equivalent).",
+    )
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_stack)
 
     s = sub.add_parser("timeline", help="dump a chrome-tracing profile")
     s.add_argument("--output", default="timeline.json")

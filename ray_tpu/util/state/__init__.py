@@ -13,24 +13,32 @@ tools) or defaults to the connected driver's GCS.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from collections import Counter, defaultdict
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "profile_actor",
     "folded_to_text",
+    "dump_stacks",
+    "format_stack_report",
+    "get_log",
     "list_actors",
     "list_cluster_events",
     "list_jobs",
+    "list_logs",
     "list_nodes",
     "list_objects",
     "list_placement_groups",
     "list_tasks",
+    "read_log_chunk",
     "summarize_tasks",
     "timeline",
 ]
+
+logger = logging.getLogger(__name__)
 
 
 _client_cache: Dict[str, Any] = {}
@@ -142,17 +150,12 @@ def list_placement_groups(*, address: Optional[str] = None) -> List[Dict[str, An
     return list(table.values()) if isinstance(table, dict) else table
 
 
-def list_tasks(
-    *,
-    address: Optional[str] = None,
-    detail: bool = False,
-) -> List[Dict[str, Any]]:
-    """One row per task. Events arrive from different processes (RUNNING
-    from the executor, FINISHED from the owner) so GCS arrival order is not
-    lifecycle order: the furthest lifecycle stage wins, timestamp breaks
-    ties."""
+def _latest_task_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Collapse raw task events into one row per task. Events arrive from
+    different processes (RUNNING from the executor, FINISHED from the owner)
+    so GCS arrival order is not lifecycle order: the furthest lifecycle
+    stage wins, timestamp breaks ties."""
     rank = {"PENDING_ARGS_AVAIL": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
-    events = _gcs_call("get_task_events", address=address)
     latest: Dict[str, Dict[str, Any]] = {}
     first_ts: Dict[str, float] = {}
     for ev in events:
@@ -164,24 +167,65 @@ def list_tasks(
             ev["ts"],
         ) >= (rank.get(cur["state"], 1), cur["ts"]):
             latest[tid] = ev
-    rows = []
-    for tid, ev in latest.items():
-        row = {
+    return [
+        {
             "task_id": tid,
             "name": ev["name"],
             "state": ev["state"],
             "start_ts": first_ts[tid],
             "worker_id": ev.get("worker_id"),
+            "last_ts": ev["ts"],
         }
-        if detail:
-            row["last_ts"] = ev["ts"]
-        rows.append(row)
+        for tid, ev in latest.items()
+    ]
+
+
+def list_tasks(
+    *,
+    address: Optional[str] = None,
+    detail: bool = False,
+) -> List[Dict[str, Any]]:
+    """One row per task, collapsed from the GCS task-event stream."""
+    events = _gcs_call("get_task_events", address=address)
+    rows = _latest_task_rows(events)
+    if not detail:
+        for row in rows:
+            row.pop("last_ts", None)
     return rows
 
 
+class StateListResult(list):
+    """A plain list of rows plus an ``errors`` attribute: one entry per node
+    whose raylet could not be reached, so callers can tell a partial listing
+    from a genuinely empty one."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.errors: List[Dict[str, str]] = []
+
+
+#: nodes already warned about once (avoid a log line per 2s dashboard poll)
+_node_error_warned: set = set()
+
+
+def _record_node_error(errors: List[Dict[str, str]], api: str,
+                       node_hex: str, exc: Exception) -> None:
+    errors.append({"node_id": node_hex, "error": repr(exc)})
+    from ray_tpu._private import internal_metrics
+
+    internal_metrics.inc("ray_tpu_state_api_node_errors", tags={"api": api})
+    if node_hex not in _node_error_warned:
+        _node_error_warned.add(node_hex)
+        logger.warning(
+            "%s: raylet on node %s unreachable (%r); results are partial",
+            api, node_hex[:12], exc,
+        )
+
+
 def list_objects(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Aggregate every raylet's plasma inventory."""
-    rows: List[Dict[str, Any]] = []
+    """Aggregate every raylet's plasma inventory. Returns a list with an
+    ``errors`` attribute naming nodes that failed mid-listing."""
+    rows = StateListResult()
     for node in list_nodes(address=address):
         if not node.get("alive"):
             continue
@@ -190,19 +234,48 @@ def list_objects(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
             for obj in _cached_client(raylet_addr).call("store_list", timeout=10.0):
                 obj["node_id"] = node["node_id"].hex()
                 rows.append(obj)
-        except Exception:
-            pass  # node died mid-listing: skip it
+        except Exception as e:  # noqa: BLE001 - node died mid-listing
+            _record_node_error(rows.errors, "list_objects", node["node_id"].hex(), e)
     return rows
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
 def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, Any]:
-    """Counts by (name, state) — the `ray summary tasks` equivalent."""
+    """Counts by (name, state) — the `ray summary tasks` equivalent — plus
+    per-name execution duration stats (count / mean / p50 / p95 seconds)
+    computed from RUNNING→FINISHED event pairs."""
+    events = _gcs_call("get_task_events", address=address)
     by_name: Dict[str, Counter] = defaultdict(Counter)
-    for row in list_tasks(address=address):
+    for row in _latest_task_rows(events):
         by_name[row["name"]][row["state"]] += 1
-    return {
-        name: dict(states) for name, states in sorted(by_name.items())
-    }
+    starts: Dict[str, Dict[str, Any]] = {}
+    durations: Dict[str, List[float]] = defaultdict(list)
+    for ev in sorted(events, key=lambda e: e["ts"]):
+        if ev["state"] == "RUNNING":
+            starts[ev["task_id"]] = ev
+        elif ev["state"] == "FINISHED" and ev["task_id"] in starts:
+            start = starts.pop(ev["task_id"])
+            durations[start["name"]].append(max(0.0, ev["ts"] - start["ts"]))
+    out: Dict[str, Any] = {}
+    for name, states in sorted(by_name.items()):
+        entry: Dict[str, Any] = dict(states)
+        durs = sorted(durations.get(name, ()))
+        if durs:
+            entry["duration"] = {
+                "count": len(durs),
+                "mean_s": sum(durs) / len(durs),
+                "p50_s": _percentile(durs, 0.50),
+                "p95_s": _percentile(durs, 0.95),
+            }
+        out[name] = entry
+    return out
 
 
 def list_cluster_events(
@@ -235,7 +308,9 @@ def timeline(
 
     One ``pid`` lane per node, one ``tid`` row per worker.
     RUNNING→FINISHED/FAILED event pairs become complete ("X") slices on the
-    executing worker's row; unpaired events become instants.
+    executing worker's row; tasks still in flight become open ("B") begin
+    events so a live cluster shows current work; other unpaired events
+    become instants.
     """
     events = _gcs_call("get_task_events", address=address)
     # GCS arrival order mixes processes; wall-clock order (same host /
@@ -284,6 +359,23 @@ def timeline(
                     "s": "t",
                 }
             )
+    # still-RUNNING tasks (no FINISHED/FAILED yet): open "B" begin events on
+    # their worker's lane — paired-only "X" slices would make a live
+    # cluster's current work invisible
+    for tid, start in running.items():
+        pid, lane = _lanes(start)
+        lanes_seen.setdefault((pid, lane))
+        trace.append(
+            {
+                "name": start["name"],
+                "cat": "task",
+                "ph": "B",
+                "ts": start["ts"] * 1e6,
+                "pid": pid,
+                "tid": lane,
+                "args": {"task_id": tid, "state": "RUNNING"},
+            }
+        )
     # metadata records name the lanes in trace viewers
     for pid, lane in lanes_seen:
         trace.append(
@@ -294,3 +386,254 @@ def timeline(
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+# ----------------------------------------------------------------------
+# log plane: list_logs / get_log / dump_stacks (reference: `ray logs`,
+# `ray stack`, python/ray/util/state/api.py get_log streaming from the
+# agent on the owning node)
+# ----------------------------------------------------------------------
+
+
+def _id_hex(value: Any) -> str:
+    """Accept an ID object, bytes, or hex string (full or prefix)."""
+    if value is None:
+        return ""
+    if hasattr(value, "hex") and not isinstance(value, str):
+        h = value.hex
+        return h() if callable(h) else h
+    return str(value)
+
+
+def _find_node(node_id: Any, address: Optional[str]) -> Dict[str, Any]:
+    """Resolve a node id (hex prefix ok) to its GCS node row."""
+    want = _id_hex(node_id)
+    for node in list_nodes(address=address):
+        if node.get("alive") and node["node_id"].hex().startswith(want):
+            return node
+    raise ValueError(f"no alive node with id {want!r}")
+
+
+def list_logs(
+    *, node_id: Any = None, address: Optional[str] = None
+) -> Dict[str, List[Dict[str, Any]]]:
+    """Enumerate log files cluster-wide (or on one node): a dict of node id
+    hex -> [{"filename", "size", "mtime"}, ...]. The result carries an
+    ``errors`` attribute like :func:`list_objects`."""
+    want = _id_hex(node_id) if node_id is not None else None
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    errors: List[Dict[str, str]] = []
+    for node in list_nodes(address=address):
+        nid = node["node_id"].hex()
+        if not node.get("alive"):
+            continue
+        if want is not None and not nid.startswith(want):
+            continue
+        raylet_addr = "{}:{}".format(*node["address"])
+        try:
+            listing = _cached_client(raylet_addr).call("list_logs", timeout=10.0)
+            out[nid] = listing["files"]
+        except Exception as e:  # noqa: BLE001
+            _record_node_error(errors, "list_logs", nid, e)
+    if want is not None and not out and not errors:
+        raise ValueError(f"no alive node with id {want!r}")
+
+    class _Listing(dict):
+        pass
+
+    result = _Listing(out)
+    result.errors = errors
+    return result
+
+
+def read_log_chunk(
+    *,
+    node_id: Any,
+    filename: str,
+    offset: Optional[int] = None,
+    max_bytes: int = 1 << 20,
+    tail_lines: Optional[int] = None,
+    follow: bool = False,
+    timeout_s: float = 10.0,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One byte-ranged read against the raylet owning ``filename``. The
+    building block under :func:`get_log`; ``follow=True`` long-polls until
+    bytes exist past ``offset``. Returns the raylet's reply dict
+    (``data``/``next_offset``/``eof`` or ``error``)."""
+    node = _find_node(node_id, address)
+    raylet_addr = "{}:{}".format(*node["address"])
+    payload: Dict[str, Any] = {
+        "filename": filename,
+        "max_bytes": max_bytes,
+        "follow": follow,
+        "timeout_s": timeout_s,
+    }
+    if offset is not None:
+        payload["offset"] = offset
+    if tail_lines is not None:
+        payload["tail_lines"] = tail_lines
+    return _cached_client(raylet_addr).call(
+        "read_log", payload, timeout=timeout_s + 30.0
+    )
+
+
+def _locate_worker_log(
+    task_id: Any, actor_id: Any, address: Optional[str]
+) -> Tuple[str, str, Optional[str]]:
+    """(node_id_hex, filename, task_id_hex_or_None) for a task/actor id."""
+    if task_id is not None:
+        loc = _gcs_call(
+            "locate_worker", {"task_id": _id_hex(task_id)}, address=address
+        )
+        if loc is None:
+            raise ValueError(
+                f"task {_id_hex(task_id)!r} has not (yet) run on any worker "
+                "— no RUNNING event in the GCS"
+            )
+        return (
+            loc["node_id"],
+            f"worker-{loc['worker_id'][:12]}.log",
+            loc["task_id"],
+        )
+    loc = _gcs_call(
+        "locate_worker", {"actor_id": _id_hex(actor_id)}, address=address
+    )
+    if loc is None:
+        raise ValueError(f"actor {_id_hex(actor_id)!r} has no live worker")
+    return loc["node_id"], f"worker-{loc['worker_id'][:12]}.log", None
+
+
+def get_log(
+    *,
+    node_id: Any = None,
+    filename: Optional[str] = None,
+    task_id: Any = None,
+    actor_id: Any = None,
+    tail: int = 1000,
+    follow: bool = False,
+    timeout_s: float = 10.0,
+    address: Optional[str] = None,
+) -> Iterator[str]:
+    """Stream a log file's lines from whichever node holds it.
+
+    Exactly one target: ``node_id`` + ``filename``, or ``task_id`` (slices
+    the lines between that task's ``::task_begin``/``::task_end`` markers in
+    its worker's log), or ``actor_id`` (its worker's whole log). ``tail=N``
+    starts N lines from the end (-1 = whole file); ``follow=True`` keeps the
+    iterator open, yielding lines as they are appended (break to stop)."""
+    task_filter: Optional[str] = None
+    if task_id is not None or actor_id is not None:
+        if filename is not None:
+            raise ValueError("pass filename OR task_id/actor_id, not both")
+        node_id, filename, task_filter = _locate_worker_log(
+            task_id, actor_id, address
+        )
+    elif filename is None:
+        raise ValueError("get_log needs node_id+filename, task_id, or actor_id")
+    elif node_id is None:
+        raise ValueError("get_log(filename=...) needs node_id")
+
+    def _stream() -> Iterator[str]:
+        # marker slicing needs the whole file; plain tail is served
+        # server-side on the first chunk
+        offset: Optional[int] = 0 if (task_filter or tail < 0) else None
+        buf = b""
+        in_task = False
+        while True:
+            chunk = read_log_chunk(
+                node_id=node_id,
+                filename=filename,
+                offset=offset,
+                tail_lines=tail if offset is None else None,
+                follow=follow,
+                timeout_s=timeout_s,
+                address=address,
+            )
+            if chunk.get("error"):
+                raise RuntimeError(chunk["error"])
+            offset = chunk["next_offset"]
+            buf += chunk["data"]
+            while b"\n" in buf:
+                raw, buf = buf.split(b"\n", 1)
+                line = raw.decode("utf-8", errors="replace")
+                if line.startswith("::task_"):
+                    # boundary markers are machine-readable metadata: they
+                    # drive task slicing but never surface as output
+                    if task_filter is not None and f"task_id={task_filter} " in line:
+                        in_task = line.startswith("::task_begin ")
+                    continue
+                if task_filter is not None and not in_task:
+                    continue
+                yield line
+            if chunk.get("eof") and not follow:
+                if buf:  # unterminated final line
+                    line = buf.decode("utf-8", errors="replace")
+                    if not line.startswith("::task_") and (
+                        task_filter is None or in_task
+                    ):
+                        yield line
+                return
+
+    lines = _stream()
+    if not follow and task_filter is not None and tail >= 0:
+        return iter(list(lines)[-tail:])
+    return lines
+
+
+def dump_stacks(
+    *,
+    duration_s: float = 0.05,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One-shot all-workers stack report (the `ray stack` equivalent): fan
+    the per-worker ``profile`` RPC out through every alive raylet. Returns
+    ``{node_id_hex: {worker_id_hex: {"pid", "folded"} | {"error"}}}`` plus
+    an ``errors`` attribute for unreachable nodes."""
+    report: Dict[str, Any] = {}
+    errors: List[Dict[str, str]] = []
+    for node in list_nodes(address=address):
+        if not node.get("alive"):
+            continue
+        nid = node["node_id"].hex()
+        raylet_addr = "{}:{}".format(*node["address"])
+        try:
+            res = _cached_client(raylet_addr).call(
+                "dump_stacks", {"duration_s": duration_s},
+                timeout=duration_s + 30.0,
+            )
+            report[nid] = res["workers"]
+        except Exception as e:  # noqa: BLE001
+            _record_node_error(errors, "dump_stacks", nid, e)
+
+    class _Report(dict):
+        pass
+
+    result = _Report(report)
+    result.errors = errors
+    return result
+
+
+def format_stack_report(report: Dict[str, Any]) -> str:
+    """Render a :func:`dump_stacks` result for terminals: per node, per
+    worker, each sampled stack (most frequent first) one frame per line."""
+    out: List[str] = []
+    for nid in sorted(report):
+        out.append(f"=== node {nid[:12]} ===")
+        workers = report[nid]
+        if not workers:
+            out.append("  (no registered workers)")
+        for wid in sorted(workers):
+            info = workers[wid]
+            if "error" in info:
+                out.append(f"-- worker {wid[:12]}: unreachable ({info['error']})")
+                continue
+            out.append(f"-- worker {wid[:12]} (pid {info.get('pid')}) --")
+            folded = info.get("folded", {})
+            if not folded:
+                out.append("  (no samples)")
+            for stack, count in sorted(folded.items(), key=lambda kv: -kv[1]):
+                out.append(f"  [{count} sample{'s' if count != 1 else ''}]")
+                for frame in stack.split(";"):
+                    out.append(f"    {frame}")
+    return "\n".join(out)

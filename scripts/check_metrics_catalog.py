@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""Static drift check: every ``ray_tpu_*`` metric family registered in
+``ray_tpu/_private/internal_metrics.py``'s CATALOG must be documented in
+README.md.
+
+The README metrics table abbreviates sibling families
+(`` `ray_tpu_tasks_submitted_total` / `_finished_total` ``), so a family
+counts as documented when its full name appears literally, OR when some
+line contains a `` `_suffix` `` shorthand that completes another
+``ray_tpu_*`` name on the same line into this family
+(``ray_tpu_tasks_`` + ``finished_total``).
+
+Parses both files textually — no ray_tpu import, so the check runs in any
+interpreter in milliseconds. Exits non-zero on drift (undocumented
+families), listing each offender.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CATALOG_PATH = REPO / "ray_tpu" / "_private" / "internal_metrics.py"
+README_PATH = REPO / "README.md"
+
+
+def catalog_families(text: str) -> list:
+    """CATALOG keys, in declaration order: the dict literal's quoted
+    ``ray_tpu_*`` keys (strings elsewhere in the module never sit at the
+    start of a line followed by a colon)."""
+    return re.findall(r'^\s*"(ray_tpu_\w+)":', text, flags=re.MULTILINE)
+
+
+def documented(name: str, readme: str, lines: list) -> bool:
+    if name in readme:
+        return True
+    for line in lines:
+        bases = re.findall(r"`(ray_tpu_\w+)`", line)
+        if not bases:
+            continue
+        for shorthand in re.findall(r"`(_\w+)`", line):
+            suffix = shorthand  # includes the leading underscore
+            if not name.endswith(suffix):
+                continue
+            prefix = name[: -len(suffix)]
+            if any(b.startswith(prefix) for b in bases):
+                return True
+    return False
+
+
+def main() -> int:
+    catalog_text = CATALOG_PATH.read_text()
+    readme = README_PATH.read_text()
+    lines = readme.splitlines()
+    families = catalog_families(catalog_text)
+    if not families:
+        print(f"check_metrics_catalog: no CATALOG entries found in {CATALOG_PATH}")
+        return 2
+    missing = [f for f in families if not documented(f, readme, lines)]
+    if missing:
+        print("check_metrics_catalog: metric families registered in")
+        print(f"  {CATALOG_PATH.relative_to(REPO)}")
+        print("but not documented in README.md:")
+        for name in missing:
+            print(f"  - {name}")
+        print("add them to the README metrics table (## Observability).")
+        return 1
+    print(
+        f"check_metrics_catalog: OK — {len(families)} families documented"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

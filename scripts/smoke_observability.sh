@@ -4,5 +4,8 @@
 # layer can be verified in ~a minute (CI and pre-PR checks).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+# static drift gate first: every registered ray_tpu_* metric family must be
+# documented in the README before the behavioral smoke runs
+python scripts/check_metrics_catalog.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
-    tests/test_observability.py tests/test_profiling.py "$@"
+    tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py "$@"
