@@ -272,6 +272,7 @@ class CoreWorker:
         import collections as _collections
 
         self._gc_pending: "_collections.deque" = _collections.deque()
+        self._gc_signaled = False  # edge trigger: armed while gc may sleep
         # finalizer->gc-thread wakeup rides a pipe: os.write is a plain
         # syscall, usable from a weakref finalizer with zero lock risk
         # (an Event would deadlock if GC ran a finalizer on the gc thread
@@ -446,56 +447,81 @@ class CoreWorker:
         the plasma-delete RPC it then issued could never be dispatched).
         deque.append is atomic; the pipe write is a raw syscall (EAGAIN
         when full is fine — the gc thread is already awake then); the
-        ref-gc thread does the real work."""
+        ref-gc thread does the real work. Edge-triggered: the write (and
+        the context switch it causes) is skipped while the gc thread is
+        known-awake — at tens of thousands of dropped refs/s on a small
+        host the wakeup churn otherwise costs more than the bookkeeping.
+        A lost race only delays the wakeup to the loop's next drain pass,
+        never loses the ref (the deque is re-checked after re-arming)."""
         self._gc_pending.append(binary)
-        try:
-            os.write(self._gc_w, b"x")
-        except (BlockingIOError, OSError):
-            pass
+        if not self._gc_signaled:
+            self._gc_signaled = True
+            try:
+                os.write(self._gc_w, b"x")
+            except (BlockingIOError, OSError):
+                pass
 
     def _ref_gc_loop(self):
         # event-driven, not polled: hundreds of idle workers each waking
-        # 20x/s to check an empty deque measurably loads a small host
-        import select as _select
+        # 20x/s to check an empty deque measurably loads a small host.
+        # selectors (epoll/poll), never the select() syscall wrapper: that
+        # one is capped at FD_SETSIZE (1024) and a worker that opened >1024
+        # fds before init (sockets, datasets) gets a pipe fd past the cap —
+        # it then raises "filedescriptor out of range" forever and ref gc
+        # dies.
+        import selectors as _selectors
 
-        while not self._shutdown.is_set():
-            try:
-                binary = self._gc_pending.popleft()
-            except IndexError:
+        sel = _selectors.DefaultSelector()
+        try:
+            sel.register(self._gc_r, _selectors.EVENT_READ)
+        except (ValueError, OSError):
+            return  # shutdown closed the pipe before the thread started
+        try:
+            while not self._shutdown.is_set():
                 try:
-                    ready, _, _ = _select.select([self._gc_r], [], [], 5.0)
-                    if ready:
-                        os.read(self._gc_r, 4096)  # drain wakeup bytes
-                except OSError:
-                    pass
-                continue
-            try:
-                to_free = self._process_ref_deleted(binary)
-            except Exception:
-                logger.exception("ref gc failed for %s", binary.hex()[:16])
-                continue
-            if to_free:
-                batch = [to_free]
-                # coalesce: one delete RPC frees every queued plasma object
-                while len(batch) < 256:
-                    try:
-                        nxt = self._gc_pending.popleft()
-                    except IndexError:
-                        break
-                    try:
-                        extra = self._process_ref_deleted(nxt)
-                    except Exception:
-                        logger.exception(
-                            "ref gc failed for %s", nxt.hex()[:16]
-                        )
+                    binary = self._gc_pending.popleft()
+                except IndexError:
+                    # re-arm the edge trigger, then re-check: an append that
+                    # raced the empty popleft (and skipped its write because
+                    # the flag was still set) is picked up here
+                    self._gc_signaled = False
+                    if self._gc_pending:
                         continue
-                    if extra:
-                        batch.append(extra)
+                    try:
+                        if sel.select(5.0):
+                            os.read(self._gc_r, 4096)  # drain wakeup bytes
+                    except OSError:
+                        pass
+                    continue
                 try:
-                    if self.plasma is not None:
-                        self.plasma.delete_batch(batch)
+                    to_free = self._process_ref_deleted(binary)
                 except Exception:
-                    pass
+                    logger.exception("ref gc failed for %s", binary.hex()[:16])
+                    continue
+                if to_free:
+                    batch = [to_free]
+                    # coalesce: one delete RPC frees every queued plasma object
+                    while len(batch) < 256:
+                        try:
+                            nxt = self._gc_pending.popleft()
+                        except IndexError:
+                            break
+                        try:
+                            extra = self._process_ref_deleted(nxt)
+                        except Exception:
+                            logger.exception(
+                                "ref gc failed for %s", nxt.hex()[:16]
+                            )
+                            continue
+                        if extra:
+                            batch.append(extra)
+                    try:
+                        if self.plasma is not None:
+                            self.plasma.delete_batch(batch)
+                    except Exception:
+                        pass
+        finally:
+            sel.close()
 
     def _process_ref_deleted(self, binary: bytes):
         """Local bookkeeping for one dropped ref. Returns the ObjectID when
@@ -545,13 +571,12 @@ class CoreWorker:
             return
         if self.plasma.contains(object_id):
             return
-        size = len(data)
-        try:
-            offset = self.raylet.call("store_create", (object_id, size))
-        except ValueError:
+        # put_wire_bytes takes the co-located local-store fast path (method
+        # calls, not raylet RPCs) and the single-RPC small path — the old
+        # direct store_create/store_seal calls paid two RPC round-trips
+        # even when the store lives in this process
+        if not self.plasma.put_wire_bytes(object_id, data):
             return  # another thread promoted it concurrently
-        self.plasma._view[offset : offset + size] = data
-        self.raylet.call("store_seal", object_id)
         binary = object_id.binary()
         self._promoted.add(binary)
         # Close the seal->mark window (ADVICE r3): if the final local ref
@@ -1989,6 +2014,22 @@ class CoreWorker:
         for _ in self._submitters:
             self._submit_queue.put(None)
         self._pull_pool.shutdown(wait=False)
+        # release the gc pipe (fd audit: init/shutdown cycles in one process
+        # — tests, notebooks — previously leaked both ends every cycle).
+        # Invalidate the fd fields BEFORE closing: a late weakref finalizer
+        # writing to a recycled fd number would corrupt an unrelated file.
+        try:
+            os.write(self._gc_w, b"x")  # wake the gc thread so it exits
+        except OSError:
+            pass
+        self._gc_thread.join(timeout=2.0)
+        gc_r, gc_w = self._gc_r, self._gc_w
+        self._gc_r = self._gc_w = -1
+        for fd in (gc_r, gc_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
         with self._worker_clients_lock:
             for c in self._worker_clients.values():
                 c.close()

@@ -99,13 +99,41 @@ def _sharding_descriptor(arr) -> Optional[dict]:
     return {"kind": "single"}
 
 
+def _shard_writer(shard_data):
+    """Deferred device→host landing: called by SerializedObject.write_to
+    with the shard's reserved slice of the plasma arena as destination —
+    on CPU-backed arrays ``np.asarray`` is a view, so the single copy goes
+    device-buffer→arena; on accelerators the DMA stages through one host
+    array but still lands directly in the reserved region (no pickle-side
+    intermediate)."""
+
+    def write(dest: memoryview) -> None:
+        import numpy as np
+
+        t0 = time.perf_counter()
+        host = np.asarray(shard_data)
+        if not host.flags["C_CONTIGUOUS"]:
+            host = np.ascontiguousarray(host)
+        flat = host.reshape(-1).view(np.uint8)
+        np.copyto(np.frombuffer(dest, np.uint8), flat)
+        _record_transfer("device_to_host", flat.nbytes, time.perf_counter() - t0)
+
+    return write
+
+
 def reduce_jax_array(arr) -> Tuple[Any, tuple]:
     """__reduce__-style entry used by the serializer's reducer_override.
 
-    Returns (rebuild_fn, args) where the shard data rides as
-    pickle.PickleBuffer objects so the protocol-5 buffer_callback lays the
-    raw bytes out-of-band in shm."""
+    Inside an active ``serialization.serialize`` call, each distinct shard
+    becomes an *indexed* LazyBuffer appended to the object's out-of-band
+    buffer list: the device→host transfer is deferred until write_to, so
+    shard bytes land straight in the reserved plasma region (the
+    reserve→serialize-in-place→seal put path). Outside a serialize scope
+    (direct cloudpickle use) shards are captured eagerly as PickleBuffers.
+    """
     import numpy as np
+
+    from ray_tpu._private import serialization
 
     if not arr.is_fully_addressable:
         # cross-host arrays can't be captured from one process; the gang
@@ -120,7 +148,10 @@ def reduce_jax_array(arr) -> Tuple[Any, tuple]:
     )
     shard_meta: List[dict] = []
     buffers: List[pickle.PickleBuffer] = []
+    indices: List[int] = []
+    lazy = serialization.serialize_scope_active()
     seen_indices: set = set()
+    eager_nbytes = 0
     for sh in shards:
         # replicated shards carry identical blocks: serialize each distinct
         # block once (the rebuilder fans blocks back out to every device
@@ -132,15 +163,27 @@ def reduce_jax_array(arr) -> Tuple[Any, tuple]:
         if index_key in seen_indices:
             continue
         seen_indices.add(index_key)
-        host = np.asarray(sh.data)  # one device->host DMA
-        if not host.flags["C_CONTIGUOUS"]:
-            host = np.ascontiguousarray(host)
-        # raw-bytes view: the buffer protocol rejects extension dtypes
-        # (bfloat16/fp8); shape+dtype live in the metadata instead
-        buffers.append(pickle.PickleBuffer(host.reshape(-1).view(np.uint8)))
+        if lazy:
+            shape = tuple(sh.data.shape)
+            indices.append(
+                serialization.append_oob_buffer(
+                    serialization.LazyBuffer(
+                        int(sh.data.nbytes), _shard_writer(sh.data)
+                    )
+                )
+            )
+        else:
+            host = np.asarray(sh.data)  # one device->host DMA
+            if not host.flags["C_CONTIGUOUS"]:
+                host = np.ascontiguousarray(host)
+            shape = host.shape
+            # raw-bytes view: the buffer protocol rejects extension dtypes
+            # (bfloat16/fp8); shape+dtype live in the metadata instead
+            buffers.append(pickle.PickleBuffer(host.reshape(-1).view(np.uint8)))
+            eager_nbytes += host.nbytes
         shard_meta.append(
             {
-                "shape": host.shape,
+                "shape": shape,
                 # index: tuple of slices into the global array
                 "index": tuple(
                     (sl.start, sl.stop, sl.step) for sl in sh.index
@@ -153,10 +196,11 @@ def reduce_jax_array(arr) -> Tuple[Any, tuple]:
         "sharding": _sharding_descriptor(arr),
         "shards": shard_meta,
     }
+    if lazy:
+        # transfers happen (and are metered) at write_to time, per shard
+        return rebuild_jax_array_indexed, (meta, indices)
     _record_transfer(
-        "device_to_host",
-        sum(b.raw().nbytes for b in buffers),
-        time.perf_counter() - transfer_t0,
+        "device_to_host", eager_nbytes, time.perf_counter() - transfer_t0
     )
     return rebuild_jax_array, (meta, buffers)
 
@@ -206,6 +250,17 @@ def _norm_index(idx, shape) -> tuple:
         stop = dim if sl.stop is None else sl.stop
         out.append((start, stop))
     return tuple(out)
+
+
+def rebuild_jax_array_indexed(meta: dict, indices: Sequence[int]):
+    """Rebuild from *indexed* out-of-band buffers: shard views are fetched
+    by absolute position from the object being deserialized (the lazy
+    write-in-place counterpart of rebuild_jax_array)."""
+    from ray_tpu._private import serialization
+
+    return rebuild_jax_array(
+        meta, [serialization.get_indexed_buffer(i) for i in indices]
+    )
 
 
 def rebuild_jax_array(meta: dict, buffers: Sequence[Any]):

@@ -961,10 +961,16 @@ class GcsServer:
             if plan is None:
                 time.sleep(0.2)
                 continue
-            # phase 1: prepare every bundle (atomic reservation per node)
+            # bundles grouped per raylet: ONE prepare/commit RPC per node
+            # instead of one per bundle (batched phase-1/phase-2 — the
+            # per-bundle round-trips dominated pg create/remove latency)
+            by_node: Dict[NodeID, List[int]] = {}
+            for i, node_id in enumerate(plan):
+                by_node.setdefault(node_id, []).append(i)
+            # phase 1: prepare every node's bundles (atomic per node)
             prepared: List[Tuple[int, NodeID]] = []
             ok = True
-            for i, node_id in enumerate(plan):
+            for node_id, idxs in by_node.items():
                 with self._lock:
                     node = self._nodes.get(node_id)
                 if node is None or not node.alive:
@@ -972,14 +978,16 @@ class GcsServer:
                     break
                 try:
                     granted = self._raylet_client(node).call(
-                        "prepare_bundle", (info.pg_id, i, bundles[i]), timeout=10.0
+                        "prepare_bundles",
+                        (info.pg_id, [(i, bundles[i]) for i in idxs]),
+                        timeout=10.0,
                     )
                 except Exception:
                     granted = False
                 if not granted:
                     ok = False
                     break
-                prepared.append((i, node_id))
+                prepared.extend((i, node_id) for i in idxs)
             if not ok:
                 self._release_bundles(info.pg_id, prepared)
                 time.sleep(0.2)
@@ -987,21 +995,22 @@ class GcsServer:
             # phase 2: commit (rollback everything on any failure)
             committed: List[Tuple[int, NodeID]] = []
             commit_ok = True
-            for i, node_id in prepared:
+            for node_id, idxs in by_node.items():
                 with self._lock:
                     node = self._nodes.get(node_id)
                 try:
                     if node is None or not node.alive:
                         raise RuntimeError("node died between prepare and commit")
-                    self._raylet_client(node).call(
-                        "commit_bundle", (info.pg_id, i), timeout=10.0
-                    )
-                    committed.append((i, node_id))
+                    if not self._raylet_client(node).call(
+                        "commit_bundles", (info.pg_id, idxs), timeout=10.0
+                    ):
+                        raise RuntimeError("commit_bundles refused")
+                    committed.extend((i, node_id) for i in idxs)
                 except Exception:
                     logger.warning(
-                        "commit_bundle(%s, %d) failed; rolling back",
+                        "commit_bundles(%s, %s) failed; rolling back",
                         info.pg_id.hex()[:8],
-                        i,
+                        idxs,
                     )
                     commit_ok = False
                     break
@@ -1044,15 +1053,22 @@ class GcsServer:
         self._publish(f"pg:{info.pg_id.hex()}", info.public_view())
 
     def _release_bundles(self, pg_id, assignment: List[Tuple[int, NodeID]]):
+        by_node: Dict[NodeID, List[int]] = {}
         for i, node_id in assignment:
+            by_node.setdefault(node_id, []).append(i)
+        for node_id, idxs in by_node.items():
             with self._lock:
                 node = self._nodes.get(node_id)
             if node is None or not node.alive:
                 continue
             try:
-                self._raylet_client(node).call("return_bundle", (pg_id, i), timeout=10.0)
+                self._raylet_client(node).call(
+                    "return_bundles", (pg_id, idxs), timeout=10.0
+                )
             except Exception:
-                logger.warning("return_bundle(%s, %d) failed", pg_id.hex()[:8], i)
+                logger.warning(
+                    "return_bundles(%s, %s) failed", pg_id.hex()[:8], idxs
+                )
 
     # ------------------------------------------------------------------
     # jobs + task events

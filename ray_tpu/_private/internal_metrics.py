@@ -66,6 +66,10 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "counter", "objects spilled to disk under memory pressure", ()),
     "ray_tpu_object_store_spilled_bytes_total": (
         "counter", "bytes spilled to disk under memory pressure", ()),
+    "ray_tpu_object_store_inplace_writes_total": (
+        "counter",
+        "large puts serialized directly into the reserved plasma region "
+        "(reserve→serialize-in-place→seal path)", ()),
     # -- device plane / collectives -----------------------------------
     "ray_tpu_device_transfer_bytes_total": (
         "counter", "device plane DMA volume", ("direction",)),

@@ -142,6 +142,10 @@ class MemoryStore:
         self._waiters: Dict[ObjectID, List] = {}
 
     def put(self, object_id: ObjectID, data: bytes):
+        # re-wrap: over the co-located fast path the caller's instance would
+        # otherwise be retained as the dict key, pinning the worker-side
+        # weakref finalizer forever and defeating reference gc
+        object_id = ObjectID(object_id.binary())
         with self._cv:
             self._objects[object_id] = data
             self._version += 1
@@ -278,7 +282,7 @@ def _make_arena(capacity: int):
 class _Entry:
     __slots__ = (
         "offset", "size", "sealed", "pin_count", "last_used",
-        "creating_worker", "spill_path", "spill_data",
+        "creating_worker", "spill_path", "spill_data", "delete_pending",
     )
 
     def __init__(self, offset: int, size: int, creating_worker=None):
@@ -286,6 +290,7 @@ class _Entry:
         self.size = size
         self.sealed = False
         self.pin_count = 0
+        self.delete_pending = False
         self.last_used = time.monotonic()
         self.creating_worker = creating_worker
         # spilled state: bytes held in memory until the background flusher
@@ -390,6 +395,10 @@ class PlasmaStore:
     # -- server-side API (called via raylet RPC handlers or locally) --
 
     def create(self, object_id: ObjectID, size: int, creating_worker=None) -> int:
+        # fresh key: never retain the caller's instance (the co-located
+        # dispatch path passes it by reference; holding it would pin the
+        # owner's weakref finalizer and break reference gc)
+        object_id = ObjectID(object_id.binary())
         with self._cv:
             if object_id in self._entries:
                 raise ValueError(f"object {object_id.hex()} already exists")
@@ -475,23 +484,35 @@ class PlasmaStore:
             e = self._entries.get(object_id)
             if e is not None and e.pin_count > 0:
                 e.pin_count -= 1
+                if e.pin_count == 0 and e.delete_pending:
+                    # a delete arrived while a reader held the buffer: the
+                    # last release completes it (otherwise the entry would
+                    # strand — the owner's ref gc only issues delete once)
+                    self._delete_locked(object_id, e)
 
     def delete(self, object_id: ObjectID):
         with self._cv:
             e = self._entries.get(object_id)
-            if e is not None and e.pin_count == 0:
-                self._entries.pop(object_id)
-                if e.resident:
-                    self._arena.free(e.offset)
-                else:
-                    if e.spill_data is not None:
-                        self._spill_pending_bytes -= e.size
-                        e.spill_data = None
-                    if e.spill_path is not None:
-                        try:
-                            os.unlink(e.spill_path)
-                        except OSError:
-                            pass
+            if e is None:
+                return
+            if e.pin_count > 0:
+                e.delete_pending = True  # completed by the last release()
+                return
+            self._delete_locked(object_id, e)
+
+    def _delete_locked(self, object_id: ObjectID, e: _Entry):
+        self._entries.pop(object_id)
+        if e.resident:
+            self._arena.free(e.offset)
+        else:
+            if e.spill_data is not None:
+                self._spill_pending_bytes -= e.size
+                e.spill_data = None
+            if e.spill_path is not None:
+                try:
+                    os.unlink(e.spill_path)
+                except OSError:
+                    pass
 
     def _evict_locked(self, needed: int):
         """Free ``needed`` bytes: spill unpinned sealed objects to disk when
@@ -583,9 +604,10 @@ class PlasmaStore:
         else:
             # cold path: the object was flushed to disk. The read happens
             # under the lock — bounded by the object's size; the common
-            # (recently-spilled) case is the memcpy branch above.
+            # (recently-spilled) case is the memcpy branch above. readinto
+            # lands file bytes straight in the arena (no intermediate bytes).
             with open(e.spill_path, "rb") as f:
-                self._view[offset : offset + e.size] = f.read()
+                f.readinto(self._view[offset : offset + e.size])
             try:
                 os.unlink(e.spill_path)
             except OSError:
@@ -683,6 +705,13 @@ class PlasmaClient:
     connection; methods are ``store_create/store_seal/...``.
     """
 
+    #: client-side PTE-population granularity. PTEs are per-mapping: the
+    #: raylet's background prefault does not warm THIS process's mapping,
+    #: and the per-put madvise costs ~5 ms per 64 MB even on populated
+    #: pages (measured) — ~35% of a 64 MB put. Track populated chunks so
+    #: each region of the arena pays the syscall once per client lifetime.
+    _POP_STEP = 32 * 1024 * 1024
+
     def __init__(self, store_path: str, capacity: int, rpc_call, local_store=None):
         if local_store is not None:
             # co-located raylet: metadata ops are method calls, not RPCs
@@ -697,8 +726,71 @@ class PlasmaClient:
         finally:
             os.close(fd)
         self._view = memoryview(self._map)
+        self._capacity = capacity
+        self._pop_chunks: set = set()
+        self._pop_lock = threading.Lock()
+        self._pop_closed = False
+        if local_store is not None and GlobalConfig.object_store_prealloc:
+            # background PTE warm-up for this mapping, bounded to pages the
+            # store itself has committed (its prealloc bound): by the time
+            # the first large puts land, writes run at warm-memcpy speed
+            # instead of paying ~5 ms of on-demand madvise per 64 MB region
+            warm = min(capacity, getattr(local_store, "_prefault_bytes", 0))
+            if warm > 0:
+                threading.Thread(
+                    target=self._warm_loop, args=(warm,),
+                    name="plasma-client-warm", daemon=True,
+                ).start()
+
+    def _warm_loop(self, total: int) -> None:
+        # let the store's own prefault run first: populating after it means
+        # this pass only builds PTEs (~2.5 ms/32 MiB) instead of doing the
+        # tmpfs allocate+zero itself, and the caller's first puts aren't
+        # competing with two madvise loops for a small host's core
+        time.sleep(1.0)
+        step = self._POP_STEP
+        for start in range(0, total, step):
+            if self._pop_closed:
+                return
+            t0 = time.monotonic()
+            try:
+                self._ensure_populated(start, min(step, total - start))
+            except Exception:
+                return
+            # ~25% duty: never monopolize a small host's core at startup
+            time.sleep(max(0.002, 3 * (time.monotonic() - t0)))
+
+    def _ensure_populated(self, offset: int, size: int) -> None:
+        """Populate the page tables under [offset, offset+size) once: puts
+        into already-populated chunks skip the madvise entirely."""
+        if not GlobalConfig.object_store_prealloc:
+            return
+        step = self._POP_STEP
+        first, last = offset // step, (offset + size - 1) // step
+        with self._pop_lock:
+            missing = [
+                c for c in range(first, last + 1) if c not in self._pop_chunks
+            ]
+            self._pop_chunks.update(missing)
+        # merge adjacent chunks into runs: one syscall per contiguous gap
+        run_start = None
+        prev = None
+        for c in missing + [None]:
+            if run_start is not None and c != prev + 1:
+                start = run_start * step
+                length = min((prev + 1) * step, self._capacity) - start
+                if length > 0:
+                    _populate_range(self._map, start, length)
+                run_start = None
+            if c is not None and run_start is None:
+                run_start = c
+            prev = c
 
     def put_serialized(self, object_id: ObjectID, sobj: serialization.SerializedObject):
+        """Reserve → serialize-in-place → seal. Large objects are written
+        directly into the mapped arena at the offset the store hands back
+        (no intermediate full-payload bytes); small objects (≤256 KiB) ride
+        a single store_put RPC instead of the create/seal round-trips."""
         size = sobj.total_size()
         deadline = time.monotonic() + GlobalConfig.object_store_full_retry_s
         small = size <= 256 * 1024
@@ -718,10 +810,46 @@ class PlasmaClient:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.1)
-        if size > 8 * 1024 * 1024:
-            _populate_range(self._map, offset, size)
-        sobj.write_to(self._view[offset : offset + size])
+        if size > 1024 * 1024:
+            self._ensure_populated(offset, size)
+        try:
+            sobj.write_to(self._view[offset : offset + size])
+        except BaseException:
+            # never leave an unsealed entry behind (a failed deferred
+            # device→host transfer would otherwise wedge readers forever)
+            try:
+                self._rpc("store_abort", object_id)
+            except Exception:
+                pass
+            raise
         self._rpc("store_seal", object_id)
+        serialization.note_inplace_write(size)
+        internal_metrics.inc("ray_tpu_object_store_inplace_writes_total")
+
+    def put_wire_bytes(self, object_id: ObjectID, data) -> bool:
+        """Store an already-serialized wire payload (e.g. an owner-inline
+        object being promoted to plasma). Returns False when the object
+        already exists (a concurrent writer won the race)."""
+        size = len(data)
+        deadline = time.monotonic() + GlobalConfig.object_store_full_retry_s
+        while True:
+            try:
+                if size <= 256 * 1024:
+                    self._rpc("store_put", (object_id, data))
+                    return True
+                offset = self._rpc("store_create", (object_id, size))
+                break
+            except ValueError:
+                return False
+            except ObjectStoreFullError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        if size > 1024 * 1024:
+            self._ensure_populated(offset, size)
+        self._view[offset : offset + size] = data
+        self._rpc("store_seal", object_id)
+        return True
 
     def get_views(
         self, object_ids: List[ObjectID], timeout: Optional[float] = None
@@ -746,6 +874,7 @@ class PlasmaClient:
             self._rpc("store_delete_batch", list(object_ids))
 
     def close(self):
+        self._pop_closed = True
         try:
             self._view.release()
             self._map.close()

@@ -980,6 +980,62 @@ class Raylet:
         self._heartbeat_now()
         return True
 
+    # Batched bundle RPCs: the GCS groups a placement group's bundles by
+    # target raylet and issues ONE prepare/commit/return call per raylet
+    # instead of one per bundle (the per-bundle round-trips dominated
+    # pg_create_remove at 0.80x baseline; reference batches the same way —
+    # node_manager.proto PrepareBundleResources takes repeated bundle specs).
+
+    def rpc_prepare_bundles(self, conn, payload):
+        """Phase 1 for several bundles at once, all-or-nothing: either every
+        bundle's resources are reserved on this raylet or none are."""
+        pg_id, items = payload  # [(index, resources), ...]
+        with self._res_cv:
+            todo = [
+                (i, r)
+                for i, r in items
+                if (pg_id, i) not in self._prepared_bundles
+                and (pg_id, i) not in self._committed_bundles
+            ]
+            need: Dict[str, float] = {}
+            for _, r in todo:
+                for k, v in r.items():
+                    need[k] = need.get(k, 0.0) + v
+            if not all(self.available.get(k, 0.0) >= v for k, v in need.items()):
+                return False
+            for k, v in need.items():
+                self.available[k] = self.available.get(k, 0.0) - v
+            for i, r in todo:
+                self._prepared_bundles[(pg_id, i)] = dict(r)
+        return True
+
+    def rpc_commit_bundles(self, conn, payload):
+        """Phase 2 for several bundles; one resource heartbeat at the end
+        instead of one per bundle."""
+        pg_id, indices = payload
+        ok = True
+        with self._res_cv:
+            for index in indices:
+                resources = self._prepared_bundles.pop((pg_id, index), None)
+                if resources is None:
+                    ok = ok and (pg_id, index) in self._committed_bundles
+                    continue
+                names = self.bundle_resource_names(pg_id, index, resources)
+                for k, v in names.items():
+                    self.total_resources[k] = self.total_resources.get(k, 0.0) + v
+                    self.available[k] = self.available.get(k, 0.0) + v
+                self._committed_bundles[(pg_id, index)] = dict(resources)
+            self._res_cv.notify_all()
+        self._heartbeat_now()
+        return ok
+
+    def rpc_return_bundles(self, conn, payload):
+        pg_id, indices = payload
+        ok = True
+        for index in indices:
+            ok = self.rpc_return_bundle(conn, (pg_id, index)) and ok
+        return ok
+
     def _report_store_gauges(self):
         """Mirror plasma stats into gauges and surface spill bursts as
         cluster events (one event per burst, diffed against a watermark)."""

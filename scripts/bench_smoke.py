@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Per-PR put/get/submit micro-smoke (<60 s) with warn-only floors.
+
+Runs a tiny slice of bench_core.py's matrix — small put/get, async task
+submission, one large in-place put — and compares each rate against a floor
+derived from the newest archived ``BENCH_CORE_r*.json`` round artifact.
+Floors are deliberately loose (``FLOOR_FRACTION`` of the archived value)
+and violations WARN instead of failing: this runs on shared boxes whose
+steal time can halve any single run, so a hard gate would flap. The point
+is a visible per-PR signal when the put path regresses by integer factors
+(the class of bug this PR's zero-copy rework exists to prevent).
+
+Usage: python scripts/bench_smoke.py  (exit code is always 0 unless the
+runtime itself breaks; warnings go to stdout as WARN lines)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FLOOR_FRACTION = 0.3  # warn below 30% of the archived round value
+CHECKS = ("put_small_per_s", "get_small_per_s", "tasks_async_per_s", "put_gbps")
+
+
+def _load_baseline() -> dict:
+    """Newest round artifact's results (BENCH_CORE_r06.json > r05 > ...)."""
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_CORE_r*.json")))
+    if not rounds:
+        return {}
+    with open(rounds[-1]) as f:
+        return json.load(f).get("results", {})
+
+
+def main() -> int:
+    import numpy as np
+
+    import ray_tpu
+
+    baseline = _load_baseline()
+    ray_tpu.init(num_cpus=2, log_level="ERROR")
+
+    @ray_tpu.remote
+    def _noop():
+        return None
+
+    results = {}
+    # warmup keeps this honest without bench_core's full 2000-task ramp
+    ray_tpu.get([_noop.remote() for _ in range(200)], timeout=60)
+
+    t0 = time.perf_counter()
+    ray_tpu.get([_noop.remote() for _ in range(1000)], timeout=60)
+    results["tasks_async_per_s"] = 1000 / (time.perf_counter() - t0)
+
+    small = np.arange(16)
+    t0 = time.perf_counter()
+    for _ in range(500):
+        ray_tpu.put(small)
+    results["put_small_per_s"] = 500 / (time.perf_counter() - t0)
+
+    ref = ray_tpu.put(small)
+    t0 = time.perf_counter()
+    for _ in range(500):
+        ray_tpu.get(ref, timeout=10)
+    results["get_small_per_s"] = 500 / (time.perf_counter() - t0)
+
+    big = np.zeros(16 * 1024 * 1024 // 8)  # 16 MB
+    ray_tpu.put(big)  # warm the arena chunks once
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        ray_tpu.put(big)
+    results["put_gbps"] = 16 * iters / 1024 / (time.perf_counter() - t0)
+
+    ray_tpu.shutdown()
+
+    warned = False
+    for key in CHECKS:
+        value = results.get(key)
+        base = baseline.get(key)
+        floor = base * FLOOR_FRACTION if base else None
+        line = {
+            "metric": key,
+            "value": round(value, 2),
+            "floor": round(floor, 2) if floor else None,
+        }
+        print(json.dumps(line), flush=True)
+        if floor and value < floor:
+            warned = True
+            print(
+                f"WARN: {key} = {value:.2f} below floor {floor:.2f} "
+                f"({FLOOR_FRACTION:.0%} of archived {base:.2f}) — possible "
+                "put-path regression (or shared-box noise; re-run to confirm)",
+                flush=True,
+            )
+    if not warned:
+        print("bench smoke: all floors met", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,5 +7,8 @@ cd "$(dirname "$0")/.."
 # static drift gate first: every registered ray_tpu_* metric family must be
 # documented in the README before the behavioral smoke runs
 python scripts/check_metrics_catalog.py
+# perf floor check (warn-only): put/get/submit micro-run vs the newest
+# archived bench round, so put-path regressions are visible per-PR
+env JAX_PLATFORMS=cpu python scripts/bench_smoke.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
     tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py "$@"

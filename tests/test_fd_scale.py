@@ -1,0 +1,81 @@
+"""fd-scale regression (ISSUE 3 satellite): the ref-gc wakeup loop must not
+use select.select — a worker that opened >1024 fds before init gets a gc
+pipe fd past FD_SETSIZE, and select() then raises ``filedescriptor out of
+range`` forever, silently killing reference gc."""
+
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+
+def test_ref_gc_loop_has_no_select(ray_start_regular):
+    """Static guard from the acceptance criteria: the loop is selectors-based."""
+    import inspect
+
+    from ray_tpu._private.core_worker import CoreWorker
+
+    src = inspect.getsource(CoreWorker._ref_gc_loop)
+    assert "select.select" not in src
+    assert "selectors" in src
+
+
+def test_ref_gc_with_fd_above_fd_setsize():
+    """Open >1024 fds BEFORE init so the gc pipe lands past FD_SETSIZE, then
+    prove reference gc still frees plasma objects (with select.select the gc
+    thread would crash on its first wait and objects would never be freed)."""
+    import resource
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < 1400:
+        pytest.skip(f"RLIMIT_NOFILE soft limit {soft} too low to cross 1024")
+
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.ids import ObjectID
+
+    hog = [os.open(os.devnull, os.O_RDONLY) for _ in range(1100)]
+    try:
+        ray_tpu.init(num_cpus=2, log_level="WARNING")
+        try:
+            core = worker_mod.global_worker.core
+            assert core._gc_r > 1024, (
+                f"gc pipe fd {core._gc_r} landed below FD_SETSIZE; "
+                "the regression scenario was not reproduced"
+            )
+            ref = ray_tpu.put(np.zeros(1 << 20))
+            query = ObjectID(ref.binary())
+            assert core.plasma.contains(query)
+            del ref
+            gc.collect()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if not core.plasma.contains(query):
+                    break
+                time.sleep(0.1)
+            assert not core.plasma.contains(query), (
+                "plasma object never freed: ref gc is dead with fd > 1024"
+            )
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        for fd in hog:
+            os.close(fd)
+
+
+def test_shutdown_releases_gc_pipe_fds(ray_start_regular):
+    """fd audit: init/shutdown cycles must not leak the gc wakeup pipe."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker.core
+    gc_r, gc_w = core._gc_r, core._gc_w
+    assert gc_r >= 0 and gc_w >= 0
+    ray_tpu.shutdown()
+    # fields are invalidated before the fds close (late finalizers must not
+    # write into a recycled fd number); the fds themselves may legitimately
+    # be recycled by other subsystems immediately, so only the fields are
+    # asserted here
+    assert core._gc_r == -1 and core._gc_w == -1
