@@ -38,6 +38,11 @@ from ray_tpu.api import (
     wait,
 )
 
+# deterministic fault injection (ray_tpu.chaos.apply/clear/report);
+# plain import — chaos.py itself lazy-imports the RPC layer on first call
+from ray_tpu import chaos
+
+
 def timeline(filename=None, *, address=None):
     """Chrome-tracing dump of all task execution — always on, no
     ``tracing_enabled`` opt-in needed (reference: ray.timeline). Lazy
@@ -53,6 +58,7 @@ __all__ = [
     "shutdown",
     "is_initialized",
     "timeline",
+    "chaos",
     "remote",
     "get",
     "put",

@@ -68,6 +68,14 @@ class _Config:
         "memory_monitor_period_s": 1.0,
         # --- health / fault tolerance ---
         "health_check_period_s": 1.0,
+        # gray-failure detection: a node whose heartbeats arrive but whose
+        # self-probes (peer data-plane pings + local store health) fail is
+        # DEGRADED — drained of new leases — and escalates to DEAD if it
+        # does not recover within this window
+        "degraded_window_s": 10.0,
+        "chaos_probe_period_s": 2.0,
+        "probe_timeout_s": 1.0,
+        "probe_failure_threshold": 2,
         # GCS->raylet resource-view gossip cadence (the ray_syncer
         # rebroadcast half); raylets spill from this cache when it is
         # younger than 3 periods
@@ -83,6 +91,19 @@ class _Config:
         "gcs_persistence_path": "",
         # --- rpc ---
         "rpc_connect_timeout_s": 10.0,
+        # idempotency-classified client retry: read-only/idempotent methods
+        # retry across reconnects with capped exponential backoff + full
+        # jitter; non-idempotent methods fail fast (NonIdempotentRpcError)
+        "rpc_retry_max_attempts": 3,
+        "rpc_retry_backoff_base_s": 0.05,
+        "rpc_retry_backoff_cap_s": 2.0,
+        # default deadline for call_async callback slots: a peer that hangs
+        # without closing can no longer pin slots forever (0 disables)
+        "rpc_async_call_timeout_s": 120.0,
+        # cap for the raylet->GCS heartbeat reconnect backoff (full jitter,
+        # doubling from half the heartbeat period) so a GCS restart doesn't
+        # see a synchronized re-registration stampede
+        "heartbeat_reconnect_backoff_cap_s": 10.0,
         # dead-peer detection for sends is byte-based, not time-based: a
         # connection whose unflushed send buffer exceeds
         # 2 * rpc_max_frame_bytes is torn down (rpc._SendState._buffer)

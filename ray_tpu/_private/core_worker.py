@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import io
 import itertools
+import json
 import logging
 import os
 import pickle
@@ -35,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
@@ -145,7 +147,13 @@ class CoreWorker:
         self._current_task_id = TaskID.for_driver_task(job_id)
         self._task_ctx = threading.local()
 
+        # chaos attribution: this worker belongs to its raylet's node, so
+        # partition rules naming that node also cover its workers/driver
+        self._chaos_node_identity = fault_injection.identity_for(
+            None, tuple(raylet_address)
+        )
         self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
+        self.gcs.chaos_identity = self._chaos_node_identity
         if mode == "driver":
             # proactive actor-cache updates are a driver-side optimization;
             # at N workers the wholesale subscription turns every actor
@@ -158,12 +166,24 @@ class CoreWorker:
         # and relies on node-removed to mark objects lost for lineage
         # recovery (_on_gcs_notify "nodes")
         self.gcs.call("subscribe", "nodes")
+        try:
+            self.gcs.call("subscribe", "chaos", timeout=5.0)
+            blob = self.gcs.call("kv_get", ("chaos", "schedule"), timeout=5.0)
+            if blob:
+                # a schedule armed before this worker/driver joined
+                fault_injection.arm(
+                    json.loads(blob),
+                    local_addresses=[tuple(raylet_address)],
+                )
+        except Exception:
+            pass  # older GCS without a chaos plane
         self.captured_logs: "deque" = deque(maxlen=1000)
         if mode == "driver" and GlobalConfig.log_to_driver:
             # worker stdout/stderr streamed back via the log monitors
             # (reference: log_monitor.py -> gcs pubsub -> driver)
             self.gcs.call("subscribe", "logs")
         self.raylet = RpcClient(raylet_address)
+        self.raylet.chaos_identity = self._chaos_node_identity
         reg = self.raylet.call(
             "register_worker",
             {
@@ -1565,6 +1585,7 @@ class CoreWorker:
             # push can never reference a template whose defining frame lost
             # the socket-write race
             client._tmpl_lock = threading.Lock()
+            client.chaos_identity = self._chaos_node_identity
             self._worker_clients[addr] = client
             return client
 
@@ -1966,6 +1987,20 @@ class CoreWorker:
                     pass
 
     def _on_gcs_notify(self, channel: str, message: Any):
+        if channel == "chaos":
+            if message.get("event") == "cleared":
+                fault_injection.disarm()
+            else:
+                schedule = message.get("schedule")
+                if schedule:
+                    fault_injection.arm(
+                        schedule,
+                        local_node_id=(
+                            self.node_id.hex() if self.node_id else None
+                        ),
+                        local_addresses=[self.raylet.address],
+                    )
+            return
         if channel == "logs":
             prefix = f"({message.get('node', '')} worker={message.get('worker', '')[:8]})"
             for line in message.get("lines", ()):

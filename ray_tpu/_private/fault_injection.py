@@ -1,0 +1,429 @@
+"""Deterministic, seed-driven fault-injection plane.
+
+The reference runtime validates fault tolerance with a chaos harness that
+kills nodes during live workloads (reference: release/nightly_tests/
+chaos_test/ + NodeKillerActor, _private/test_utils.py:1367). Here the
+idea is taken further: a *deterministic* ``FaultSchedule`` — a list of
+rules matched on plane × rpc-method × peer × nth-occurrence (or seeded
+probability) — is distributed cluster-wide through GCS KV, and every
+process evaluates the same schedule from the same seed. Two runs with the
+same seed and the same call sequence inject the identical fault sequence,
+so chaos findings reproduce.
+
+Rule shape (all JSON/YAML-able; unknown keys rejected by
+:func:`validate_schedule`)::
+
+    {"action": "drop" | "delay" | "duplicate" | "disconnect"
+             | "kill_worker" | "kill_raylet"
+             | "partition" | "unpartition" | "slow_store_reads",
+     # matchers (RPC actions)
+     "method": "store_fetch",      # fnmatch pattern; None = any method
+     "peer": "<node_name|node_id|gcs|host:port>",  # None = any peer
+     "side": "send" | "recv",      # default "send" (client call boundary)
+     # trigger (at most one; neither = every occurrence)
+     "nth": 3,                     # 1-based nth matching occurrence only
+     "probability": 0.05,          # seeded coin per occurrence
+     "max_injections": 10,         # stop after N injections (any trigger)
+     # action parameters
+     "delay_ms": 250,              # delay / kill_* grace
+     "nodes": ["node-a", "node-b"],  # partition / unpartition pair
+     "node": "node-a",             # kill_* / slow_store_reads target
+     "read_delay_ms": 50}          # slow_store_reads
+
+Hook sites (all zero-cost no-ops while ``_armed is None`` — one module
+attribute read):
+
+- :func:`decide` at the RPC send/recv boundary (``rpc.py``),
+- :meth:`ArmedSchedule.store_read_delay` in the plasma read path
+  (``object_store.py``),
+- :func:`take_process_actions` in the raylet when a schedule arrives
+  (``raylet.py``: kill_worker / kill_raylet).
+
+Identity: one process can host many logical components (in-process test
+clusters run the GCS, several raylets, and the driver in a single
+process), so ``_armed`` is process-global but every hook accepts an
+``identity`` override — ``(node_id_hex_or_None, iterable_of_addresses)``
+— that components attach to their RPC clients (``RpcClient.
+chaos_identity``) and stores. Arming is idempotent per schedule version:
+the first armer wins and later same-version arms reuse the existing
+``ArmedSchedule`` (one injection log per process).
+
+Partitions are enforced as *outbound* drops on both members — each side
+drops every frame it would send to the other side's addresses — which
+yields a symmetric partition without needing to attribute inbound
+connections (client sockets dial from ephemeral ports).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import itertools
+import json
+import os
+import random
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+RPC_ACTIONS = ("drop", "delay", "duplicate", "disconnect")
+PROCESS_ACTIONS = ("kill_worker", "kill_raylet")
+TOPOLOGY_ACTIONS = ("partition", "unpartition")
+STORE_ACTIONS = ("slow_store_reads",)
+ALL_ACTIONS = RPC_ACTIONS + PROCESS_ACTIONS + TOPOLOGY_ACTIONS + STORE_ACTIONS
+
+_RULE_KEYS = {
+    "action", "method", "peer", "side", "nth", "probability",
+    "max_injections", "delay_ms", "nodes", "node", "read_delay_ms",
+}
+
+#: chaos control traffic is exempt from method/probability rules (else a
+#: blanket drop rule could make ``chaos clear`` itself undeliverable);
+#: partitions still block it — a partitioned node is partitioned.
+_CONTROL_EXEMPT = ("chaos_apply", "chaos_clear", "chaos_status",
+                   "chaos_report")
+
+#: (node_ids, addresses) pair resolved from the schedule topology
+_Resolved = Tuple[Set[str], Set[str]]
+
+#: hook-site identity override: (node_id hex or None, addresses)
+Identity = Tuple[Optional[str], Iterable[Any]]
+
+
+def addr_key(addr: Any) -> str:
+    """Canonical string form of a peer address: runtime address tuples
+    ``(host, port)``, JSON-round-tripped lists, and ``"host:port"``
+    strings all collapse to the same key."""
+    if isinstance(addr, (tuple, list)) and len(addr) == 2:
+        return f"{addr[0]}:{addr[1]}"
+    return str(addr)
+
+
+def identity_for(node_id: Any, *addresses: Any) -> Identity:
+    """Build a hook-site identity: hex the id, canonicalize addresses."""
+    hex_id = None
+    if node_id is not None:
+        hex_id = node_id if isinstance(node_id, str) else node_id.hex()
+    return (hex_id, frozenset(addr_key(a) for a in addresses))
+
+
+def validate_schedule(schedule: Dict[str, Any]) -> None:
+    """Raise ``ValueError`` on a malformed schedule (unknown actions or
+    rule keys, wrong field types) so mistakes surface at arm time, not as
+    silently-never-matching rules mid-run."""
+    if not isinstance(schedule, dict):
+        raise ValueError("schedule must be a dict with 'seed' and 'rules'")
+    rules = schedule.get("rules", [])
+    if not isinstance(rules, list):
+        raise ValueError("schedule['rules'] must be a list")
+    for i, rule in enumerate(rules):
+        if not isinstance(rule, dict):
+            raise ValueError(f"rule #{i} must be a dict")
+        action = rule.get("action")
+        if action not in ALL_ACTIONS:
+            raise ValueError(
+                f"rule #{i}: unknown action {action!r} "
+                f"(expected one of {', '.join(ALL_ACTIONS)})")
+        unknown = set(rule) - _RULE_KEYS
+        if unknown:
+            raise ValueError(
+                f"rule #{i}: unknown keys {sorted(unknown)}")
+        if action in TOPOLOGY_ACTIONS:
+            nodes = rule.get("nodes")
+            if not (isinstance(nodes, (list, tuple)) and len(nodes) == 2):
+                raise ValueError(
+                    f"rule #{i}: {action} needs 'nodes': [a, b]")
+        if rule.get("side", "send") not in ("send", "recv"):
+            raise ValueError(f"rule #{i}: side must be 'send' or 'recv'")
+        p = rule.get("probability")
+        if p is not None and not (0.0 <= float(p) <= 1.0):
+            raise ValueError(f"rule #{i}: probability must be in [0, 1]")
+        if rule.get("nth") is not None and int(rule["nth"]) < 1:
+            raise ValueError(f"rule #{i}: nth is 1-based")
+
+
+class ArmedSchedule:
+    """A schedule resolved against the cluster topology and armed in this
+    process. Deterministic: every rule draws from its own
+    ``random.Random(f"{seed}:{rule_index}")`` stream, and occurrence
+    counters advance only on matching calls — so a fixed call sequence
+    yields a fixed injection log."""
+
+    def __init__(self, schedule: Dict[str, Any],
+                 local_node_id: Optional[str] = None,
+                 local_addresses: Optional[Iterable[Any]] = None):
+        self.schedule = schedule
+        self.seed = int(schedule.get("seed", 0))
+        self.version = int(schedule.get("version", 0))
+        self.rules: List[Dict[str, Any]] = list(schedule.get("rules", []))
+        self.local_identity: Identity = identity_for(
+            local_node_id, *(local_addresses or ())
+        )
+        # unique per armed instance across processes: report aggregation
+        # dedupes by this (in-process clusters share one instance between
+        # all their components, real deployments have one per process)
+        self.instance = f"{os.getpid()}:{next(_instance_ids)}"
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.log: List[Dict[str, Any]] = []
+        self._rngs = [random.Random(f"{self.seed}:{i}")
+                      for i in range(len(self.rules))]
+        self._occurrences = [0] * len(self.rules)
+        self._injections = [0] * len(self.rules)
+        # identifier -> (ids, addresses) from the GCS-embedded topology
+        self._idents: Dict[str, _Resolved] = {}
+        for entry in schedule.get("cluster_nodes", ()):
+            ids = {entry.get("node_id", "")} | {entry.get("node_name", "")}
+            ids.discard("")
+            addrs = {addr_key(a) for a in entry.get("addresses", ())}
+            for ident in ids | addrs:
+                self._idents[ident] = (ids, addrs)
+        # active partitions, resolved but side-agnostic: hook sites pick
+        # the direction from the caller's identity
+        self._partitions: List[Tuple[_Resolved, _Resolved, int]] = []
+        for i, rule in enumerate(self.rules):
+            if rule.get("action") == "partition":
+                a, b = rule["nodes"]
+                self._partitions.append((self._resolve(a), self._resolve(b), i))
+            elif rule.get("action") == "unpartition":
+                a, b = rule["nodes"]
+                gone = (self._resolve(a)[1] | self._resolve(b)[1])
+                self._partitions = [
+                    p for p in self._partitions
+                    if not ((p[0][1] | p[1][1]) & gone)
+                ]
+
+    # -- topology resolution ------------------------------------------
+
+    def _resolve(self, ident: Any) -> _Resolved:
+        """(node_ids, addresses) an identifier names; an unknown
+        identifier resolves to itself as a literal address."""
+        key = addr_key(ident)
+        hit = self._idents.get(key)
+        if hit is not None:
+            return hit
+        return ({key}, {key})
+
+    def _local_matches(self, side: _Resolved,
+                       identity: Optional[Identity]) -> bool:
+        ids, addrs = side
+        node_id, local_addrs = (
+            identity if identity is not None else self.local_identity
+        )
+        if node_id is not None and node_id in ids:
+            return True
+        return any(a in addrs for a in local_addrs)
+
+    def _is_local(self, ident: Any, identity: Optional[Identity]) -> bool:
+        return self._local_matches(self._resolve(ident), identity)
+
+    # -- matching ------------------------------------------------------
+
+    def _peer_match(self, rule: Dict[str, Any], peer: Optional[str]) -> bool:
+        want = rule.get("peer")
+        if want is None:
+            return True
+        if peer is None:
+            return False
+        return peer in self._resolve(want)[1]
+
+    @staticmethod
+    def _method_match(rule: Dict[str, Any], method: Optional[str]) -> bool:
+        pattern = rule.get("method")
+        if pattern is None:
+            return True
+        return method is not None and fnmatch.fnmatch(method, pattern)
+
+    def _fire(self, i: int, rule: Dict[str, Any]) -> bool:
+        """Advance rule *i*'s occurrence counter and decide (under the
+        lock) whether this occurrence injects."""
+        self._occurrences[i] += 1
+        maxi = rule.get("max_injections")
+        if maxi is not None and self._injections[i] >= int(maxi):
+            return False
+        nth = rule.get("nth")
+        if nth is not None and self._occurrences[i] != int(nth):
+            return False
+        p = rule.get("probability")
+        if p is not None and self._rngs[i].random() >= float(p):
+            return False
+        self._injections[i] += 1
+        return True
+
+    def _record_locked(self, rule_idx: int, action: str,
+                       method: Optional[str], peer: Optional[str],
+                       side: str) -> None:
+        # no wall-clock in the entry: the log itself is the deterministic
+        # artifact compared across seeded runs
+        self.log.append({
+            "seq": self._seq, "rule": rule_idx, "action": action,
+            "method": method, "peer": peer, "side": side,
+        })
+        self._seq += 1
+
+    def record(self, rule_idx: int, action: str, method: Optional[str],
+               peer: Optional[str], side: str) -> None:
+        with self._lock:
+            self._record_locked(rule_idx, action, method, peer, side)
+        _count_metric(action)
+
+    # -- hook evaluation ----------------------------------------------
+
+    def decide(self, side: str, method: Optional[str], peer: Optional[str],
+               identity: Optional[Identity] = None) -> Optional[Dict[str, Any]]:
+        if side == "send" and peer is not None:
+            for a, b, idx in self._partitions:
+                if (peer in b[1] and self._local_matches(a, identity)) or (
+                    peer in a[1] and self._local_matches(b, identity)
+                ):
+                    self.record(idx, "drop", method, peer, side)
+                    return {"action": "drop", "rule": idx, "delay_ms": 0}
+        exempt = method in _CONTROL_EXEMPT
+        for i, rule in enumerate(self.rules):
+            action = rule.get("action")
+            if action not in RPC_ACTIONS:
+                continue
+            if exempt:
+                continue
+            if rule.get("side", "send") != side:
+                continue
+            if not self._method_match(rule, method):
+                continue
+            if not self._peer_match(rule, peer):
+                continue
+            with self._lock:
+                if not self._fire(i, rule):
+                    continue
+                self._record_locked(i, action, method, peer, side)
+            _count_metric(action)
+            return {"action": action, "rule": i,
+                    "delay_ms": float(rule.get("delay_ms", 0) or 0)}
+        return None
+
+    def store_read_delay(self, identity: Optional[Identity] = None) -> float:
+        """Seconds to stall a plasma read, or 0.0 (slow_store_reads)."""
+        for i, rule in enumerate(self.rules):
+            if rule.get("action") != "slow_store_reads":
+                continue
+            node = rule.get("node")
+            if node is not None and not self._is_local(node, identity):
+                continue
+            with self._lock:
+                if not self._fire(i, rule):
+                    continue
+                self._record_locked(i, "slow_store_reads", None, None,
+                                    "store")
+            _count_metric("slow_store_reads")
+            return float(rule.get("read_delay_ms", 50)) / 1000.0
+        return 0.0
+
+    def local_report(self) -> Dict[str, Any]:
+        with self._lock:
+            log = list(self.log)
+        counts: Dict[str, int] = {}
+        for entry in log:
+            counts[entry["action"]] = counts.get(entry["action"], 0) + 1
+        return {"version": self.version, "seed": self.seed,
+                "node_id": self.local_identity[0],
+                "instance": self.instance,
+                "injected": log, "counts": counts}
+
+
+_instance_ids = itertools.count()
+
+#: the armed schedule, or None — hot paths gate on this one attribute
+_armed: Optional[ArmedSchedule] = None
+
+#: kill rules already executed in this process, keyed by rule content, so
+#: a re-applied schedule (version bump from chaos.partition() etc.) does
+#: not re-kill (an intentionally repeated kill is a distinct rule)
+_executed_kills: Set[str] = set()
+_exec_lock = threading.Lock()
+
+
+def _count_metric(action: str) -> None:
+    try:
+        from ray_tpu._private import internal_metrics
+
+        internal_metrics.inc("ray_tpu_chaos_injected_faults_total",
+                             tags={"action": action})
+    except Exception:
+        pass
+
+
+def arm(schedule: Optional[Dict[str, Any]],
+        local_node_id: Optional[str] = None,
+        local_addresses: Optional[Iterable[Any]] = None) -> Optional[ArmedSchedule]:
+    """Arm (or with ``None``/empty, disarm) a schedule in this process.
+    Idempotent per version: when the same GCS-assigned version is already
+    armed (an in-process cluster arms once per component), the existing
+    ArmedSchedule — and its injection log — is reused."""
+    global _armed
+    if schedule is None or not schedule.get("rules"):
+        _armed = None
+        return None
+    current = _armed
+    version = int(schedule.get("version", 0))
+    if current is not None and version != 0 and current.version == version:
+        return current
+    armed = ArmedSchedule(schedule, local_node_id=local_node_id,
+                          local_addresses=local_addresses)
+    _armed = armed
+    return armed
+
+
+def disarm() -> None:
+    global _armed
+    _armed = None
+
+
+def is_armed() -> bool:
+    return _armed is not None
+
+
+def decide(side: str, method: Optional[str], peer: Optional[str],
+           identity: Optional[Identity] = None) -> Optional[Dict[str, Any]]:
+    armed = _armed
+    if armed is None:
+        return None
+    return armed.decide(side, method, peer, identity)
+
+
+def store_read_delay(identity: Optional[Identity] = None) -> float:
+    armed = _armed
+    if armed is None:
+        return 0.0
+    return armed.store_read_delay(identity)
+
+
+def local_report() -> Optional[Dict[str, Any]]:
+    armed = _armed
+    if armed is None:
+        return None
+    return armed.local_report()
+
+
+def take_process_actions(
+    armed: ArmedSchedule, identity: Optional[Identity] = None
+) -> List[Dict[str, Any]]:
+    """kill_worker / kill_raylet rules targeting this component that have
+    not executed yet in this process. Marks them executed; the caller (the
+    raylet) performs the kill. Each returned dict carries the rule plus a
+    dedicated seeded ``rng`` for victim selection."""
+    out = []
+    node_id = (identity or armed.local_identity)[0] or ""
+    for i, rule in enumerate(armed.rules):
+        if rule.get("action") not in PROCESS_ACTIONS:
+            continue
+        node = rule.get("node")
+        if node is not None and not armed._is_local(node, identity):
+            continue
+        # keyed per (rule, executing node): in-process clusters share the
+        # executed-set, but a node-untargeted kill still runs on each node
+        key = node_id + "|" + json.dumps(rule, sort_keys=True)
+        with _exec_lock:
+            if key in _executed_kills:
+                continue
+            _executed_kills.add(key)
+        armed.record(i, rule["action"], None, rule.get("node"), "process")
+        out.append({"rule": dict(rule), "index": i,
+                    "rng": random.Random(f"{armed.seed}:kill:{key}")})
+    return out

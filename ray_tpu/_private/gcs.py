@@ -12,6 +12,7 @@ tasks (the reference's ScheduleByRaylet default, gcs_actor_scheduler.h:355).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -94,6 +95,13 @@ class NodeInfo:
         self.available_resources = dict(resources)
         self.labels = labels
         self.alive = True
+        # gray-failure lifecycle: ALIVE -> DEGRADED (heartbeats arrive but
+        # self-probes fail) -> back to ALIVE, or escalation to DEAD after
+        # degraded_window_s. ``alive`` stays True while DEGRADED — the node
+        # is drained of new leases, not declared lost.
+        self.state = "ALIVE"
+        self.degraded_since: Optional[float] = None
+        self.probes: Dict[str, Any] = {}
         self.last_heartbeat = time.monotonic()
         self.store_path: str = labels.get("store_path", "")
         self.store_capacity: int = int(labels.get("store_capacity", "0"))
@@ -158,6 +166,10 @@ class GcsServer:
         # the dashboard's event_agent. Ring-buffered, queryable via
         # rpc_list_cluster_events, live via the "cluster_events" channel.
         self._cluster_events: List[Dict[str, Any]] = []
+        # monotonically increasing chaos schedule version: every apply or
+        # clear bumps it so late subscribers can order arm/clear events
+        self._chaos_version = 0
+        self.server.chaos_identity = self._chaos_identity()
         self._stopped = threading.Event()
         if self._storage is not None:
             self._reload_from_storage()
@@ -368,6 +380,10 @@ class GcsServer:
         node_id, available = payload[0], payload[1]
         total = payload[2] if len(payload) > 2 else None
         demand = payload[3] if len(payload) > 3 else None
+        # self-probe snapshot (peer data-plane pings + local store health):
+        # the gray-failure signal — a node can heartbeat fine while its
+        # data plane is partitioned or its store is wedged
+        probes = payload[4] if len(payload) > 4 else None
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info.alive:
@@ -382,6 +398,8 @@ class GcsServer:
             if demand is not None:
                 # parked lease requests: the autoscaler's scale-up signal
                 info.pending_demand = demand
+            if probes is not None:
+                info.probes = probes
         return True
 
     def rpc_unregister_node(self, conn, payload):
@@ -392,6 +410,7 @@ class GcsServer:
             if info is None or not info.alive:
                 return False
             info.alive = False
+            info.state = "DEAD"
         self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
         self._record_cluster_event(
             "NODE_REMOVED",
@@ -413,6 +432,8 @@ class GcsServer:
             "available": n.available_resources,
             "labels": n.labels,
             "alive": n.alive,
+            "state": n.state,
+            "probes": dict(n.probes),
             "store_path": n.store_path,
             "store_capacity": n.store_capacity,
             "demand": list(n.pending_demand),
@@ -443,23 +464,213 @@ class GcsServer:
         threshold = GlobalConfig.health_check_failure_threshold
         while not self._stopped.wait(period):
             now = time.monotonic()
-            dead: List[NodeInfo] = []
+            window = GlobalConfig.degraded_window_s
+            dead: List[Tuple[NodeInfo, str]] = []
+            degraded: List[NodeInfo] = []
+            recovered: List[NodeInfo] = []
             with self._lock:
                 for info in self._nodes.values():
-                    if info.alive and now - info.last_heartbeat > period * threshold:
+                    if not info.alive:
+                        continue
+                    if now - info.last_heartbeat > period * threshold:
                         info.alive = False
-                        dead.append(info)
-            for info in dead:
-                logger.warning("node %s failed health check", info.node_id.hex()[:8])
+                        info.state = "DEAD"
+                        dead.append(
+                            (info,
+                             f"failed health check (no heartbeat for "
+                             f"{period * threshold:.1f}s)")
+                        )
+                        continue
+                    # gray failure: heartbeats arrive, but the node's
+                    # self-probes (peer pings / local store) report failure
+                    probes_bad = bool(info.probes) and not info.probes.get(
+                        "healthy", True
+                    )
+                    if info.state == "ALIVE" and probes_bad:
+                        info.state = "DEGRADED"
+                        info.degraded_since = now
+                        degraded.append(info)
+                    elif info.state == "DEGRADED":
+                        if not probes_bad:
+                            info.state = "ALIVE"
+                            info.degraded_since = None
+                            recovered.append(info)
+                        elif now - (info.degraded_since or now) > window:
+                            info.alive = False
+                            info.state = "DEAD"
+                            dead.append(
+                                (info,
+                                 f"gray failure escalated: DEGRADED for "
+                                 f">{window:.1f}s without recovering")
+                            )
+                n_degraded = sum(
+                    1
+                    for i in self._nodes.values()
+                    if i.alive and i.state == "DEGRADED"
+                )
+            from ray_tpu._private import internal_metrics
+
+            internal_metrics.set_gauge("ray_tpu_node_degraded", float(n_degraded))
+            for info in degraded:
+                logger.warning(
+                    "node %s DEGRADED (gray failure): probes=%s",
+                    info.node_id.hex()[:8], info.probes,
+                )
+                self._publish("nodes", {"event": "degraded", "node": self._node_view(info)})
+                self._record_cluster_event(
+                    "NODE_DEGRADED",
+                    f"node {info.node_id.hex()[:8]} entered DEGRADED: "
+                    f"heartbeats healthy but self-probes failing "
+                    f"({info.probes.get('detail', 'no detail')}); draining "
+                    f"new leases away",
+                    severity="WARNING",
+                    node_id=info.node_id.hex(),
+                )
+            for info in recovered:
+                logger.info("node %s recovered from DEGRADED", info.node_id.hex()[:8])
+                self._publish("nodes", {"event": "recovered", "node": self._node_view(info)})
+                self._record_cluster_event(
+                    "NODE_RECOVERED",
+                    f"node {info.node_id.hex()[:8]} recovered from DEGRADED "
+                    f"(self-probes healthy again)",
+                    node_id=info.node_id.hex(),
+                )
+            for info, why in dead:
+                logger.warning("node %s %s", info.node_id.hex()[:8], why)
                 self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
                 self._record_cluster_event(
                     "NODE_DIED",
-                    f"node {info.node_id.hex()[:8]} failed health check "
-                    f"(no heartbeat for {period * threshold:.1f}s)",
+                    f"node {info.node_id.hex()[:8]} {why}",
                     severity="ERROR",
                     node_id=info.node_id.hex(),
                 )
                 self._handle_node_death(info.node_id)
+
+    # ------------------------------------------------------------------
+    # chaos plane (deterministic fault injection, fault_injection.py)
+    # ------------------------------------------------------------------
+
+    def _chaos_cluster_nodes_locked(self) -> List[Dict[str, Any]]:
+        """Topology snapshot embedded into an applied schedule so every
+        process resolves rule identifiers (node names/ids) to addresses —
+        and its own identity — the same way. The GCS itself appears as the
+        pseudo-node "gcs" (partitioning a node from "gcs" drops its
+        heartbeats, which is how escalation-to-DEAD is injected)."""
+        from ray_tpu._private import fault_injection as fi
+
+        entries = [
+            {
+                "node_id": n.node_id.hex(),
+                "node_name": n.labels.get("node_name", ""),
+                "addresses": [fi.addr_key(n.address)],
+            }
+            for n in self._nodes.values()
+        ]
+        entries.append(
+            {"node_id": "gcs", "node_name": "gcs",
+             "addresses": [fi.addr_key(self.server.address)]}
+        )
+        return entries
+
+    def rpc_chaos_apply(self, conn, payload):
+        """Validate, version, and distribute a fault schedule: persisted in
+        KV (namespace "chaos") for late joiners, pushed over the "chaos"
+        channel to every subscribed raylet/driver, and armed in the GCS's
+        own process. Returns the assigned version."""
+        from ray_tpu._private import fault_injection as fi
+
+        schedule = dict(payload or {})
+        fi.validate_schedule(schedule)
+        with self._lock:
+            self._chaos_version += 1
+            schedule["version"] = self._chaos_version
+            schedule["cluster_nodes"] = self._chaos_cluster_nodes_locked()
+            blob = json.dumps(schedule).encode()
+            self._kv.setdefault("chaos", {})["schedule"] = blob
+            if self._storage is not None:
+                self._storage.put("kv", "chaos\x00schedule", blob)
+        fi.arm(schedule, local_node_id="gcs",
+               local_addresses=[self.server.address])
+        self._publish("chaos", {"event": "armed", "schedule": schedule})
+        self._record_cluster_event(
+            "CHAOS_ARMED",
+            f"chaos schedule v{schedule['version']} armed: "
+            f"{len(schedule.get('rules', []))} rules, "
+            f"seed={schedule.get('seed', 0)}",
+            severity="WARNING",
+        )
+        return schedule["version"]
+
+    def rpc_chaos_clear(self, conn, payload=None):
+        from ray_tpu._private import fault_injection as fi
+
+        with self._lock:
+            had = self._kv.get("chaos", {}).pop("schedule", None)
+            self._chaos_version += 1
+            if self._storage is not None:
+                self._storage.delete("kv", "chaos\x00schedule")
+        fi.disarm()
+        self._publish("chaos", {"event": "cleared"})
+        if had is not None:
+            self._record_cluster_event("CHAOS_CLEARED", "chaos schedule cleared")
+        return had is not None
+
+    def rpc_chaos_status(self, conn, payload=None):
+        from ray_tpu._private import fault_injection as fi
+
+        with self._lock:
+            blob = self._kv.get("chaos", {}).get("schedule")
+            version = self._chaos_version
+        return {
+            "armed": blob is not None,
+            "version": version,
+            "schedule": json.loads(blob) if blob is not None else None,
+        }
+
+    def rpc_chaos_report(self, conn, payload=None):
+        """Cluster-wide injection report: the GCS's own log plus every
+        alive raylet's (best-effort — a partitioned raylet can't answer,
+        which is the point), plus chaos-related cluster events."""
+        from ray_tpu._private import fault_injection as fi
+
+        with self._lock:
+            nodes = [n for n in self._nodes.values() if n.alive]
+            events = [
+                dict(e)
+                for e in self._cluster_events
+                if e.get("type") in (
+                    "CHAOS_ARMED", "CHAOS_CLEARED", "NODE_DEGRADED",
+                    "NODE_RECOVERED", "NODE_DIED",
+                )
+            ]
+        reports: Dict[str, Any] = {}
+        own = fi.local_report()
+        if own is not None:
+            reports["gcs"] = own
+        for node in nodes:
+            try:
+                r = self._raylet_client(node).call("chaos_report", None, timeout=2.0)
+                if r is not None:
+                    reports[node.node_id.hex()] = r
+            except Exception:
+                reports[node.node_id.hex()] = {"error": "unreachable"}
+        # in-process clusters share one ArmedSchedule between all their
+        # components, so identical instances must count once
+        seen_instances = set()
+        total = 0
+        for r in reports.values():
+            if not (isinstance(r, dict) and "counts" in r):
+                continue
+            instance = r.get("instance")
+            if instance is not None and instance in seen_instances:
+                continue
+            seen_instances.add(instance)
+            total += sum(r["counts"].values())
+        return {
+            "reports": reports,
+            "events": events,
+            "total_injected": total,
+        }
 
     # ------------------------------------------------------------------
     # actors
@@ -541,6 +752,9 @@ class GcsServer:
                 n
                 for n in self._nodes.values()
                 if n.alive
+                # DEGRADED drains new leases away (explicit targeting wins:
+                # a caller pinning node_id accepts the gray failure risk)
+                and (n.state != "DEGRADED" or node_id is not None)
                 and all(n.total_resources.get(k, 0) >= v for k, v in resources.items())
                 and (node_id is None or n.node_id == node_id)
             ]
@@ -612,8 +826,14 @@ class GcsServer:
             if client is not None and not client.closed:
                 return client
             client = RpcClient(node.address)
+            client.chaos_identity = self._chaos_identity()
             self._raylet_clients[node.node_id] = client
             return client
+
+    def _chaos_identity(self):
+        from ray_tpu._private import fault_injection as fi
+
+        return fi.identity_for("gcs", self.server.address)
 
     def _schedule_actor(self, info: ActorInfo):
         spec = info.spec
@@ -856,7 +1076,10 @@ class GcsServer:
         """Groups of candidate nodes. With a label-equality constraint (e.g.
         tpu_slice_id for gang-scheduling a pod slice) each group shares one
         label value; otherwise a single group of all alive nodes."""
-        alive = [n for n in self._nodes.values() if n.alive]
+        alive = [
+            n for n in self._nodes.values()
+            if n.alive and n.state != "DEGRADED"
+        ]
         if not label_equal:
             return [alive]
         groups: Dict[str, List[NodeInfo]] = {}

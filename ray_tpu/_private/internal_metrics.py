@@ -108,6 +108,19 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "per-node raylet failures during cluster-wide state listings "
         "(partial results)",
         ("api",)),
+    # -- chaos / fault tolerance --------------------------------------
+    "ray_tpu_chaos_injected_faults_total": (
+        "counter",
+        "faults injected by an armed chaos schedule in this process",
+        ("action",)),
+    "ray_tpu_rpc_retries_total": (
+        "counter",
+        "idempotent RPC calls retried after a reconnect or timeout",
+        ("method",)),
+    "ray_tpu_node_degraded": (
+        "gauge",
+        "nodes currently in the DEGRADED gray-failure state (GCS view)",
+        ()),
 }
 
 _lock = threading.Lock()

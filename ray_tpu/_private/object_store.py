@@ -310,9 +310,14 @@ class PlasmaStore:
     This class owns allocation, seal notification, pinning, and LRU eviction
     (reference: src/ray/object_manager/plasma/object_lifecycle_manager.cc,
     eviction_policy.cc).
+
+    ``chaos_identity`` (set by the owning raylet) attributes this store to
+    its logical node for slow_store_reads fault rules — in-process test
+    clusters host several stores per process.
     """
 
     def __init__(self, session_dir: str, capacity: Optional[int] = None, name: str = "store"):
+        self.chaos_identity = None
         self.capacity = capacity or GlobalConfig.object_store_memory_bytes
         shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
         self.path = os.path.join(
@@ -417,7 +422,16 @@ class PlasmaStore:
             return offset
 
     def put_bytes(self, object_id: ObjectID, data: bytes, creating_worker=None):
-        """create+write+seal in one step (single-RPC path for small puts)."""
+        """create+write+seal in one step (single-RPC path for small puts).
+
+        Duplicate-tolerant: a put of an already-sealed object is a no-op
+        success, so the RPC is retry-safe (a dropped/duplicated store_put
+        frame must not fail the task — object ids name one task attempt's
+        immutable result, so the bytes are the same)."""
+        with self._cv:
+            existing = self._entries.get(object_id)
+            if existing is not None and existing.sealed:
+                return
         offset = self.create(object_id, len(data), creating_worker)
         self._view[offset : offset + len(data)] = data
         self.seal(object_id)
@@ -441,6 +455,7 @@ class PlasmaStore:
         self, object_ids: List[ObjectID], timeout: Optional[float], pin: bool = True
     ) -> Optional[Dict[ObjectID, Tuple[int, int]]]:
         """Block until all objects are sealed; returns {oid: (offset, size)}."""
+        self._chaos_stall()  # local read path (shm readers resolve via here)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
@@ -618,9 +633,19 @@ class PlasmaStore:
         e.last_used = time.monotonic()
         return True
 
+    def _chaos_stall(self):
+        """slow_store_reads fault hook: one attribute read when disarmed."""
+        from ray_tpu._private import fault_injection
+
+        if fault_injection._armed is not None:
+            delay = fault_injection.store_read_delay(self.chaos_identity)
+            if delay > 0:
+                time.sleep(delay)
+
     def read(self, object_id: ObjectID, offset: int, length: int) -> Optional[bytes]:
         """Copy out a chunk of a sealed object (node-to-node transfer plane,
         reference: src/ray/object_manager/object_buffer_pool.cc)."""
+        self._chaos_stall()
         with self._cv:
             e = self._entries.get(object_id)
             if e is None or not e.sealed:
@@ -647,6 +672,7 @@ class PlasmaStore:
         protocol drift) gets a copy instead of a live view that eviction
         could concurrently reuse (ADVICE r4). Spilled entries use the
         copying read too."""
+        self._chaos_stall()
         with self._cv:
             e = self._entries.get(object_id)
             if e is None or not e.sealed:

@@ -13,14 +13,17 @@ python/ray/cluster_utils.py:99).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import random
 import subprocess
 import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import object_store
 from ray_tpu._private.config import GlobalConfig
@@ -284,6 +287,11 @@ class WorkerHandle:
 
 
 class Raylet:
+    # data-plane liveness probes must answer even when the dispatch pool
+    # is saturated by long-poll handlers — that saturation is exactly the
+    # gray failure the probes exist to detect
+    RPC_INLINE = ("ping",)
+
     def __init__(
         self,
         session_dir: str,
@@ -297,9 +305,18 @@ class Raylet:
         self.session_dir = session_dir
         self.gcs_address = gcs_address
         self.server = RpcServer(f"raylet-{node_name}")
+        # chaos attribution: this node's identity rides on every client,
+        # server, and store hook so partition/kill/slow-read rules resolve
+        # per logical node even when several nodes share one process
+        self._chaos_identity = fault_injection.identity_for(
+            self.node_id, self.server.address
+        )
+        self.server.chaos_identity = self._chaos_identity
+        self._chaos_armed: Optional[fault_injection.ArmedSchedule] = None
         self.store = object_store.PlasmaStore(
             session_dir, capacity=store_capacity, name=node_name
         )
+        self.store.chaos_identity = self._chaos_identity
         # same-process workers (the head-node driver, in-process test
         # clusters) bypass the RPC hop for store metadata ops
         object_store.register_local_store(self.server.address, self.store)
@@ -339,6 +356,7 @@ class Raylet:
         # NodeResourceInfo downstream half)
         self._peer_view: Dict[str, Any] = {"at": 0.0, "nodes": []}
         self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
+        self.gcs.chaos_identity = self._chaos_identity
         self.gcs.call(
             "register_node",
             (self.node_id, self.server.address, self.total_resources, self.labels),
@@ -347,6 +365,22 @@ class Raylet:
             self.gcs.call("subscribe", "resource_view", timeout=5.0)
         except Exception:
             pass  # older GCS: spillback falls back to get_nodes
+        try:
+            self.gcs.call("subscribe", "chaos", timeout=5.0)
+            blob = self.gcs.call("kv_get", ("chaos", "schedule"), timeout=5.0)
+            if blob:
+                # late joiner: a schedule armed before this node existed
+                self._arm_chaos(json.loads(blob))
+        except Exception:
+            pass  # older GCS without a chaos plane: stay disarmed
+        # gray-failure self-probes feed heartbeat payloads (see _probe_loop)
+        self._probe_failures: Dict[str, int] = {}
+        self._probe_snapshot: Dict[str, Any] = {"healthy": True}
+        self._probe_rr = 0
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name=f"probe-{node_name}", daemon=True
+        )
+        self._probe_thread.start()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
         # memory monitor: kill the newest-leased worker under node memory
@@ -572,6 +606,136 @@ class Raylet:
                 "at": time.monotonic(),
                 "nodes": message.get("nodes") or [],
             }
+        elif channel == "chaos":
+            if message.get("event") == "cleared":
+                self._chaos_armed = None
+                fault_injection.disarm()
+            else:
+                schedule = message.get("schedule")
+                if schedule:
+                    self._arm_chaos(schedule)
+
+    # ------------------------------------------------------------------
+    # chaos plane (fault_injection.py)
+    # ------------------------------------------------------------------
+
+    def _arm_chaos(self, schedule: Dict[str, Any]):
+        """Arm a schedule in this process and execute any kill_worker /
+        kill_raylet rules aimed at this node (once per rule, off-thread —
+        a kill must not run on the poller's notify path)."""
+        armed = fault_injection.arm(
+            schedule,
+            local_node_id=self.node_id.hex(),
+            local_addresses=[self.server.address],
+        )
+        if armed is None:
+            self._chaos_armed = None
+            return
+        self._chaos_armed = armed
+        logger.warning(
+            "chaos schedule v%s armed on %s (%d rules, seed=%s)",
+            armed.version, self.labels.get("node_name"), len(armed.rules),
+            armed.seed,
+        )
+        for item in fault_injection.take_process_actions(
+            armed, identity=self._chaos_identity
+        ):
+            threading.Thread(
+                target=self._execute_chaos_kill, args=(item,), daemon=True
+            ).start()
+
+    def _execute_chaos_kill(self, item: Dict[str, Any]):
+        rule = item["rule"]
+        grace = float(rule.get("delay_ms", 0) or 0) / 1000.0
+        if grace > 0:
+            time.sleep(grace)
+        if rule["action"] == "kill_worker":
+            with self._res_cv:
+                victims = sorted(
+                    (w for w in self._workers if self._workers[w].proc is not None),
+                    key=lambda w: w.hex(),
+                )
+            if not victims:
+                return
+            victim = item["rng"].choice(victims)  # seeded: reproducible pick
+            handle = self._workers.get(victim)
+            if handle is None or handle.proc is None:
+                return
+            logger.warning("chaos: killing worker %s", victim)
+            try:
+                handle.proc.kill()
+            except Exception:
+                pass
+        elif rule["action"] == "kill_raylet":
+            logger.warning(
+                "chaos: killing raylet %s", self.labels.get("node_name")
+            )
+            # no unregister: the GCS must discover the death the hard way
+            # (missed heartbeats), exactly like a crashed node
+            self.stop(unregister=False)
+
+    def rpc_ping(self, conn: ServerConn, payload=None):
+        """Data-plane liveness probe (inline: answers even when the
+        dispatch pool is wedged). Subject to chaos hooks like any RPC, so
+        a partitioned peer's probes genuinely fail."""
+        return True
+
+    def rpc_chaos_report(self, conn: ServerConn, payload=None):
+        armed = self._chaos_armed
+        return armed.local_report() if armed is not None else None
+
+    def _probe_loop(self):
+        """Self-probe: round-robin one peer raylet data-plane ping per tick
+        plus a local store health check. Consecutive failures are counted
+        PER PEER (a healthy peer next tick must not reset a failing peer's
+        streak); any streak >= probe_failure_threshold flips the snapshot
+        unhealthy. The snapshot rides heartbeats to the GCS, which is the
+        gray-failure signal: heartbeats arriving + probes failing =>
+        DEGRADED."""
+        while not self._stopped.wait(GlobalConfig.chaos_probe_period_s):
+            threshold = GlobalConfig.probe_failure_threshold
+            peers = sorted(
+                tuple(n["address"])
+                for n in self._peer_view["nodes"]
+                if n.get("alive") and n.get("node_id") != self.node_id
+            )
+            live = {f"{a[0]}:{a[1]}" for a in peers}
+            for k in [k for k in self._probe_failures if k not in live]:
+                # a peer that left the view (e.g. escalated to DEAD) must
+                # not pin this node unhealthy forever
+                self._probe_failures.pop(k, None)
+            if peers:
+                addr = peers[self._probe_rr % len(peers)]
+                self._probe_rr += 1
+                key = f"{addr[0]}:{addr[1]}"
+                try:
+                    self._peer_client(addr).call(
+                        "ping", None, timeout=GlobalConfig.probe_timeout_s
+                    )
+                    self._probe_failures.pop(key, None)
+                except Exception:
+                    self._probe_failures[key] = (
+                        self._probe_failures.get(key, 0) + 1
+                    )
+            store_ok = True
+            try:
+                self.store.stats()
+            except Exception:
+                store_ok = False
+            failing = {
+                k: v for k, v in self._probe_failures.items() if v >= threshold
+            }
+            snapshot: Dict[str, Any] = {
+                "healthy": store_ok and not failing,
+            }
+            detail = []
+            if failing:
+                detail.append(f"unreachable peers: {sorted(failing)}")
+            if not store_ok:
+                detail.append("local store unhealthy")
+            if detail:
+                snapshot["detail"] = "; ".join(detail)
+            self._probe_snapshot = snapshot
 
     def _find_spill_node(
         self, resources: Dict[str, float], against: str, fresh: bool = False
@@ -604,6 +768,8 @@ class Raylet:
         for n in nodes:
             if not n["alive"] or n["node_id"] == self.node_id:
                 continue
+            if n.get("state") == "DEGRADED":
+                continue  # draining: no new spillback leases either
             pool = n["resources"] if against == "total" else n["available"]
             if all(pool.get(k, 0) >= v for k, v in resources.items() if v > 0):
                 slack = min(
@@ -1072,7 +1238,9 @@ class Raylet:
             except Exception:
                 pass  # event log is best-effort; never block heartbeats
 
-    def _heartbeat_now(self):
+    def _heartbeat_now(self) -> bool:
+        """One heartbeat attempt. Returns False when the GCS was
+        unreachable (the loop applies jittered backoff before retrying)."""
         try:
             with self._res_cv:
                 available = dict(self.available)
@@ -1089,37 +1257,62 @@ class Raylet:
             internal_metrics.set_gauge("ray_tpu_workers_idle", float(num_idle))
             self._report_store_gauges()
             ok = self.gcs.call(
-                "heartbeat", (self.node_id, available, total, demand), timeout=5.0
+                "heartbeat",
+                (self.node_id, available, total, demand, self._probe_snapshot),
+                timeout=5.0,
             )
             if ok is False and not self._stopped.is_set():
                 # the GCS doesn't know us: it restarted (persistence reload
                 # drops node liveness on purpose) — re-register, replaying
                 # our live resource view (reference: NotifyGCSRestart,
-                # node_manager.proto:358)
+                # node_manager.proto:358). The transport may have healed
+                # silently (idempotent-retry reconnect), so subscriptions
+                # need re-establishing too.
                 self._register_with_gcs()
+                self._resubscribe_gcs()
+            return True
         except Exception:
             if self._stopped.is_set():
-                return
+                return True
             # connection to the GCS lost: reconnect and re-register
             try:
                 new_client = RpcClient(
-                    self.gcs_address, on_notify=self._on_gcs_notify
+                    self.gcs_address,
+                    on_notify=self._on_gcs_notify,
+                    connect_timeout=2.0,
                 )
+                new_client.chaos_identity = self._chaos_identity
                 old, self.gcs = self.gcs, new_client
                 try:
                     old.close()
                 except Exception:
                     pass
                 self._register_with_gcs()
-                try:
-                    self.gcs.call("subscribe", "resource_view", timeout=5.0)
-                except Exception:
-                    pass
+                self._resubscribe_gcs()
                 logger.info(
                     "node %s reconnected to restarted GCS", self.node_id.hex()[:8]
                 )
+                return True
             except Exception:
-                pass  # GCS still down; next heartbeat retries
+                return False  # GCS still down; the loop backs off
+
+    def _resubscribe_gcs(self):
+        """Re-establish pubsub + chaos state after a GCS reconnect or
+        restart (subscriptions are per-connection on the GCS side)."""
+        try:
+            self.gcs.call("subscribe", "resource_view", timeout=5.0)
+        except Exception:
+            pass
+        try:
+            self.gcs.call("subscribe", "chaos", timeout=5.0)
+            blob = self.gcs.call("kv_get", ("chaos", "schedule"), timeout=5.0)
+            if blob:
+                self._arm_chaos(json.loads(blob))
+            else:
+                self._chaos_armed = None
+                fault_injection.disarm()
+        except Exception:
+            pass
 
     def _register_with_gcs(self):
         with self._res_cv:
@@ -1208,6 +1401,7 @@ class Raylet:
             if client is not None and not client.closed:
                 return client
             client = RpcClient(addr)
+            client.chaos_identity = self._chaos_identity
             self._peers[addr] = client
             return client
 
@@ -1334,8 +1528,24 @@ class Raylet:
 
     def _heartbeat_loop(self):
         period = GlobalConfig.health_check_period_s
-        while not self._stopped.wait(period / 2):
-            self._heartbeat_now()
+        failures = 0
+        while True:
+            if failures == 0:
+                delay = period / 2
+            else:
+                # capped exponential backoff with FULL jitter: after a GCS
+                # restart every raylet retries at a decorrelated moment
+                # instead of the whole fleet stampeding re-registration on
+                # a shared period (reference: gcs_rpc_client.h retry +
+                # the classic exponential-backoff-and-jitter result)
+                cap = GlobalConfig.heartbeat_reconnect_backoff_cap_s
+                delay = max(
+                    0.05,
+                    random.uniform(0.0, min(cap, (period / 2) * (2 ** failures))),
+                )
+            if self._stopped.wait(delay):
+                return
+            failures = 0 if self._heartbeat_now() else failures + 1
             self._reap_idle_workers()
 
     def _reap_idle_workers(self):

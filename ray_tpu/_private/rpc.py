@@ -25,14 +25,17 @@ import hmac
 import itertools
 import os
 import pickle
+import random
 import selectors
 import socket
 import struct
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private.config import GlobalConfig
 
 # Versioned wire header: magic + version + frame kind + payload length.
@@ -294,6 +297,43 @@ class RpcError(Exception):
 
 class ConnectionLost(RpcError):
     pass
+
+
+class NonIdempotentRpcError(ConnectionLost):
+    """A non-idempotent RPC lost its connection after the request may have
+    reached the peer: retrying could double-apply it, so the caller must
+    decide (re-issue with its own dedup, or surface the failure).
+    Subclasses ConnectionLost so existing connection-failure handling
+    still catches it."""
+
+
+#: methods the client retries transparently across reconnects (read-only,
+#: or safe to double-apply: last-write-wins KV, re-subscription on the
+#: replacement connection, cumulative-snapshot metric reports). Everything
+#: else fails fast with NonIdempotentRpcError on connection loss —
+#: heartbeat/register_node stay out so the raylet's own re-registration
+#: logic remains the single authority on node identity.
+IDEMPOTENT_METHODS = frozenset({
+    # GCS reads
+    "get_nodes", "get_actor", "get_actor_by_name", "list_actors",
+    "wait_for_actor", "wait_placement_group", "placement_group_table",
+    "get_jobs", "list_cluster_events", "get_task_events", "locate_worker",
+    "get_config", "get_metrics", "chaos_status", "chaos_report",
+    # GCS KV / pubsub / metrics
+    "kv_get", "kv_multi_get", "kv_keys", "kv_put", "kv_del",
+    "subscribe", "report_metrics",
+    # raylet reads
+    "get_node_info", "ping", "store_get", "store_contains", "store_stats",
+    "store_list", "store_fetch", "store_pull", "list_logs", "read_log",
+    "dump_stacks",
+    # retry-safe store mutations: store_put is duplicate-tolerant (re-put
+    # of a sealed object no-ops), seal/delete/abort converge on re-apply.
+    # store_create and store_release are NOT here: create reserves a fresh
+    # arena offset (a duplicate would strand the first), release
+    # decrements a reader pin count (a duplicate unpins someone else).
+    "store_put", "store_seal", "store_delete", "store_delete_batch",
+    "store_abort",
+})
 
 
 def _wire_safe_exc(e: BaseException) -> BaseException:
@@ -969,6 +1009,27 @@ class ServerConn:
             return
         msg_id, method, payload = _decode_body(body)
         srv = self._server
+        if _fi._armed is not None:
+            decision = _fi.decide("recv", method, _fi.addr_key(self.addr),
+                                  identity=srv.chaos_identity)
+            if decision is not None:
+                action = decision["action"]
+                if action == "drop":
+                    return  # request vanishes: the caller times out
+                if action == "disconnect":
+                    raise ConnectionLost("chaos: injected disconnect")
+                if action == "delay":
+                    # never sleep on the poller thread — defer the dispatch
+                    threading.Timer(
+                        decision["delay_ms"] / 1000.0,
+                        srv._pool.submit,
+                        args=(srv._dispatch, self, msg_id, method, payload),
+                    ).start()
+                    return
+                if action == "duplicate":
+                    # dispatch an extra copy; both replies carry the same
+                    # msg_id, the caller keeps the first and drops the rest
+                    srv._pool.submit(srv._dispatch, self, msg_id, method, payload)
         if method in srv._inline:
             # order-sensitive handlers run right here on the poller thread
             # (non-blocking by contract; a Deferred reply is sent by its
@@ -1060,6 +1121,10 @@ class RpcServer:
 
     def __init__(self, name: str = "rpc", host: str = "127.0.0.1", port: int = 0):
         self.name = name
+        # chaos attribution: which logical node this server belongs to
+        # (in-process test clusters host several nodes per process, so the
+        # armed schedule's process identity alone is ambiguous)
+        self.chaos_identity = None
         self._handlers: Dict[str, Callable[[ServerConn, Any], Any]] = {}
         self._inline: set = set()
         self._pool = _DynamicPool(
@@ -1224,22 +1289,11 @@ class RpcClient:
         connect_timeout: Optional[float] = None,
         inline_notify: bool = False,
     ):
-        timeout = connect_timeout or GlobalConfig.rpc_connect_timeout_s
-        deadline = time.monotonic() + timeout
-        last_err: Optional[Exception] = None
-        while True:
-            try:
-                self._sock = socket.create_connection(address, timeout=timeout)
-                break
-            except OSError as e:
-                last_err = e
-                if time.monotonic() > deadline:
-                    raise ConnectionLost(f"cannot connect to {address}: {e}") from e
-                time.sleep(0.05)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.setblocking(False)
         self.address = address
-        self.sender = _SendState(self._sock, self)
+        # chaos attribution (see RpcServer.chaos_identity): owners set
+        # this so partition rules resolve "which side am I on" per client
+        self.chaos_identity = None
+        self._connect_timeout = connect_timeout or GlobalConfig.rpc_connect_timeout_s
         self._pending: Dict[int, Any] = {}
         self._pending_lock = threading.Lock()
         self._ids = itertools.count(1)
@@ -1249,11 +1303,33 @@ class RpcClient:
         # consumers that sequence streamed item frames against a terminal
         # response (batched task pushes). Handlers must be non-blocking.
         self._inline_notify = inline_notify
-        self._closed = threading.Event()
-        self._frames = _FrameBuffer()
         self._notify_q: deque = deque()
         self._notify_draining = False
+        self._user_closed = False  # close() called: never auto-reconnect
+        self._reconnect_lock = threading.Lock()
+        self._conn_gen = 0
+        self._connect(self._connect_timeout)
+
+    def _connect(self, timeout: float):
+        """Establish (or re-establish) the transport. Fresh socket, frame
+        buffer, closed-event and sender each time — the old connection's
+        state never bleeds into the new one."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection(self.address, timeout=timeout)
+                break
+            except OSError as e:
+                if time.monotonic() > deadline:
+                    raise ConnectionLost(f"cannot connect to {self.address}: {e}") from e
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.setblocking(False)
+        self.sender = _SendState(self._sock, self)
+        self._closed = threading.Event()
+        self._frames = _FrameBuffer()
         self._poller = _get_poller()
+        self._conn_gen += 1
         if isinstance(self._poller, _NativePoller):
             self.sender = self._poller.attach(self._sock, self)
         else:
@@ -1261,6 +1337,20 @@ class RpcClient:
         if session_token() is not None:
             # first frame on the wire: prove session membership
             self.sender.send_frame((AUTH, 0, "", session_token()))
+
+    def _reconnect(self, gen: int):
+        """Replace a dead transport (single-flight). ``gen`` is the
+        connection generation the caller observed failing: when another
+        thread already reconnected past it, this is a no-op."""
+        with self._reconnect_lock:
+            if self._user_closed:
+                raise ConnectionLost(f"connection to {self.address} closed")
+            if self._conn_gen != gen:
+                return  # a concurrent caller already replaced the transport
+            self._teardown(ConnectionLost(f"connection to {self.address} lost"))
+            # short cap: a reconnect probe must not inherit the generous
+            # first-connect budget (callers are inside a retry loop)
+            self._connect(min(self._connect_timeout, 2.0))
 
     # -- poller interface ----------------------------------------------
 
@@ -1336,19 +1426,96 @@ class RpcClient:
     # -- public API ----------------------------------------------------
 
     def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        """One RPC round trip, with idempotency-classified retry: methods
+        in IDEMPOTENT_METHODS retry across reconnects (and, while a chaos
+        schedule is armed, across timeouts) with capped exponential
+        backoff + full jitter; non-idempotent methods fail fast with
+        NonIdempotentRpcError on connection loss."""
+        idempotent = method in IDEMPOTENT_METHODS
+        attempts = max(1, int(GlobalConfig.rpc_retry_max_attempts))
+        base = GlobalConfig.rpc_retry_backoff_base_s
+        cap = GlobalConfig.rpc_retry_backoff_cap_s
+        attempt = 0
+        while True:
+            gen = self._conn_gen
+            try:
+                return self._call_once(method, payload, timeout)
+            except TimeoutError:
+                # retrying timeouts is only safe when the timeout was OUR
+                # injection: without chaos armed, honor the caller's
+                # deadline contract exactly as before
+                if not idempotent or _fi._armed is None:
+                    raise
+                attempt += 1
+                if attempt >= attempts:
+                    raise
+            except ConnectionLost as e:
+                if self._user_closed or isinstance(e, NonIdempotentRpcError):
+                    raise
+                if not idempotent:
+                    raise NonIdempotentRpcError(
+                        f"rpc {method} to {self.address} failed after the "
+                        f"request may have been delivered; not retrying a "
+                        f"non-idempotent method: {e}"
+                    ) from e
+                attempt += 1
+                if attempt >= attempts:
+                    raise
+            from ray_tpu._private import internal_metrics
+
+            internal_metrics.inc(
+                "ray_tpu_rpc_retries_total", tags={"method": method}
+            )
+            # full jitter: each retrier draws uniformly in [0, capped
+            # exponential] so a thundering herd decorrelates
+            time.sleep(random.uniform(0.0, min(cap, base * (2 ** (attempt - 1)))))
+            if self._closed.is_set():
+                try:
+                    self._reconnect(gen)
+                except ConnectionLost:
+                    continue  # next _call_once fails fast, consuming an attempt
+
+    def _call_once(self, method: str, payload: Any, timeout: Optional[float]) -> Any:
         if self._closed.is_set():
             raise ConnectionLost(f"connection to {self.address} closed")
+        duplicate = False
+        if _fi._armed is not None:
+            decision = _fi.decide("send", method, _fi.addr_key(self.address),
+                                  identity=self.chaos_identity)
+            if decision is not None:
+                action = decision["action"]
+                if action == "drop":
+                    # the request never leaves the process: park for the
+                    # caller's deadline (bounded), then time out exactly
+                    # like a lost frame would
+                    time.sleep(min(timeout if timeout is not None else 30.0, 30.0))
+                    raise TimeoutError(
+                        f"rpc {method} to {self.address} timed out "
+                        f"(chaos: injected drop)"
+                    )
+                if action == "disconnect":
+                    self._teardown(ConnectionLost("chaos: injected disconnect"))
+                    raise ConnectionLost("chaos: injected disconnect")
+                if action == "delay":
+                    time.sleep(decision["delay_ms"] / 1000.0)
+                elif action == "duplicate":
+                    duplicate = True
         msg_id = next(self._ids)
         slot = {"event": threading.Event(), "result": None}
         with self._pending_lock:
             self._pending[msg_id] = slot
         try:
             self.sender.send_frame((REQUEST, msg_id, method, payload))
+            if duplicate:
+                self.sender.send_frame((REQUEST, msg_id, method, payload))
         except (ConnectionLost, OSError) as e:
             with self._pending_lock:
                 self._pending.pop(msg_id, None)
             raise ConnectionLost(str(e)) from e
         if not slot["event"].wait(timeout):
+            # popping the slot here is what makes a LATE reply to this
+            # msg_id drop silently in _on_frame — ids are never recycled
+            # (itertools.count), so it cannot land in another call's slot
             with self._pending_lock:
                 self._pending.pop(msg_id, None)
             raise TimeoutError(f"rpc {method} to {self.address} timed out after {timeout}s")
@@ -1359,37 +1526,114 @@ class RpcClient:
             raise payload
         return payload
 
-    def call_async(self, method: str, payload: Any, callback: Callable[[int, Any], None]):
+    def call_async(
+        self,
+        method: str,
+        payload: Any,
+        callback: Callable[[int, Any], None],
+        timeout: Optional[float] = None,
+    ):
         """Fire a request; ``callback(kind, payload)`` runs on the shared
-        callback executor when the response (or connection error) arrives."""
+        callback executor when the response (or connection error) arrives.
+        Every slot carries a deadline (default rpc_async_call_timeout_s;
+        0 disables): a peer that hangs without closing can no longer pin
+        the slot — and its callback — forever. The reaper fires the
+        callback with a TimeoutError and drops the slot; a reply arriving
+        after that is discarded silently."""
         if self._closed.is_set():
             _get_callback_executor().submit(
                 callback, ERROR, ConnectionLost(f"connection to {self.address} closed")
             )
             return
+        send_delay = 0.0
+        duplicate = False
+        if _fi._armed is not None:
+            decision = _fi.decide("send", method, _fi.addr_key(self.address),
+                                  identity=self.chaos_identity)
+            if decision is not None:
+                action = decision["action"]
+                if action == "disconnect":
+                    self._teardown(ConnectionLost("chaos: injected disconnect"))
+                    _get_callback_executor().submit(
+                        callback, ERROR, ConnectionLost("chaos: injected disconnect")
+                    )
+                    return
+                if action == "drop":
+                    # no send, but the slot's deadline still fires: the
+                    # caller sees the same TimeoutError a lost reply causes
+                    slot = {"callback": callback}
+                    self._arm_slot_deadline(slot, timeout)
+                    with self._pending_lock:
+                        self._pending[next(self._ids)] = slot
+                    return
+                if action == "delay":
+                    send_delay = decision["delay_ms"] / 1000.0
+                elif action == "duplicate":
+                    duplicate = True
         msg_id = next(self._ids)
+        slot = {"callback": callback}
+        self._arm_slot_deadline(slot, timeout)
         with self._pending_lock:
-            self._pending[msg_id] = {"callback": callback}
-        try:
-            self.sender.send_frame((REQUEST, msg_id, method, payload))
-        except (ConnectionLost, OSError) as e:
-            with self._pending_lock:
-                self._pending.pop(msg_id, None)
-            _get_callback_executor().submit(callback, ERROR, ConnectionLost(str(e)))
+            self._pending[msg_id] = slot
+
+        def _send():
+            try:
+                self.sender.send_frame((REQUEST, msg_id, method, payload))
+                if duplicate:
+                    self.sender.send_frame((REQUEST, msg_id, method, payload))
+            except (ConnectionLost, OSError) as e:
+                with self._pending_lock:
+                    self._pending.pop(msg_id, None)
+                _get_callback_executor().submit(callback, ERROR, ConnectionLost(str(e)))
+
+        if send_delay > 0:
+            threading.Timer(send_delay, _send).start()
+        else:
+            _send()
+
+    def _arm_slot_deadline(self, slot: Dict[str, Any], timeout: Optional[float]):
+        if timeout is None:
+            timeout = GlobalConfig.rpc_async_call_timeout_s
+        if timeout and timeout > 0:
+            slot["deadline"] = time.monotonic() + timeout
+            _reaper_track(self)
+
+    def _reap_expired(self, now: float):
+        """Fail callback slots whose deadline passed (reaper thread)."""
+        expired = []
+        with self._pending_lock:
+            for msg_id, slot in list(self._pending.items()):
+                deadline = slot.get("deadline")
+                if deadline is not None and now > deadline:
+                    expired.append(self._pending.pop(msg_id))
+        for slot in expired:
+            _get_callback_executor().submit(
+                slot["callback"],
+                ERROR,
+                TimeoutError(f"async rpc to {self.address} timed out (reaped)"),
+            )
 
     @property
     def closed(self) -> bool:
         return self._closed.is_set()
 
-    def close(self):
-        self._poller.unregister(self._sock)
-        was_closed = self._closed.is_set()
+    def _teardown(self, err: ConnectionLost):
+        """Tear the current transport down (fails all pending slots) but
+        leave the client reconnectable — unlike close()."""
+        try:
+            self._poller.unregister(self._sock)
+        except Exception:
+            pass
         try:
             self._sock.close()
         except OSError:
             pass
-        if not was_closed:
-            self.on_closed(ConnectionLost(f"connection to {self.address} closed"))
+        if not self._closed.is_set():
+            self.on_closed(err)
+
+    def close(self):
+        self._user_closed = True
+        self._teardown(ConnectionLost(f"connection to {self.address} closed"))
 
 
 class _CallbackExecutor:
@@ -1429,3 +1673,44 @@ def _get_callback_executor() -> _CallbackExecutor:
         if _callback_executor is None:
             _callback_executor = _CallbackExecutor()
         return _callback_executor
+
+
+# ---------------------------------------------------------------------------
+# async-slot reaper
+# ---------------------------------------------------------------------------
+#
+# call_async slots used to live in RpcClient._pending until a reply or a
+# connection close arrived; a peer that hangs WITHOUT closing retained the
+# slot (and its callback closure) forever. One process-wide daemon sweeps
+# clients that have armed deadlines and fails expired slots with a
+# TimeoutError. Weak references: tracking a client must not keep it (or
+# its socket) alive.
+
+_reaper_clients: "weakref.WeakSet" = weakref.WeakSet()
+_reaper_lock = threading.Lock()
+_reaper_started = False
+
+
+def _reaper_track(client: "RpcClient") -> None:
+    global _reaper_started
+    _reaper_clients.add(client)
+    if _reaper_started:
+        return
+    with _reaper_lock:
+        if _reaper_started:
+            return
+        _reaper_started = True
+        threading.Thread(
+            target=_reaper_loop, name="rpc-async-reaper", daemon=True
+        ).start()
+
+
+def _reaper_loop() -> None:
+    while True:
+        time.sleep(1.0)
+        now = time.monotonic()
+        for client in list(_reaper_clients):
+            try:
+                client._reap_expired(now)
+            except Exception:
+                pass  # a torn-down client must not stop the sweep

@@ -1,0 +1,128 @@
+"""Public chaos-engineering surface: deterministic cluster-wide fault
+injection.
+
+A *fault schedule* is a plain dict — ``{"seed": 42, "rules": [...]}`` —
+whose rules match RPC traffic (plane × method × peer × nth-occurrence or
+seeded probability) or name process/topology/store faults. Applying it
+distributes it through the GCS to every raylet, worker, and driver; each
+process arms the identical schedule from the identical seed, so a chaos
+run replays exactly (see ``ray_tpu._private.fault_injection`` for the
+rule reference).
+
+    import ray_tpu
+    from ray_tpu import chaos
+
+    ray_tpu.init()
+    chaos.apply({"seed": 7, "rules": [
+        {"action": "drop", "method": "store_*", "probability": 0.05},
+        {"action": "partition", "nodes": ["node-1", "node-2"]},
+    ]})
+    ...  # run the workload under fault
+    print(chaos.report())   # per-node injection logs + chaos events
+    chaos.clear()
+
+CLI: ``ray_tpu chaos apply schedule.yaml`` / ``status`` / ``report`` /
+``clear``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "apply",
+    "clear",
+    "status",
+    "report",
+    "partition",
+    "unpartition",
+    "load_schedule",
+]
+
+
+def _gcs_call(method: str, payload=None, *,
+              address: Optional[str] = None, timeout: float = 30.0):
+    if address is not None:
+        from ray_tpu.util.state import _cached_client
+
+        return _cached_client(address).call(method, payload, timeout=timeout)
+    import ray_tpu._private.worker as worker_mod
+
+    worker = worker_mod.global_worker
+    if worker is None or worker.core is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized (call ray_tpu.init()) and no "
+            "address= was given"
+        )
+    return worker.core.gcs.call(method, payload, timeout=timeout)
+
+
+def apply(schedule: Dict[str, Any], *, address: Optional[str] = None) -> int:
+    """Validate and arm a fault schedule cluster-wide. Returns the
+    GCS-assigned schedule version. Re-applying replaces the previous
+    schedule (rule counters reset); already-executed kill rules do not
+    re-fire in surviving processes."""
+    from ray_tpu._private import fault_injection
+
+    fault_injection.validate_schedule(schedule)
+    return _gcs_call("chaos_apply", dict(schedule), address=address)
+
+
+def clear(*, address: Optional[str] = None) -> bool:
+    """Disarm everywhere. Returns True if a schedule was armed."""
+    return _gcs_call("chaos_clear", address=address)
+
+
+def status(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """``{"armed": bool, "version": int, "schedule": dict | None}``."""
+    return _gcs_call("chaos_status", address=address)
+
+
+def report(*, address: Optional[str] = None) -> Dict[str, Any]:
+    """Cluster-wide injection report: per-node deterministic injection
+    logs (``reports``), chaos-related cluster events (``events``: armed/
+    cleared/degraded/recovered/died), and ``total_injected``."""
+    return _gcs_call("chaos_report", address=address)
+
+
+def _edit_partitions(a: str, b: str, action: str,
+                     address: Optional[str]) -> int:
+    current = status(address=address).get("schedule") or {"seed": 0, "rules": []}
+    rules = [
+        r for r in current.get("rules", [])
+        # drop a pre-existing rule for the same pair (either order)
+        if not (r.get("action") in ("partition", "unpartition")
+                and sorted(map(str, r.get("nodes", ()))) == sorted((a, b)))
+    ]
+    rules.append({"action": action, "nodes": [a, b]})
+    current["rules"] = rules
+    return apply(current, address=address)
+
+
+def partition(a: str, b: str, *, address: Optional[str] = None) -> int:
+    """Symmetrically partition two nodes (names, ids, ``"gcs"``, or
+    ``host:port``): each side drops everything it sends to the other.
+    Convenience wrapper that re-applies the current schedule with a
+    partition rule appended."""
+    return _edit_partitions(a, b, "partition", address)
+
+
+def unpartition(a: str, b: str, *, address: Optional[str] = None) -> int:
+    """Heal a partition previously injected between two nodes."""
+    return _edit_partitions(a, b, "unpartition", address)
+
+
+def load_schedule(path: str) -> Dict[str, Any]:
+    """Load a schedule from a YAML or JSON file (by extension)."""
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        schedule = yaml.safe_load(text)
+    else:
+        schedule = json.loads(text)
+    if not isinstance(schedule, dict):
+        raise ValueError(f"{path}: expected a mapping with 'seed'/'rules'")
+    return schedule

@@ -167,7 +167,8 @@ def cmd_status(args) -> int:
     nodes = list_nodes(address=address)
     print(f"cluster at {address}: {sum(n['alive'] for n in nodes)} alive node(s)")
     for n in nodes:
-        state = "ALIVE" if n["alive"] else "DEAD "
+        state = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
+        state = f"{state:<8}"
         res = " ".join(
             f"{k}={n['available'].get(k, 0):g}/{v:g}"
             for k, v in sorted(n["resources"].items())
@@ -258,6 +259,32 @@ def cmd_stack(args) -> int:
     print(state_api.format_stack_report(report))
     for err in getattr(report, "errors", ()):
         print(f"!! node {err['node_id'][:12]} unreachable: {err['error']}")
+    return 0
+
+
+def cmd_chaos(args) -> int:
+    """``raytpu chaos apply/status/report/clear`` — arm a deterministic
+    fault schedule (YAML or JSON file) against a running cluster."""
+    from ray_tpu import chaos
+
+    address = _head_address(args.address)
+    if args.chaos_cmd == "apply":
+        schedule = chaos.load_schedule(args.schedule)
+        version = chaos.apply(schedule, address=address)
+        n = len(schedule.get("rules", []))
+        print(f"armed schedule v{version} ({n} rule(s), "
+              f"seed={schedule.get('seed', 0)})")
+        return 0
+    if args.chaos_cmd == "status":
+        print(json.dumps(chaos.status(address=address), indent=2,
+                         default=_json_default))
+        return 0
+    if args.chaos_cmd == "report":
+        print(json.dumps(chaos.report(address=address), indent=2,
+                         default=_json_default))
+        return 0
+    cleared = chaos.clear(address=address)
+    print("cleared" if cleared else "nothing armed")
     return 0
 
 
@@ -421,6 +448,29 @@ def build_parser() -> argparse.ArgumentParser:
     d = serve_sub.add_parser("shutdown", help="tear down all deployments")
     d.add_argument("--address")
     d.set_defaults(fn=cmd_serve)
+
+    s = sub.add_parser(
+        "chaos",
+        help="deterministic fault injection (apply/status/report/clear)",
+        description="Arm a seed-driven fault schedule cluster-wide. The "
+        "schedule file (YAML or JSON) holds {seed, rules}; rules drop/"
+        "delay/duplicate RPCs, partition or kill nodes, and slow store "
+        "reads — deterministically, so a chaos run replays exactly.",
+    )
+    chaos_sub = s.add_subparsers(dest="chaos_cmd", required=True)
+    d = chaos_sub.add_parser("apply", help="arm a schedule from a file")
+    d.add_argument("schedule", help="path to a YAML/JSON fault schedule")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_chaos)
+    d = chaos_sub.add_parser("status", help="armed schedule, if any")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_chaos)
+    d = chaos_sub.add_parser("report", help="per-node injection logs")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_chaos)
+    d = chaos_sub.add_parser("clear", help="disarm everywhere")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_chaos)
 
     s = sub.add_parser("submit", help="run an entrypoint as a tracked job")
     s.add_argument("--address")

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Seeded chaos smoke (<90 s): arms a deterministic fault schedule on an
+# in-process cluster, drives a retryable workload through injected RPC
+# drops + a worker kill, then partitions a node and asserts the
+# DEGRADED -> recovered gray-failure lifecycle and the chaos report.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py "$@"

@@ -10,5 +10,7 @@ python scripts/check_metrics_catalog.py
 # perf floor check (warn-only): put/get/submit micro-run vs the newest
 # archived bench round, so put-path regressions are visible per-PR
 env JAX_PLATFORMS=cpu python scripts/bench_smoke.py
+# seeded chaos run: fault injection + gray-failure lifecycle end to end
+bash scripts/chaos_smoke.sh
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
     tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py "$@"

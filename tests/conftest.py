@@ -36,6 +36,13 @@ except AttributeError:
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/soak tests, excluded from the tier-1 run",
+    )
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

@@ -1,16 +1,30 @@
-"""Chaos: kill a worker node DURING a JaxTrainer.fit and assert
-checkpoint-restart recovery (reference: release/nightly_tests/chaos_test/
-+ _private/test_utils.py:1367 NodeKillerActor — random node kills during a
-live training workload, not just targeted unit-test kills)."""
+"""Deterministic chaos plane: fault injection + gray-failure hardening.
 
-import os
+Covers the seed-driven FaultSchedule (same seed => identical injection
+log), RPC-boundary injection (drop/delay/duplicate/disconnect) with
+idempotency-classified retry, the DEGRADED gray-failure lifecycle
+(partition -> DEGRADED -> recovered, and escalation to DEAD), lineage
+reconstruction after a chaos-induced node death, and node kills during a
+live JaxTrainer.fit (reference: release/nightly_tests/chaos_test/ +
+_private/test_utils.py:1367 NodeKillerActor)."""
+
 import threading
 import time
 
+import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu import train
+from ray_tpu import chaos, train
+from ray_tpu._private import fault_injection as fi
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.rpc import (
+    ERROR,
+    ConnectionLost,
+    NonIdempotentRpcError,
+    RpcClient,
+    RpcServer,
+)
 from ray_tpu.cluster_utils import Cluster
 from ray_tpu.train import (
     Checkpoint,
@@ -19,6 +33,543 @@ from ray_tpu.train import (
     RunConfig,
     ScalingConfig,
 )
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    fi.disarm()
+    fi._executed_kills.clear()
+
+
+# ---------------------------------------------------------------------------
+# schedule semantics (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def _drive(armed, n=200):
+    """Fixed synthetic call sequence; returns the injection log."""
+    for i in range(n):
+        armed.decide("send", f"method_{i % 3}", f"peer:{i % 2}")
+    return [dict(e) for e in armed.log]
+
+
+def test_same_seed_same_injection_log():
+    schedule = {
+        "seed": 1234,
+        "rules": [
+            {"action": "drop", "method": "method_0", "probability": 0.3},
+            {"action": "delay", "method": "method_*", "nth": 7, "delay_ms": 5},
+            {"action": "duplicate", "peer": "peer:1", "probability": 0.1},
+        ],
+    }
+    log_a = _drive(fi.ArmedSchedule(schedule))
+    log_b = _drive(fi.ArmedSchedule(schedule))
+    assert log_a == log_b  # the log IS the reproducibility artifact
+    assert len(log_a) > 0
+    # entries carry no wall-clock — nothing run-dependent in the artifact
+    assert set(log_a[0]) == {"seq", "rule", "action", "method", "peer", "side"}
+
+
+def test_different_seed_different_injections():
+    base = {
+        "rules": [{"action": "drop", "method": "method_0", "probability": 0.3}]
+    }
+    log_a = _drive(fi.ArmedSchedule({**base, "seed": 1}))
+    log_b = _drive(fi.ArmedSchedule({**base, "seed": 2}))
+    assert log_a != log_b
+
+
+def test_validate_schedule_rejects_malformed():
+    with pytest.raises(ValueError):
+        fi.validate_schedule({"rules": [{"action": "explode"}]})
+    with pytest.raises(ValueError):
+        fi.validate_schedule({"rules": [{"action": "drop", "bogus_key": 1}]})
+    with pytest.raises(ValueError):
+        fi.validate_schedule({"rules": [{"action": "partition"}]})  # no nodes
+    with pytest.raises(ValueError):
+        fi.validate_schedule(
+            {"rules": [{"action": "drop", "probability": 1.5}]}
+        )
+    fi.validate_schedule({"seed": 1, "rules": []})  # empty is fine
+
+
+def test_nth_and_max_injections():
+    armed = fi.ArmedSchedule(
+        {"seed": 0, "rules": [{"action": "drop", "nth": 3}]}
+    )
+    decisions = [armed.decide("send", "m", None) for _ in range(5)]
+    assert [d is not None for d in decisions] == [
+        False, False, True, False, False
+    ]
+    armed = fi.ArmedSchedule(
+        {"seed": 0, "rules": [{"action": "drop", "max_injections": 2}]}
+    )
+    decisions = [armed.decide("send", "m", None) for _ in range(5)]
+    assert sum(d is not None for d in decisions) == 2
+
+
+def test_partition_is_symmetric_and_unpartition_heals():
+    nodes = [
+        {"node_id": "aa", "node_name": "node-a", "addresses": ["h:1"]},
+        {"node_id": "bb", "node_name": "node-b", "addresses": ["h:2"]},
+    ]
+    armed = fi.ArmedSchedule(
+        {
+            "seed": 0,
+            "cluster_nodes": nodes,
+            "rules": [{"action": "partition", "nodes": ["node-a", "node-b"]}],
+        }
+    )
+    ident_a = fi.identity_for("aa", "h:1")
+    ident_b = fi.identity_for("bb", "h:2")
+    ident_c = fi.identity_for("cc", "h:3")
+    assert armed.decide("send", "x", "h:2", identity=ident_a) is not None
+    assert armed.decide("send", "x", "h:1", identity=ident_b) is not None
+    # a third node talks to both sides freely
+    assert armed.decide("send", "x", "h:1", identity=ident_c) is None
+    assert armed.decide("send", "x", "h:2", identity=ident_c) is None
+    # an unpartition rule later in the list removes the cut
+    healed = fi.ArmedSchedule(
+        {
+            "seed": 0,
+            "cluster_nodes": nodes,
+            "rules": [
+                {"action": "partition", "nodes": ["node-a", "node-b"]},
+                {"action": "unpartition", "nodes": ["node-a", "node-b"]},
+            ],
+        }
+    )
+    assert healed.decide("send", "x", "h:2", identity=ident_a) is None
+
+
+def test_control_rpcs_exempt_from_blanket_drop():
+    armed = fi.ArmedSchedule(
+        {"seed": 0, "rules": [{"action": "drop", "probability": 1.0}]}
+    )
+    # a blanket drop must not make chaos_clear undeliverable
+    assert armed.decide("send", "chaos_clear", "h:1") is None
+    assert armed.decide("send", "kv_get", "h:1") is not None
+
+
+def test_kill_rules_execute_once_per_rule():
+    schedule = {"seed": 9, "rules": [{"action": "kill_worker"}]}
+    armed = fi.ArmedSchedule(schedule, local_node_id="aa")
+    first = fi.take_process_actions(armed, identity=fi.identity_for("aa"))
+    assert len(first) == 1
+    # re-applying the same schedule (e.g. a version bump from
+    # chaos.partition()) must not re-kill
+    rearmed = fi.ArmedSchedule(schedule, local_node_id="aa")
+    again = fi.take_process_actions(rearmed, identity=fi.identity_for("aa"))
+    assert again == []
+
+
+# ---------------------------------------------------------------------------
+# RPC-boundary injection + idempotency-classified retry (raw rpc layer)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def echo_server():
+    srv = RpcServer(name="chaos-test")
+    state = {"calls": {}, "kv": {}}
+
+    def _count(method):
+        state["calls"][method] = state["calls"].get(method, 0) + 1
+
+    def kv_get(conn, payload):
+        _count("kv_get")
+        return state["kv"].get(payload)
+
+    def kv_put(conn, payload):
+        _count("kv_put")
+        k, v = payload
+        state["kv"][k] = v
+        return True
+
+    def mutate(conn, payload):
+        _count("mutate")
+        return state["calls"]["mutate"]
+
+    srv.register("kv_get", kv_get)
+    srv.register("kv_put", kv_put)
+    srv.register("mutate", mutate)
+    client = RpcClient(srv.address)
+    yield srv, client, state
+    client.close()
+    srv.stop()
+
+
+def test_duplicate_delivery_is_idempotent(echo_server):
+    srv, client, state = echo_server
+    fi.arm(
+        {
+            "seed": 0,
+            "rules": [{"action": "duplicate", "method": "kv_put", "nth": 1}],
+        }
+    )
+    assert client.call("kv_put", ("k", "v"), timeout=10) is True
+    deadline = time.monotonic() + 5
+    while state["calls"].get("kv_put", 0) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    # the handler really ran twice; one reply won, state converged
+    assert state["calls"]["kv_put"] == 2
+    assert state["kv"] == {"k": "v"}
+    assert client.call("kv_get", "k", timeout=10) == "v"
+    assert fi.local_report()["counts"].get("duplicate") == 1
+
+
+def test_idempotent_call_retries_through_injected_drop(echo_server):
+    srv, client, state = echo_server
+    fi.arm(
+        {
+            "seed": 0,
+            "rules": [{"action": "drop", "method": "kv_get", "nth": 1}],
+        }
+    )
+    state["kv"]["k"] = 42
+    t0 = time.monotonic()
+    # first send is swallowed -> injected timeout -> retried (idempotent)
+    assert client.call("kv_get", "k", timeout=1.0) == 42
+    assert time.monotonic() - t0 >= 0.9  # really ate the injected timeout
+    assert state["calls"]["kv_get"] == 1  # dropped call never reached it
+    assert fi.local_report()["counts"].get("drop") == 1
+
+
+def test_non_idempotent_fails_fast_on_disconnect(echo_server):
+    srv, client, state = echo_server
+    fi.arm(
+        {
+            "seed": 0,
+            "rules": [{"action": "disconnect", "method": "mutate", "nth": 1}],
+        }
+    )
+    with pytest.raises(NonIdempotentRpcError):
+        client.call("mutate", None, timeout=10)
+    assert state["calls"].get("mutate", 0) == 0
+    # the classified error still reads as a ConnectionLost to old handlers
+    assert issubclass(NonIdempotentRpcError, ConnectionLost)
+    # the same client recovers for the next (idempotent) call: transparent
+    # reconnect inside the retry loop
+    state["kv"]["x"] = 1
+    assert client.call("kv_get", "x", timeout=10) == 1
+
+
+def test_injected_delay_defers_delivery(echo_server):
+    srv, client, state = echo_server
+    fi.arm(
+        {
+            "seed": 0,
+            "rules": [
+                {"action": "delay", "method": "kv_get", "nth": 1,
+                 "delay_ms": 300}
+            ],
+        }
+    )
+    state["kv"]["k"] = 7
+    t0 = time.monotonic()
+    assert client.call("kv_get", "k", timeout=10) == 7
+    assert time.monotonic() - t0 >= 0.25
+
+
+def test_call_async_slots_are_reaped(echo_server):
+    """Satellite: a pending call_async slot whose reply never comes is
+    reaped at its deadline instead of leaking forever."""
+    srv, client, state = echo_server
+    fi.arm(
+        {
+            "seed": 0,
+            # drop: the slot is created but the request never sent
+            "rules": [{"action": "drop", "method": "kv_get", "nth": 1}],
+        }
+    )
+    got = []
+    done = threading.Event()
+
+    def cb(kind, result):
+        got.append((kind, result))
+        done.set()
+
+    client.call_async("kv_get", "k", cb, timeout=0.5)
+    assert len(client._pending) == 1
+    # reaper ticks every 1s: the 0.5s deadline fires within two ticks
+    assert done.wait(5.0), "reaper never fired the callback"
+    assert got[0][0] == ERROR
+    assert isinstance(got[0][1], TimeoutError)
+    assert len(client._pending) == 0
+
+
+def test_late_reply_after_timeout_drops_silently(echo_server):
+    srv, client, state = echo_server
+    hold = threading.Event()
+
+    def slow(conn, payload):
+        hold.wait(5)
+        return "late"
+
+    srv.register("slow", slow)
+    with pytest.raises(TimeoutError):
+        client.call("slow", None, timeout=0.2)
+    assert len(client._pending) == 0  # slot removed at timeout
+    hold.set()
+    time.sleep(0.3)  # late reply arrives; must not corrupt anything
+    assert client.call("kv_get", "nope", timeout=10) is None
+
+
+# ---------------------------------------------------------------------------
+# cluster lifecycle: DEGRADED gray-failure state machine
+# ---------------------------------------------------------------------------
+
+
+def _make_cluster(**overrides):
+    cfg = {
+        "health_check_period_s": 0.4,
+        "health_check_failure_threshold": 4,
+        "chaos_probe_period_s": 0.25,
+        "probe_timeout_s": 0.3,
+        "probe_failure_threshold": 2,
+        "degraded_window_s": 60.0,
+        "resource_broadcast_period_s": 0.2,
+    }
+    cfg.update(overrides)
+    saved = dict(GlobalConfig._values)
+    GlobalConfig.initialize(cfg)
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "resources": {"head": 1.0}},
+    )
+    return cluster, saved
+
+
+def _teardown_cluster(cluster, saved):
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    cluster.shutdown()
+    with GlobalConfig._lock:
+        GlobalConfig._values = saved
+
+
+def _node_states(cluster):
+    return {
+        n["labels"].get("node_name"): n.get("state")
+        for n in cluster.list_nodes()
+    }
+
+
+def _await(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_partition_degrades_then_recovers():
+    """Symmetric partition between two workers: heartbeats keep flowing
+    (gray failure), self-probes fail => DEGRADED; healing the partition
+    recovers the node to ALIVE. Events appear in chaos.report()."""
+    cluster, saved = _make_cluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        addr = cluster.address
+        chaos.apply(
+            {
+                "seed": 7,
+                "rules": [
+                    {"action": "partition", "nodes": ["node1", "node2"]}
+                ],
+            },
+            address=addr,
+        )
+        _await(
+            lambda: "DEGRADED" in _node_states(cluster).values(),
+            30,
+            "a DEGRADED node",
+        )
+        # heartbeats still arrive: the node is degraded, NOT dead
+        states = _node_states(cluster)
+        assert "DEAD" not in states.values(), states
+        report = chaos.report(address=addr)
+        assert report["total_injected"] > 0
+        assert any(
+            e["type"] == "NODE_DEGRADED" for e in report["events"]
+        ), report["events"]
+        chaos.clear(address=addr)
+        _await(
+            lambda: all(
+                s == "ALIVE" for s in _node_states(cluster).values()
+            ),
+            30,
+            "recovery to ALIVE",
+        )
+        report = chaos.report(address=addr)
+        assert any(e["type"] == "NODE_RECOVERED" for e in report["events"])
+    finally:
+        _teardown_cluster(cluster, saved)
+
+
+def test_degraded_escalates_to_dead_after_window():
+    """A node that stays gray past degraded_window_s is declared DEAD."""
+    cluster, saved = _make_cluster(degraded_window_s=2.0)
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        addr = cluster.address
+        chaos.apply(
+            {
+                "seed": 7,
+                "rules": [
+                    {"action": "partition", "nodes": ["node1", "node2"]}
+                ],
+            },
+            address=addr,
+        )
+        _await(
+            lambda: "DEAD" in _node_states(cluster).values(),
+            40,
+            "gray-failure escalation to DEAD",
+        )
+        report = chaos.report(address=addr)
+        assert any(e["type"] == "NODE_DEGRADED" for e in report["events"])
+        assert any(e["type"] == "NODE_DIED" for e in report["events"])
+    finally:
+        _teardown_cluster(cluster, saved)
+
+
+def test_gcs_partition_kills_node_and_lineage_recovers():
+    """Partition a node from the GCS: heartbeats stop arriving, the node
+    is declared DEAD, and a task result that lived only there is
+    reconstructed from lineage on a replacement node."""
+    cluster, saved = _make_cluster()
+    try:
+        node_b = cluster.add_node(num_cpus=2, resources={"B": 2.0})
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+        @ray_tpu.remote(resources={"B": 0.001}, max_retries=3)
+        def produce():
+            return np.arange(200_000, dtype=np.int64)
+
+        ref = produce.remote()
+        done, _ = ray_tpu.wait(
+            [ref], num_returns=1, timeout=60, fetch_local=False
+        )
+        assert done
+        chaos.partition("node1", "gcs", address=cluster.address)
+        _await(
+            lambda: _node_states(cluster).get("node1") == "DEAD",
+            40,
+            "partitioned node declared DEAD",
+        )
+        # the raylet object is partitioned, not crashed: stop it so it
+        # cannot re-register once the partition is cleared
+        cluster.remove_node(node_b, graceful=False)
+        chaos.clear(address=cluster.address)
+        cluster.add_node(num_cpus=2, resources={"B": 2.0})
+        arr = ray_tpu.get(ref, timeout=90)
+        np.testing.assert_array_equal(arr[:5], np.arange(5))
+        assert len(arr) == 200_000
+    finally:
+        _teardown_cluster(cluster, saved)
+
+
+def test_seeded_rpc_drop_workload_completes():
+    """Store-plane drops under an object-churn workload: idempotent
+    retries absorb the faults and the run completes."""
+    cluster, saved = _make_cluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address, log_level="WARNING")
+        chaos.apply(
+            {
+                "seed": 42,
+                "rules": [
+                    {
+                        "action": "drop",
+                        "method": "store_*",
+                        "probability": 0.05,
+                        "max_injections": 10,
+                    }
+                ],
+            },
+            address=cluster.address,
+        )
+
+        @ray_tpu.remote
+        def churn(i):
+            return np.full(64 * 1024, i, dtype=np.float32)  # 256 KiB
+
+        refs = [churn.remote(i) for i in range(30)]
+        for i, r in enumerate(refs):
+            arr = ray_tpu.get(r, timeout=120)
+            assert arr[0] == i
+        status = chaos.status(address=cluster.address)
+        assert status["armed"] and status["schedule"]["seed"] == 42
+        chaos.clear(address=cluster.address)
+        assert not chaos.status(address=cluster.address)["armed"]
+    finally:
+        _teardown_cluster(cluster, saved)
+
+
+@pytest.mark.slow
+def test_kill_worker_loop_under_load():
+    """Long chaos soak: repeatedly kill a seeded-chosen worker while a
+    retryable task stream runs; everything still completes."""
+    cluster, saved = _make_cluster()
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address, log_level="WARNING")
+
+        @ray_tpu.remote(max_retries=5)
+        def work(i):
+            time.sleep(0.05)
+            return i * i
+
+        for round_no in range(3):
+            refs = [work.remote(i) for i in range(20)]
+            chaos.apply(
+                {
+                    "seed": 100 + round_no,
+                    "rules": [{"action": "kill_worker", "node": "node1"}],
+                },
+                address=cluster.address,
+            )
+            assert [ray_tpu.get(r, timeout=120) for r in refs] == [
+                i * i for i in range(20)
+            ]
+            chaos.clear(address=cluster.address)
+    finally:
+        _teardown_cluster(cluster, saved)
+
+
+def test_chaos_yaml_roundtrip(tmp_path):
+    path = tmp_path / "schedule.yaml"
+    path.write_text(
+        "seed: 5\n"
+        "rules:\n"
+        "  - action: drop\n"
+        "    method: 'store_*'\n"
+        "    probability: 0.05\n"
+        "  - action: partition\n"
+        "    nodes: [node1, node2]\n"
+    )
+    schedule = chaos.load_schedule(str(path))
+    assert schedule["seed"] == 5
+    assert len(schedule["rules"]) == 2
+    fi.validate_schedule(schedule)
+
+
+# ---------------------------------------------------------------------------
+# node kill during live training (checkpoint-restart recovery)
+# ---------------------------------------------------------------------------
 
 
 def test_node_kill_during_training_recovers(tmp_path):
