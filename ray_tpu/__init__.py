@@ -27,10 +27,15 @@ from ray_tpu.api import (
     ObjectStoreFullError,
     RayTpuError,
     RemoteFunction,
+    RuntimeContext,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
+    cancel,
+    drain_node,
     get,
     get_actor,
+    get_runtime_context,
     kill,
     nodes,
     put,
@@ -64,6 +69,10 @@ __all__ = [
     "put",
     "wait",
     "kill",
+    "cancel",
+    "drain_node",
+    "get_runtime_context",
+    "RuntimeContext",
     "get_actor",
     "nodes",
     "ObjectRef",
@@ -73,6 +82,7 @@ __all__ = [
     "RemoteFunction",
     "RayTpuError",
     "TaskError",
+    "TaskCancelledError",
     "ActorDiedError",
     "GetTimeoutError",
     "ObjectLostError",

@@ -88,6 +88,17 @@ class WorkerCrashedError(RayTpuError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ``ray_tpu.cancel``; re-raised at ``get``
+    on any of the task's return refs."""
+
+    def __init__(self, task_desc: str = ""):
+        self.task_desc = task_desc
+        super().__init__(
+            f"task {task_desc or '<unknown>'} was cancelled"
+        )
+
+
 # ---------------------------------------------------------------------------
 # argument capture: collect nested ObjectRefs while serializing
 # ---------------------------------------------------------------------------
@@ -242,6 +253,12 @@ class CoreWorker:
         # pending normal tasks owned by this worker
         self._pending: Dict[TaskID, Dict[str, Any]] = {}
         self._pending_lock = threading.Lock()
+        # ownership-side lineage fan-out for recursive cancellation: parent
+        # task binary -> TaskIDs of still-pending children submitted by this
+        # process while that parent was executing (TaskIDs hash the parent,
+        # so parentage is not recoverable from an ID — this registry is the
+        # explicit edge set). Entries are pruned as children complete.
+        self._children: Dict[bytes, List[TaskID]] = {}
         # owner-based object directory: object -> raylet address of a node
         # whose plasma store holds it (reference:
         # object_manager/ownership_based_object_directory.cc — locations come
@@ -360,6 +377,33 @@ class CoreWorker:
         if actor_id is not None:
             return TaskID.for_actor_task(self.job_id, parent, counter, actor_id)
         return TaskID.for_normal_task(self.job_id, parent, counter)
+
+    def _record_child(self, spec: Dict[str, Any], task_id: TaskID):
+        """Record the parent->child edge for recursive cancellation. TaskIDs
+        hash the parent, so parentage is not recoverable from an ID — this
+        registry is the explicit edge set, pruned as children complete."""
+        parent = getattr(self._task_ctx, "task_id", self._current_task_id)
+        parent_bin = parent.binary()
+        spec["_parent_bin"] = parent_bin
+        with self._pending_lock:
+            self._children.setdefault(parent_bin, []).append(task_id)
+
+    def _prune_child(self, spec: Dict[str, Any]):
+        """Drop a completed task from its parent's child registry (called
+        with the task terminally resolved; best-effort)."""
+        parent_bin = spec.get("_parent_bin")
+        if parent_bin is None:
+            return
+        with self._pending_lock:
+            children = self._children.get(parent_bin)
+            if children is None:
+                return
+            try:
+                children.remove(spec["task_id"])
+            except ValueError:
+                pass
+            if not children:
+                self._children.pop(parent_bin, None)
 
     def _next_put_id(self) -> ObjectID:
         with self._counter_lock:
@@ -708,7 +752,11 @@ class CoreWorker:
             )
             spec["attempt"] = spec.get("attempt", 0) + 1
             spec.pop("locations", None)
+            spec.pop("_finalized", None)
+            spec.pop("_cancelled", None)
+            spec.pop("_worker_addr", None)
             self._pending[task_id] = spec
+            internal_metrics.inc("ray_tpu_lineage_reconstructions_total")
         with self._locations_lock:
             self._locations.pop(binary, None)
             self._lost_objects.discard(binary)
@@ -1046,6 +1094,7 @@ class CoreWorker:
         )
         with self._pending_lock:
             self._pending[task_id] = spec
+        self._record_child(spec, task_id)
         for r in return_ids:
             self._register_ref(r)
         self._emit_event(task_id, "PENDING_ARGS_AVAIL", spec["name"], spec.get("trace"))
@@ -1381,6 +1430,8 @@ class CoreWorker:
                 for i, spec in enumerate(specs):
                     if acked[i]:
                         continue
+                    if spec.get("_cancelled"):
+                        continue  # ref already resolved cancelled; no retry
                     if spec["retries_left"] > 0:
                         spec["retries_left"] -= 1
                         spec["attempt"] = spec.get("attempt", 0) + 1
@@ -1406,6 +1457,10 @@ class CoreWorker:
                     if not acked[i]:
                         self._fail_task(spec, reply)
 
+        # record the push target so a later cancel() can reach the
+        # executing worker directly (no GCS lookup on the common path)
+        for s in specs:
+            s["_worker_addr"] = tuple(client.address)
         # encode + send under the client's template lock: the frame carrying
         # a template definition must hit the socket before any frame that
         # references it without one
@@ -1461,6 +1516,8 @@ class CoreWorker:
         (reply handling, lease return, retries) runs on the rpc callback
         executor, so in-flight task count is bounded by leases, not by the
         submitter pool size."""
+        if spec.get("_cancelled"):
+            return  # cancelled while queued: ref already resolved
         self._resolve_deps(spec["deps"], spec["nested"])
         spec["locations"] = self._dep_locations(spec["deps"], spec["nested"])
         sig = self._lease_sig(spec)
@@ -1591,6 +1648,13 @@ class CoreWorker:
 
     def _handle_reply(self, spec: Dict[str, Any], reply: Dict[str, Any]):
         task_id = spec["task_id"]
+        if spec.get("_cancelled"):
+            # the ref already resolved to TaskCancelledError owner-side; a
+            # late worker reply must not overwrite it (or re-pin lineage)
+            with self._pending_lock:
+                self._pending.pop(task_id, None)
+            self._prune_child(spec)
+            return
         if reply["status"] == "retry":  # application asked for retry (unused yet)
             raise RayTpuError("unexpected retry status")
         producer_node = reply.get("node")
@@ -1648,6 +1712,7 @@ class CoreWorker:
                             self._lineage.pop(child, None)
         with self._pending_lock:
             self._pending.pop(task_id, None)
+        self._prune_child(spec)
         internal_metrics.inc(
             "ray_tpu_tasks_finished_total"
             if reply["status"] == "ok"
@@ -1656,6 +1721,12 @@ class CoreWorker:
         self._emit_event(task_id, "FINISHED" if reply["status"] == "ok" else "FAILED", spec["name"], spec.get("trace"))
 
     def _fail_task(self, spec: Dict[str, Any], exc: BaseException):
+        # finalize-once: a cancelled task can see a second failure (its
+        # push erroring after the owner already resolved the ref) — the
+        # first resolution wins. _try_recover clears the flag on resubmit.
+        if spec.get("_finalized"):
+            return
+        spec["_finalized"] = True
         task_id = spec["task_id"]
         err = serialization.serialize(
             exc if isinstance(exc, RayTpuError) else TaskError(exc, spec["name"]),
@@ -1666,8 +1737,16 @@ class CoreWorker:
             self.memory_store.put(ObjectID.for_task_return(task_id, i + 1), err)
         with self._pending_lock:
             self._pending.pop(task_id, None)
-        internal_metrics.inc("ray_tpu_tasks_failed_total")
-        self._emit_event(task_id, "FAILED", spec["name"], spec.get("trace"))
+        self._prune_child(spec)
+        cancelled = isinstance(exc, TaskCancelledError)
+        if not cancelled:
+            internal_metrics.inc("ray_tpu_tasks_failed_total")
+        self._emit_event(
+            task_id,
+            "CANCELLED" if cancelled else "FAILED",
+            spec["name"],
+            spec.get("trace"),
+        )
 
     # ------------------------------------------------------------------
     # actor submission
@@ -1771,6 +1850,7 @@ class CoreWorker:
         )
         with self._pending_lock:
             self._pending[task_id] = spec
+        self._record_child(spec, task_id)
         for r in return_ids:
             self._register_ref(r)
         self._submit_queue.put(spec)
@@ -1849,6 +1929,12 @@ class CoreWorker:
         drainer for ordered calls) and push asynchronously; completion runs
         on the callback executor. Any unexpected failure must still release
         the in-flight window, or the actor wedges."""
+        if spec.get("_cancelled"):
+            # purged queued actor call: skip the wire send but keep the
+            # seq/window accounting intact (removing it from the seq heap
+            # instead would stall _pump_actor forever on the missing seq)
+            self._actor_task_done(spec)
+            return
         try:
             self._send_actor_task_inner(spec)
         except Exception as e:  # noqa: BLE001
@@ -1874,6 +1960,7 @@ class CoreWorker:
                 return
             try:
                 client = self._get_worker_client(addr)
+                spec["_worker_addr"] = tuple(addr)
             except (ConnectionLost, OSError):
                 # couldn't even connect: address stale (restart in flight)
                 with self._actor_lock:
@@ -1921,6 +2008,130 @@ class CoreWorker:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.gcs.call("kill_actor", (actor_id, no_restart))
+
+    # ------------------------------------------------------------------
+    # cancellation
+    # ------------------------------------------------------------------
+
+    def cancel(self, object_ref: ObjectID, *, force: bool = False,
+               recursive: bool = True) -> bool:
+        """Cancel the task that produces ``object_ref``. Pending tasks are
+        dequeued before lease grant; running tasks get their cooperative
+        cancel flag set on the executing worker (``force=True`` escalates to
+        a thread interrupt); the ref resolves to TaskCancelledError. Returns
+        True when this owner still had the task pending."""
+        return self.cancel_task_id(
+            object_ref.task_id(), force=force, recursive=recursive
+        )
+
+    def cancel_task_id(self, task_id: TaskID, *, force: bool = False,
+                       recursive: bool = True) -> bool:
+        with self._pending_lock:
+            spec = self._pending.get(task_id)
+        owned = spec is not None
+        first = owned and not spec.get("_cancelled")
+        if first:
+            spec["_cancelled"] = True
+            # dequeue a not-yet-pushed normal task before any lease grant
+            if spec.get("actor_id") is None:
+                sig = self._lease_sig(spec)
+                if sig is not None:
+                    with self._lease_lock:
+                        waiting = self._lease_waiting.get(sig)
+                        if waiting is not None:
+                            try:
+                                waiting.remove(spec)
+                            except ValueError:
+                                pass  # already popped for a push (or queued)
+            mode = "force" if force else "cooperative"
+            internal_metrics.inc(
+                "ray_tpu_tasks_cancelled_total", tags={"mode": mode}
+            )
+            # resolve the ref NOW: cancellation must not wait on a worker
+            # round-trip (a task sleeping in C code can't ack cooperatively)
+            self._fail_task(spec, TaskCancelledError(spec.get("name", "")))
+        # reach the executing worker — idempotent RPC, delivered off-thread
+        # (and retried by the rpc layer across drops while chaos is armed)
+        if first or not owned:
+            self._send_cancel_rpc(task_id, spec, force, recursive)
+        if recursive:
+            with self._pending_lock:
+                children = list(self._children.get(task_id.binary(), ()))
+            for child in children:
+                try:
+                    self.cancel_task_id(child, force=force, recursive=True)
+                except Exception:
+                    pass
+        return owned
+
+    def cancel_descendants(self, task_id: TaskID, *, force: bool = False):
+        """Cancel every still-pending child this process submitted while
+        ``task_id`` was executing (the worker-side leg of recursive
+        cancellation: each child cancel fans out to ITS executing worker)."""
+        with self._pending_lock:
+            children = list(self._children.get(task_id.binary(), ()))
+        for child in children:
+            try:
+                self.cancel_task_id(child, force=force, recursive=True)
+            except Exception:
+                pass
+
+    def _send_cancel_rpc(self, task_id: TaskID, spec, force: bool,
+                         recursive: bool):
+        payload = {
+            "task_id": task_id.binary(),
+            "force": bool(force),
+            "recursive": bool(recursive),
+        }
+        addr = tuple(spec.get("_worker_addr") or ()) if spec else ()
+        name = spec.get("name", "") if spec else ""
+
+        def _deliver():
+            if addr:
+                try:
+                    self._get_worker_client(addr).call(
+                        "cancel_task", payload, timeout=3.0
+                    )
+                    self._report_cancel_event(task_id, name)
+                    return
+                except Exception:
+                    pass  # push target gone/stale: fall back to GCS lookup
+            try:
+                loc = self.gcs.call(
+                    "locate_worker", {"task_id": task_id.hex()}, timeout=10.0
+                )
+                if not loc or not loc.get("node_id"):
+                    if spec is not None:
+                        self._report_cancel_event(task_id, name)
+                    return
+                node_addr = self._node_address(NodeID.from_hex(loc["node_id"]))
+                if node_addr is None:
+                    return
+                self._get_raylet_client(node_addr).call(
+                    "cancel_task",
+                    {**payload, "worker_id": bytes.fromhex(loc["worker_id"])},
+                    timeout=3.0,
+                )
+                self._report_cancel_event(task_id, name)
+            except Exception:
+                pass  # best-effort: the owner-side resolution already stands
+
+        threading.Thread(target=_deliver, name="cancel-rpc", daemon=True).start()
+
+    def _report_cancel_event(self, task_id: TaskID, name: str):
+        try:
+            self.gcs.call(
+                "report_cluster_event",
+                {
+                    "type": "TASK_CANCELLED",
+                    "severity": "INFO",
+                    "message": f"task {name or task_id.hex()[:12]} cancelled",
+                    "task_id": task_id.hex(),
+                },
+                timeout=5.0,
+            )
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # task events + tracing
@@ -2013,6 +2224,10 @@ class CoreWorker:
                 self._node_addr_cache.pop(node["node_id"], None)
                 # invalidate the object directory for that node: objects
                 # located only there are lost and become recovery candidates
+                # — EXCEPT objects a graceful drain re-replicated to a peer
+                # (the migration map rides the removal notification), which
+                # just get their location updated: zero reconstructions.
+                migrated = message.get("migrated") or {}
                 addr = tuple(node.get("address") or ())
                 if addr:
                     with self._locations_lock:
@@ -2020,8 +2235,12 @@ class CoreWorker:
                             b for b, a in self._locations.items() if tuple(a) == addr
                         ]
                         for b in stale:
-                            self._locations.pop(b, None)
-                            self._lost_objects.add(b)
+                            new_loc = migrated.get(b)
+                            if new_loc:
+                                self._locations[b] = tuple(new_loc)
+                            else:
+                                self._locations.pop(b, None)
+                                self._lost_objects.add(b)
             return
         if channel == "actors" or channel.startswith("actor:"):
             actor_id = message["actor_id"]

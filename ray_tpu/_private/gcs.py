@@ -155,6 +155,11 @@ class GcsServer:
         self._pgs: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self._subscribers: Dict[str, List[ServerConn]] = {}
         self._raylet_clients: Dict[NodeID, RpcClient] = {}
+        # graceful drain: object migration maps stashed by the drain
+        # orchestrator (node -> {oid binary: new (host, port)}), consumed
+        # by unregister's "nodes removed" publish so owners rewrite
+        # locations instead of declaring the objects lost
+        self._drain_migrations: Dict[NodeID, Dict[bytes, Tuple[str, int]]] = {}
         # pooled GCS->worker connections for create_actor (LRU-bounded;
         # entries invalidate on call failure)
         from collections import OrderedDict as _OD
@@ -403,18 +408,32 @@ class GcsServer:
         return True
 
     def rpc_unregister_node(self, conn, payload):
-        """Graceful node drain: mark dead immediately (no health-check wait)."""
+        """Graceful node exit: mark dead immediately (no health-check wait).
+        If a drain orchestrator stashed a migration map for this node, it
+        rides the removal publish so owners re-point their object locations
+        at the peers holding the re-replicated copies (zero lineage
+        reconstructions) instead of marking them lost."""
         node_id = payload
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info.alive:
                 return False
+            was_draining = info.state == "DRAINING"
             info.alive = False
             info.state = "DEAD"
-        self._publish("nodes", {"event": "removed", "node": self._node_view(info)})
+            migrated = self._drain_migrations.pop(node_id, None)
+        removal = {"event": "removed", "node": self._node_view(info)}
+        if migrated:
+            removal["migrated"] = {
+                oid: tuple(addr) for oid, addr in migrated.items()
+            }
+        self._publish("nodes", removal)
         self._record_cluster_event(
             "NODE_REMOVED",
-            f"node {node_id.hex()[:8]} drained (graceful unregister)",
+            f"node {node_id.hex()[:8]} "
+            + ("drained and deregistered" if was_draining
+               else "deregistered (graceful unregister)")
+            + (f" ({len(migrated)} objects migrated)" if migrated else ""),
             node_id=node_id.hex(),
         )
         self._handle_node_death(node_id)
@@ -423,6 +442,131 @@ class GcsServer:
     def rpc_get_nodes(self, conn, payload=None):
         with self._lock:
             return [self._node_view(n) for n in self._nodes.values()]
+
+    # ------------------------------------------------------------------
+    # graceful drain (ALIVE -> DRAINING -> DEAD; reference:
+    # gcs_service.proto DrainNode + the autoscaler's drain-before-preempt)
+    # ------------------------------------------------------------------
+
+    def _resolve_node_locked(self, ident) -> Optional[NodeInfo]:
+        """Resolve a node by NodeID, node_id hex prefix, or node_name
+        label (callers hold self._lock)."""
+        if isinstance(ident, NodeID):
+            return self._nodes.get(ident)
+        ident = str(ident or "")
+        if not ident:
+            return None
+        for info in self._nodes.values():
+            if info.node_id.hex().startswith(ident):
+                return info
+        for info in self._nodes.values():
+            if info.labels.get("node_name") == ident:
+                return info
+        return None
+
+    def rpc_drain_node(self, conn, payload):
+        """Initiate a graceful drain (idempotent: re-issuing onto a node
+        already DRAINING or DEAD is a no-op). The orchestration runs off
+        the dispatch thread: tell the raylet to drain (stop leasing, let
+        running work finish until the deadline, migrate its primary plasma
+        objects), stash the returned migration map, then shut the raylet
+        down so it deregisters cleanly."""
+        p = payload or {}
+        deadline_s = float(p.get("deadline_s", 30.0))
+        with self._lock:
+            info = self._resolve_node_locked(p.get("node_id"))
+            if info is None:
+                return {"status": "not_found", "node_id": None}
+            node_hex = info.node_id.hex()
+            if not info.alive:
+                return {"status": "dead", "node_id": node_hex}
+            if info.state == "DRAINING":
+                return {"status": "draining", "node_id": node_hex}
+            info.state = "DRAINING"
+        self._publish(
+            "nodes", {"event": "draining", "node": self._node_view(info)}
+        )
+        self._record_cluster_event(
+            "NODE_DRAINING",
+            f"node {node_hex[:8]} "
+            f"({info.labels.get('node_name', '?')}) draining: new leases "
+            f"rejected, running work has {deadline_s:.0f}s to finish",
+            node_id=node_hex,
+        )
+        threading.Thread(
+            target=self._drain_node_orchestrate,
+            args=(info, deadline_s),
+            name=f"drain-{node_hex[:8]}",
+            daemon=True,
+        ).start()
+        return {"status": "draining", "node_id": node_hex}
+
+    def _drain_node_orchestrate(self, info: NodeInfo, deadline_s: float):
+        from ray_tpu._private import internal_metrics
+
+        node_hex = info.node_id.hex()
+        outcome = "completed"
+        migrated: Dict[bytes, Tuple[str, int]] = {}
+        moved_actors = self._migrate_actors_for_drain(info.node_id)
+        try:
+            reply = self._raylet_client(info).call(
+                "drain", {"deadline_s": deadline_s}, timeout=deadline_s + 30.0
+            )
+            migrated = (reply or {}).get("migrated") or {}
+            if migrated:
+                with self._lock:
+                    self._drain_migrations[info.node_id] = dict(migrated)
+            self._raylet_client(info).call("shutdown", None, timeout=10.0)
+        except Exception as e:
+            outcome = "failed"
+            logger.warning("drain of node %s failed: %r", node_hex[:8], e)
+        # the raylet's stop() unregisters; give it a grace window, then
+        # force the transition so a wedged raylet can't stay DRAINING
+        # forever (its objects still migrate if the map came back)
+        grace = time.monotonic() + 15.0
+        while time.monotonic() < grace:
+            with self._lock:
+                if not info.alive:
+                    break
+            time.sleep(0.1)
+        else:
+            with self._lock:
+                still_alive = info.alive
+            if still_alive:
+                outcome = "forced"
+                self.rpc_unregister_node(None, info.node_id)
+        internal_metrics.inc(
+            "ray_tpu_node_drains_total", tags={"outcome": outcome}
+        )
+        self._record_cluster_event(
+            "NODE_DRAINED",
+            f"node {node_hex[:8]} drain {outcome}: "
+            f"{len(migrated)} objects migrated to peers, "
+            f"{moved_actors} actors relocated",
+            severity="INFO" if outcome == "completed" else "WARNING",
+            node_id=node_hex,
+        )
+
+    def _migrate_actors_for_drain(self, node_id: NodeID) -> int:
+        """Proactively restart restartable actors away from a DRAINING
+        node (an actor worker never releases its lease, so waiting for it
+        would burn the whole drain deadline). The stale instance left on
+        the draining node dies when its raylet shuts down; non-restartable
+        actors ride out the drain and die with the node, exactly as on a
+        preemption."""
+        with self._lock:
+            movable = [
+                a.actor_id
+                for a in self._actors.values()
+                if a.node_id == node_id
+                and a.state == ALIVE
+                and (a.num_restarts < a.max_restarts or a.max_restarts < 0)
+            ]
+        for actor_id in movable:
+            self._reconstruct_actor(
+                actor_id, f"node {node_id.hex()[:8]} draining"
+            )
+        return len(movable)
 
     def _node_view(self, n: NodeInfo) -> Dict[str, Any]:
         return {
@@ -752,6 +896,8 @@ class GcsServer:
                 n
                 for n in self._nodes.values()
                 if n.alive
+                # a DRAINING node is leaving: never place anything there
+                and n.state != "DRAINING"
                 # DEGRADED drains new leases away (explicit targeting wins:
                 # a caller pinning node_id accepts the gray failure risk)
                 and (n.state != "DEGRADED" or node_id is not None)
@@ -1078,7 +1224,7 @@ class GcsServer:
         label value; otherwise a single group of all alive nodes."""
         alive = [
             n for n in self._nodes.values()
-            if n.alive and n.state != "DEGRADED"
+            if n.alive and n.state not in ("DEGRADED", "DRAINING")
         ]
         if not label_equal:
             return [alive]

@@ -121,6 +121,23 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "gauge",
         "nodes currently in the DEGRADED gray-failure state (GCS view)",
         ()),
+    # -- cancellation / graceful drain --------------------------------
+    "ray_tpu_tasks_cancelled_total": (
+        "counter",
+        "tasks cancelled via ray_tpu.cancel (mode=cooperative|force)",
+        ("mode",)),
+    "ray_tpu_node_drains_total": (
+        "counter",
+        "graceful node drains by outcome (completed|forced|failed)",
+        ("outcome",)),
+    "ray_tpu_drain_migrated_objects_total": (
+        "counter",
+        "primary plasma objects re-replicated to peers during a drain",
+        ()),
+    "ray_tpu_lineage_reconstructions_total": (
+        "counter",
+        "tasks re-submitted through lineage to reconstruct lost objects",
+        ()),
 }
 
 _lock = threading.Lock()

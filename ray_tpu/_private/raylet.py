@@ -27,7 +27,7 @@ from ray_tpu._private import fault_injection
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import object_store
 from ray_tpu._private.config import GlobalConfig
-from ray_tpu._private.ids import ActorID, NodeID, WorkerID
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
 from ray_tpu._private.runtime_env_packaging import (
     ensure_extracted,
@@ -347,6 +347,11 @@ class Raylet:
         # spill watermark: heartbeats diff against it to report OBJECT_SPILL
         # cluster events exactly once per spill burst
         self._spill_event_bytes = 0
+        # graceful drain (GCS ALIVE->DRAINING->DEAD): a draining raylet
+        # redirects new lease requests and migrates its primary objects
+        # before deregistering
+        self._draining = False
+        self._drain_stop_scheduled = False
         self._stopped = threading.Event()
         self.server.register_all(self)
         self.server.on_disconnect = self._on_disconnect
@@ -768,8 +773,8 @@ class Raylet:
         for n in nodes:
             if not n["alive"] or n["node_id"] == self.node_id:
                 continue
-            if n.get("state") == "DEGRADED":
-                continue  # draining: no new spillback leases either
+            if n.get("state") in ("DEGRADED", "DRAINING"):
+                continue  # degraded/draining: no new spillback leases
             pool = n["resources"] if against == "total" else n["available"]
             if all(pool.get(k, 0) >= v for k, v in resources.items() if v > 0):
                 slack = min(
@@ -785,6 +790,15 @@ class Raylet:
         actor_id: Optional[ActorID] = payload.get("actor_id")
         timeout = payload.get("timeout", GlobalConfig.worker_lease_timeout_s)
         allow_spill = payload.get("allow_spill", True)
+        if self._draining:
+            # draining node: grant nothing new — redirect to a peer with
+            # capacity, or make the caller retry elsewhere
+            spill = (
+                self._find_spill_node(resources, against="total", fresh=True)
+                if allow_spill
+                else None
+            )
+            return {"retry_at": spill} if spill is not None else None
         deadline = time.monotonic() + timeout
         with self._res_cv:
             # infeasible check against total
@@ -834,6 +848,19 @@ class Raylet:
         registered this request in self._demand for heartbeat reporting)."""
         my_spawned = False  # this request's one in-flight spawn credit
         while not self._stopped.is_set():
+            if self._draining:
+                # drain started while this request was parked: evict it to
+                # a peer (the owner follows retry_at) or let it retry
+                self._res_cv.release()
+                try:
+                    spill = (
+                        self._find_spill_node(resources, against="total")
+                        if allow_spill
+                        else None
+                    )
+                finally:
+                    self._res_cv.acquire()
+                return {"retry_at": spill} if spill is not None else None
             effective = self._expand_pg_request_locked(resources)
             have_resources = effective is not None and all(
                 self.available.get(k, 0) >= v for k, v in effective.items()
@@ -982,6 +1009,125 @@ class Raylet:
             self._res_cv.notify_all()
         if kill and handle.proc is not None:
             handle.proc.terminate()
+        return True
+
+    # ------------------------------------------------------------------
+    # cancellation + graceful drain
+    # ------------------------------------------------------------------
+
+    def rpc_cancel_task(self, conn: ServerConn, payload) -> Dict[str, Any]:
+        """Forward a cancel to the worker executing the task (idempotent —
+        an unknown worker is a no-op: the task already finished, or the
+        worker died and the owner's failure path takes over)."""
+        p = dict(payload or {})
+        worker_id = p.pop("worker_id", None)
+        if isinstance(worker_id, bytes):
+            worker_id = WorkerID(worker_id)
+        addr = None
+        if worker_id is not None:
+            with self._res_cv:
+                handle = self._workers.get(worker_id)
+                if handle is not None and handle.address and handle.address[1]:
+                    addr = tuple(handle.address)
+        if addr is None:
+            return {"status": "unknown"}
+        try:
+            return self._peer_client(addr).call("cancel_task", p, timeout=5.0)
+        except Exception:
+            return {"status": "unreachable"}
+
+    def rpc_drain(self, conn: ServerConn, payload) -> Dict[str, Any]:
+        """Graceful drain (idempotent — a re-issued drain re-walks the same
+        migration set and peer store_pull no-ops on objects it already
+        holds): stop granting leases, wait for leased workers to finish
+        until the deadline, then re-replicate every sealed primary object
+        to peer nodes. Returns the migration map so the GCS can rewrite
+        owner-side locations when this node deregisters — a drained node
+        causes zero lineage reconstructions."""
+        p = payload or {}
+        deadline = time.monotonic() + float(p.get("deadline_s", 30.0))
+        self._draining = True
+        with self._res_cv:
+            self._res_cv.notify_all()  # wake parked lease requests to redirect
+        while time.monotonic() < deadline:
+            with self._res_cv:
+                # actor workers hold their lease for life — the GCS
+                # orchestrator migrates restartable actors before this
+                # call, so waiting on them would just burn the deadline
+                busy = any(
+                    h.lease_resources and not h.actor_ids
+                    for h in self._workers.values()
+                )
+            if not busy or self._stopped.is_set():
+                break
+            time.sleep(0.05)
+        migrated = self._migrate_objects(deadline)
+        return {"node_id": self.node_id, "migrated": migrated}
+
+    def _migrate_objects(
+        self, deadline: float
+    ) -> Dict[bytes, Tuple[str, int]]:
+        """Re-replicate this node's sealed plasma objects onto alive,
+        non-draining peers (pull-based: the peer's idempotent store_pull
+        does the chunked transfer). Returns oid binary -> new address for
+        every object that made it; objects left behind at the deadline
+        fall back to lineage reconstruction."""
+        try:
+            nodes = self.gcs.call("get_nodes", timeout=5.0)
+        except Exception:
+            nodes = []
+        peers = [
+            tuple(n["address"])
+            for n in nodes
+            if n.get("alive")
+            and n.get("node_id") != self.node_id
+            and n.get("state") not in ("DEGRADED", "DRAINING")
+        ]
+        migrated: Dict[bytes, Tuple[str, int]] = {}
+        if not peers:
+            return migrated
+        entries = self.store.list_objects()
+        for i, e in enumerate(entries):
+            if not e.get("sealed"):
+                continue
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "drain deadline hit: %d/%d objects migrated",
+                    len(migrated), len(entries),
+                )
+                break
+            oid = ObjectID(bytes.fromhex(e["object_id"]))
+            for attempt in range(len(peers)):
+                peer = peers[(i + attempt) % len(peers)]
+                try:
+                    ok = self._peer_client(peer).call(
+                        "store_pull",
+                        (oid, self.server.address),
+                        timeout=max(5.0, deadline - time.monotonic()),
+                    )
+                except Exception:
+                    ok = False
+                if ok:
+                    migrated[oid.binary()] = peer
+                    internal_metrics.inc(
+                        "ray_tpu_drain_migrated_objects_total"
+                    )
+                    break
+        return migrated
+
+    def rpc_shutdown(self, conn: ServerConn, payload=None) -> bool:
+        """Deregister and stop this raylet shortly after replying — the
+        drain orchestrator's final step. Idempotent: repeat deliveries see
+        the stop already scheduled."""
+        if self._stopped.is_set() or self._drain_stop_scheduled:
+            return True
+        self._drain_stop_scheduled = True
+
+        def _go():
+            time.sleep(0.5)  # let the reply flush before the server dies
+            self.stop(unregister=True)
+
+        threading.Thread(target=_go, daemon=True).start()
         return True
 
     # ------------------------------------------------------------------

@@ -333,6 +333,11 @@ IDEMPOTENT_METHODS = frozenset({
     # decrements a reader pin count (a duplicate unpins someone else).
     "store_put", "store_seal", "store_delete", "store_delete_batch",
     "store_abort",
+    # cancellation / drain: cancel_task converges (cancelling a cancelled
+    # or finished task no-ops), drain_node re-issues onto an already
+    # DRAINING node harmlessly, and a raylet-level drain re-walks the same
+    # migration set (peer store_pull is itself idempotent).
+    "cancel_task", "drain_node", "drain", "shutdown",
 })
 
 

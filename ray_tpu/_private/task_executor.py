@@ -19,11 +19,21 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GlobalConfig
-from ray_tpu._private.core_worker import CoreWorker, PLASMA_MARKER, TaskError
-from ray_tpu._private.ids import ActorID, ObjectID, WorkerID
+from ray_tpu._private.core_worker import (
+    CoreWorker,
+    PLASMA_MARKER,
+    TaskCancelledError,
+    TaskError,
+)
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.rpc import Deferred, RpcServer, ServerConn
 
 logger = logging.getLogger(__name__)
+
+#: the process's TaskExecutor (workers only) — lets the public
+#: ``get_runtime_context().was_cancelled()`` reach the cancel registry
+#: without threading the executor through every call site
+_current_executor: Optional["TaskExecutor"] = None
 
 
 class _NullGate:
@@ -96,9 +106,20 @@ class TaskExecutor:
         # wire-spec templates registered by owners (bounded by the number of
         # distinct RemoteFunction+options objects across connected drivers)
         self._tmpls: Dict[bytes, Dict[str, Any]] = {}
+        # cancellation plane: task binary -> {"cancelled", "thread"} while a
+        # task executes; cancel RPCs that beat the task's arrival park in
+        # _precancelled (bounded — cancel is best-effort once evicted)
+        self._cancel_lock = threading.Lock()
+        self._cancel_running: Dict[bytes, Dict[str, Any]] = {}
+        import collections
+
+        self._precancelled: "collections.OrderedDict" = collections.OrderedDict()
+        global _current_executor
+        _current_executor = self
         server.register("push_task", self.rpc_push_task, inline=True)
         server.register("push_task_batch", self.rpc_push_task_batch, inline=True)
         server.register("create_actor", self.rpc_create_actor)
+        server.register("cancel_task", self.rpc_cancel_task)
         server.register("kill_self", self.rpc_kill_self)
         server.register("health", lambda conn, p: "ok")
         server.register("profile", self.rpc_profile)
@@ -250,7 +271,17 @@ class TaskExecutor:
         # contain spaces)
         marker = f"task_id={task_id.hex()} attempt={attempt} name={name}"
         print(f"::task_begin {marker}", flush=True)
+        tbin = task_id.binary()
+        with self._cancel_lock:
+            precancelled = self._precancelled.pop(tbin, None) is not None
+            if not precancelled:
+                self._cancel_running[tbin] = {
+                    "cancelled": False,
+                    "thread": threading.get_ident(),
+                }
         try:
+            if precancelled:
+                return TaskCancelledError(name), True
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
                 if loop is not None:
@@ -261,9 +292,16 @@ class TaskExecutor:
                 else:
                     result = asyncio.run(result)  # async normal task
             return result, False
+        except TaskCancelledError:
+            # raised by the task itself or injected by a force-cancel: reply
+            # with the typed error unwrapped so the owner resolves the ref
+            # to TaskCancelledError (not a generic TaskError)
+            return TaskCancelledError(name), True
         except Exception as e:  # noqa: BLE001
             return TaskError(e, name, traceback.format_exc()), True
         finally:
+            with self._cancel_lock:
+                self._cancel_running.pop(tbin, None)
             print(f"::task_end {marker}", flush=True)
             self.core._task_ctx.task_id = token_tid
             self.core._task_ctx.task_name = token_name
@@ -504,6 +542,54 @@ class TaskExecutor:
             self._actors[actor_id] = _ActorState(instance, max_concurrency)
         logger.info("actor %s (%s) created", actor_id.hex()[:8], spec.get("class_name"))
         return True
+
+    # ------------------------------------------------------------------
+    # cancellation (idempotent: repeated calls for the same task converge
+    # on the same state — the retry layer may deliver this twice)
+
+    def rpc_cancel_task(self, conn: ServerConn, payload) -> Dict[str, Any]:
+        payload = payload or {}
+        tbin = payload.get("task_id")
+        force = bool(payload.get("force"))
+        recursive = bool(payload.get("recursive", True))
+        status = "pending"
+        with self._cancel_lock:
+            entry = self._cancel_running.get(tbin)
+            if entry is not None:
+                already = entry["cancelled"]
+                entry["cancelled"] = True
+                status = "running"
+            elif tbin not in self._precancelled:
+                # task not here yet (or already finished): park the intent so
+                # a late-arriving execution is rejected before user code runs
+                self._precancelled[tbin] = True
+                while len(self._precancelled) > 4096:
+                    self._precancelled.popitem(last=False)
+        if status == "running" and force and not already:
+            # escalation: raise TaskCancelledError inside the executing
+            # thread (takes effect at the next bytecode boundary — a task
+            # blocked in C code is only reaped when it returns to Python)
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(entry["thread"]),
+                ctypes.py_object(TaskCancelledError),
+            )
+        if recursive:
+            try:
+                self.core.cancel_descendants(
+                    TaskID(tbin), force=force
+                )
+            except Exception:
+                logger.exception("recursive cancel of descendants failed")
+        return {"status": status}
+
+    def is_cancelled(self, task_id) -> bool:
+        """Cooperative check for the currently running task — surfaced as
+        ``ray_tpu.get_runtime_context().was_cancelled()``."""
+        with self._cancel_lock:
+            entry = self._cancel_running.get(task_id.binary())
+            return bool(entry and entry["cancelled"])
 
     def rpc_profile(self, conn: ServerConn, payload) -> Dict[str, Any]:
         """On-demand CPU profile: sample every thread's stack for

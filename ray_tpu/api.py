@@ -17,6 +17,7 @@ from ray_tpu._private.core_worker import (  # re-export error types
     ActorDiedError,
     GetTimeoutError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -363,6 +364,64 @@ def wait(
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):
     worker_mod.get_global_worker().core.kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectID, *, force: bool = False, recursive: bool = True) -> bool:
+    """Cancel the task that produces ``ref``. Pending tasks are dequeued
+    before lease grant; running tasks are interrupted cooperatively (the
+    task polls ``get_runtime_context().was_cancelled()``), or via a
+    thread-interrupt escalation with ``force=True``. ``recursive=True``
+    also cancels the task's not-yet-finished children. The ref resolves to
+    :class:`TaskCancelledError`. Returns True when this owner still had
+    the task in flight."""
+    if not isinstance(ref, ObjectID):
+        raise TypeError(f"cancel() expects an ObjectRef, got {type(ref)}")
+    return worker_mod.get_global_worker().core.cancel(
+        ref, force=force, recursive=recursive
+    )
+
+
+def drain_node(node_id: str, deadline_s: float = 30.0) -> Dict[str, Any]:
+    """Gracefully retire a node (ALIVE -> DRAINING -> DEAD): it stops
+    accepting leases, running tasks get ``deadline_s`` to finish, its
+    primary plasma objects are re-replicated to peers, restartable actors
+    migrate, then it deregisters — zero lineage reconstructions.
+    ``node_id`` is a node id hex prefix or a node_name label."""
+    return worker_mod.get_global_worker().core.gcs.call(
+        "drain_node",
+        {"node_id": node_id, "deadline_s": deadline_s},
+        timeout=30.0,
+    )
+
+
+class RuntimeContext:
+    """Task-side runtime introspection (`ray.get_runtime_context()`
+    equivalent, narrowed to what the cancellation plane needs)."""
+
+    def __init__(self, core, executor):
+        self._core = core
+        self._executor = executor
+
+    def get_task_id(self):
+        return getattr(self._core._task_ctx, "task_id", None)
+
+    def was_cancelled(self) -> bool:
+        """True once ``ray_tpu.cancel`` reached this worker for the
+        currently executing task — long-running tasks should poll this
+        and exit early (cooperative interruption)."""
+        if self._executor is None:
+            return False
+        task_id = self.get_task_id()
+        if task_id is None:
+            return False
+        return self._executor.is_cancelled(task_id)
+
+
+def get_runtime_context() -> RuntimeContext:
+    from ray_tpu._private import task_executor as _te
+
+    core = worker_mod.get_global_worker().core
+    return RuntimeContext(core, _te._current_executor)
 
 
 def nodes():

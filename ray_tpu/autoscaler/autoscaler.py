@@ -31,6 +31,9 @@ class AutoscalerConfig:
     update_interval_s: float = 1.0
     # launch at most this many units per round (reference: upscaling_speed)
     max_launch_batch: int = 4
+    # drain window granted to a preempted unit's nodes (cloud preemption
+    # notice is typically 30-60s; leave headroom for the delete itself)
+    preemption_drain_deadline_s: float = 25.0
 
 
 class StandardAutoscaler:
@@ -83,7 +86,69 @@ class StandardAutoscaler:
 
         managed = self.provider.non_terminated_nodes()
         report = {"demand": len(demand), "managed": len(managed), "launched": 0,
-                  "terminated": 0}
+                  "terminated": 0, "preempted": 0}
+
+        # ---- preemption handling: a unit the cloud reclaimed gets its GCS
+        # nodes drained NOW (objects migrate, actors move, zero
+        # reconstructions) instead of waiting for missed heartbeats, and a
+        # replacement launches in the same round
+        # duck-typed: providers are not required to subclass NodeProvider
+        # (BootstrappingNodeProvider doesn't), so absence of a preemption
+        # signal means an empty report, not a crashed update loop
+        preempted = getattr(self.provider, "preempted_nodes", lambda: [])()
+        for nid in preempted:
+            report["preempted"] += 1
+            members = [
+                n for n in alive
+                if (n.get("labels") or {}).get("node_name", "").startswith(nid)
+            ]
+            drained = []
+            for m in members:
+                try:
+                    reply = self._gcs.call(
+                        "drain_node",
+                        {
+                            "node_id": m["node_id"].hex(),
+                            "deadline_s":
+                                self.config.preemption_drain_deadline_s,
+                        },
+                        timeout=10.0,
+                    )
+                    if (reply or {}).get("status") == "draining":
+                        drained.append(m["node_id"].hex()[:8])
+                except Exception:
+                    logger.exception(
+                        "failed to drain preempted unit %s member", nid
+                    )
+            logger.warning(
+                "autoscaler: unit %s preempted by the cloud; draining %d "
+                "member node(s) %s", nid, len(drained), drained,
+            )
+            self._report_event(
+                "AUTOSCALER_PREEMPTION",
+                f"unit {nid} preempted: draining {len(drained)} member "
+                f"node(s), launching a replacement",
+                node=nid,
+                drained=drained,
+            )
+            self._idle_since.pop(nid, None)
+            self._launched_at.pop(nid, None)
+        if preempted and len(managed) < self.config.max_workers:
+            # replace reclaimed capacity immediately (bounded by the cap)
+            to_replace = min(
+                len(preempted), self.config.max_workers - len(managed)
+            )
+            created = self.provider.create_nodes(to_replace)
+            now = time.monotonic()
+            for nid in created:
+                self._launched_at[nid] = now
+            managed = list(managed) + list(created)  # counts against the cap
+            report["launched"] += len(created)
+            self._report_event(
+                "AUTOSCALER_LAUNCH",
+                f"replacing {len(created)} preempted unit(s): {created}",
+                launched=list(created),
+            )
 
         # ---- scale up: bin-pack unmet demand into hypothetical free
         # capacity, then into new provider units
@@ -103,7 +168,7 @@ class StandardAutoscaler:
                 now = time.monotonic()
                 for nid in created:
                     self._launched_at[nid] = now
-                report["launched"] = len(created)
+                report["launched"] += len(created)
                 logger.info(
                     "autoscaler: %d unmet demand shapes -> launching %d "
                     "unit(s) %s", len(unmet), to_launch, created,

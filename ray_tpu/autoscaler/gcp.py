@@ -136,6 +136,12 @@ class GcpTpuNodeProvider(NodeProvider):
         self.provision_timeout_s = provision_timeout_s
         self._lock = threading.Lock()
         self._parent = f"projects/{project}/locations/{zone}"
+        # slices observed in a reclaimed state (PREEMPTED/DELETING/
+        # TERMINATED) by non_terminated_nodes; drained once via
+        # preempted_nodes(), then remembered so a lingering API row isn't
+        # re-reported every poll
+        self._preempted_pending: List[str] = []
+        self._preempted_seen: set = set()
 
     # -- NodeProvider interface -------------------------------------------
 
@@ -259,7 +265,31 @@ class GcpTpuNodeProvider(NodeProvider):
             labels = node.get("labels") or {}
             if labels.get("raytpu-cluster") != self.name_prefix:
                 continue
+            name = node.get("name", "").rsplit("/", 1)[-1]
             if node.get("state") in ("DELETING", "TERMINATED", "PREEMPTED"):
+                # the cloud reclaimed this slice out from under us: don't
+                # just drop it from the managed set — queue it so the
+                # autoscaler drains the matching GCS nodes immediately and
+                # launches a replacement (once per slice)
+                with self._lock:
+                    if name not in self._preempted_seen:
+                        self._preempted_seen.add(name)
+                        self._preempted_pending.append(name)
+                        logger.warning(
+                            "TPU slice %s observed %s (cloud reclaim)",
+                            name, node.get("state"),
+                        )
                 continue
-            out.append(node.get("name", "").rsplit("/", 1)[-1])
+            with self._lock:
+                # a slice that reappears healthy (name reuse) is managed
+                # again and eligible for a future preemption report
+                self._preempted_seen.discard(name)
+            out.append(name)
+        return out
+
+    def preempted_nodes(self) -> List[str]:
+        """Drain-and-replace queue: each reclaimed slice is reported
+        exactly once."""
+        with self._lock:
+            out, self._preempted_pending = self._preempted_pending, []
         return out

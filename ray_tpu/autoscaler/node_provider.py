@@ -42,6 +42,14 @@ class NodeProvider:
         """Resources ONE created unit adds to the cluster."""
         raise NotImplementedError
 
+    def preempted_nodes(self) -> List[str]:
+        """Units the cloud reclaimed out from under us (observed
+        PREEMPTED/DELETING) since the last poll. The autoscaler drains
+        the matching GCS nodes immediately instead of waiting for missed
+        heartbeats. Default: providers without a preemption signal report
+        none."""
+        return []
+
     def shutdown(self) -> None:
         for nid in list(self.non_terminated_nodes()):
             self.terminate_node(nid)

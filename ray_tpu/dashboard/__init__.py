@@ -91,7 +91,7 @@ async function refresh(){
       spark(hist,'alive_nodes','alive nodes','#c0232c');
     document.getElementById('cluster').innerHTML = table([cluster]);
     document.getElementById('nodes').innerHTML = table(nodes,
-      ['node_id','address','alive','resources','available','demand']);
+      ['node_id','address','alive','state','resources','available','demand']);
     document.getElementById('actors').innerHTML = table(actors,
       ['actor_id','class_name','state','name','num_restarts']);
     document.getElementById('jobs').innerHTML = table(jobs);

@@ -25,8 +25,10 @@ function fmt(v) {
 function stateClass(v) {
   const good = ["ALIVE", "RUNNING", "FINISHED", "SUCCEEDED", "CREATED", true, "true"];
   const bad = ["DEAD", "FAILED", "ERRORED", false, "false"];
+  const warn = ["DRAINING", "DEGRADED", "RESTARTING"];
   if (good.includes(v)) return "ok";
   if (bad.includes(v)) return "bad";
+  if (warn.includes(v)) return "warn";
   return "";
 }
 

@@ -288,6 +288,39 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_drain(args) -> int:
+    """``raytpu drain NODE`` — gracefully retire a node: it stops taking
+    leases, running work gets --deadline seconds to finish, its plasma
+    objects re-replicate to peers, then it deregisters (zero lineage
+    reconstructions)."""
+    from ray_tpu.util.state import drain_node, list_nodes
+
+    address = _head_address(args.address)
+    reply = drain_node(args.node, args.deadline, address=address)
+    status = reply.get("status")
+    if status == "not_found":
+        print(f"no node matches {args.node!r}", file=sys.stderr)
+        return 1
+    node_hex = reply.get("node_id") or ""
+    print(f"node {node_hex[:12]}: {status}")
+    if status != "draining" or args.no_wait:
+        return 0
+    deadline = time.monotonic() + args.deadline + 30.0
+    while time.monotonic() < deadline:
+        view = next(
+            (n for n in list_nodes(address=address)
+             if n["node_id"].hex() == node_hex),
+            None,
+        )
+        if view is None or not view.get("alive"):
+            print(f"node {node_hex[:12]}: drained")
+            return 0
+        time.sleep(0.5)
+    print(f"node {node_hex[:12]}: still draining past the deadline",
+          file=sys.stderr)
+    return 1
+
+
 def cmd_submit(args) -> int:
     from ray_tpu.job_submission import JobStatus, JobSubmissionClient
 
@@ -471,6 +504,21 @@ def build_parser() -> argparse.ArgumentParser:
     d = chaos_sub.add_parser("clear", help="disarm everywhere")
     d.add_argument("--address")
     d.set_defaults(fn=cmd_chaos)
+
+    s = sub.add_parser(
+        "drain",
+        help="gracefully retire a node (ALIVE -> DRAINING -> DEAD)",
+        description="Drain one node: reject new leases, let running tasks "
+        "finish within --deadline, migrate its plasma objects and "
+        "restartable actors to peers, then deregister it cleanly.",
+    )
+    s.add_argument("node", help="node id (hex prefix) or node_name label")
+    s.add_argument("--deadline", type=float, default=30.0,
+                   help="seconds running work gets to finish (default 30)")
+    s.add_argument("--no-wait", action="store_true",
+                   help="initiate the drain and return immediately")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_drain)
 
     s = sub.add_parser("submit", help="run an entrypoint as a tracked job")
     s.add_argument("--address")

@@ -101,7 +101,7 @@ class AsyncHTTPProxy:
                     return
                 method, path, headers, body = request
                 keep_alive = headers.get("connection", "keep-alive") != "close"
-                await self._route(method, path, body, writer)
+                await self._route(method, path, body, writer, reader)
                 await writer.drain()
                 if not keep_alive:
                     return
@@ -146,7 +146,8 @@ class AsyncHTTPProxy:
         )
         writer.write(body)
 
-    async def _route(self, method: str, path: str, body: bytes, writer):
+    async def _route(self, method: str, path: str, body: bytes, writer,
+                     reader=None):
         segments = [s for s in path.split("/") if s]
         if method == "GET" and segments == ["-", "healthz"]:
             self._reply(writer, 200, b'"ok"')
@@ -188,9 +189,14 @@ class AsyncHTTPProxy:
             for attempt in range(4):
                 response = await loop.run_in_executor(self._submit_pool, submit)
                 try:
-                    value = await self._await_ref(response.ref, timeout=60.0)
+                    value = await self._await_ref(
+                        response.ref, timeout=60.0, reader=reader
+                    )
                     response._finish_once()
                     break
+                except ConnectionResetError:
+                    response._finish_once()
+                    raise
                 except ray_tpu.ActorDiedError:
                     response._finish_once()
                     if attempt == 3:
@@ -199,6 +205,12 @@ class AsyncHTTPProxy:
                         self._submit_pool,
                         lambda: handle._refresh(force=True),
                     )
+        except ConnectionResetError:
+            # client went away mid-wait: the replica call was cancelled
+            # through the cancellation plane; nobody is left to reply to
+            # (499 is nginx's "client closed request")
+            self._record_proxy(name, 499, route_t0)
+            return
         except Exception as e:  # noqa: BLE001
             self._reply(
                 writer, 500,
@@ -259,9 +271,15 @@ class AsyncHTTPProxy:
             await writer.drain()
         writer.write(b"0\r\n\r\n")
 
-    async def _await_ref(self, ref, timeout: float):
+    async def _await_ref(self, ref, timeout: float, reader=None):
         """Await an ObjectRef without blocking the loop: the memory store
-        fires our callback when the value (or its plasma marker) lands."""
+        fires our callback when the value (or its plasma marker) lands.
+
+        With a ``reader``, the wait is sliced so a client disconnect is
+        noticed within ~250ms: the in-flight replica call is then cancelled
+        through the cancellation plane instead of abandoned (a replica
+        computing a reply nobody reads blocks its slot for other clients).
+        """
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
@@ -272,12 +290,36 @@ class AsyncHTTPProxy:
 
         store = _core().memory_store
         store.add_waiter(ref, _on_ready)
+        deadline = loop.time() + timeout
         try:
-            await asyncio.wait_for(fut, timeout)
-        except (asyncio.TimeoutError, asyncio.CancelledError):
+            while True:
+                if reader is not None and reader.at_eof():
+                    raise ConnectionResetError("client disconnected")
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(
+                        f"no result within {timeout:.0f}s"
+                    )
+                try:
+                    # shield: the slice timeout must not cancel the fut
+                    # the store callback resolves
+                    await asyncio.wait_for(
+                        asyncio.shield(fut), min(0.25, remaining)
+                    )
+                    break
+                except asyncio.TimeoutError:
+                    continue
+        except (asyncio.TimeoutError, asyncio.CancelledError,
+                ConnectionResetError) as e:
             # drop the waiter: a long-lived ingress must not accumulate
             # closures for results that never arrive
             store.remove_waiter(ref, _on_ready)
+            if not isinstance(e, asyncio.TimeoutError):
+                # disconnect (or handler teardown): reap the replica call
+                try:
+                    _core().cancel(ref, force=False, recursive=True)
+                except Exception:
+                    pass
             raise
         # the value is local now; this get returns immediately
         return await loop.run_in_executor(

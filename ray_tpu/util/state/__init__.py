@@ -22,6 +22,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "profile_actor",
     "folded_to_text",
+    "drain_node",
     "dump_stacks",
     "format_stack_report",
     "get_log",
@@ -87,6 +88,22 @@ def _gcs_call(method: str, payload=None, *, address: Optional[str] = None):
 
 def list_nodes(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
     return _gcs_call("get_nodes", address=address)
+
+
+def drain_node(
+    node_id: str,
+    deadline_s: float = 30.0,
+    *,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Initiate a graceful drain (ALIVE -> DRAINING -> DEAD) of one node,
+    identified by node id hex prefix or node_name label. Returns the GCS
+    status dict ({"status": "draining"|"dead"|"not_found", ...})."""
+    return _gcs_call(
+        "drain_node",
+        {"node_id": node_id, "deadline_s": deadline_s},
+        address=address,
+    )
 
 
 def profile_actor(

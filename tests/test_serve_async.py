@@ -3,7 +3,10 @@ schema validation, serve CLI (reference: serve/_private/http_proxy.py:256
 ASGI ingress, serve/schema.py pydantic models, `serve deploy` CLI)."""
 
 import json
+import os
+import socket
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -65,6 +68,53 @@ def test_async_proxy_100_concurrent_no_thread_growth(serve_cluster):
     # side of this test used 100 threads; the proxy is loop-based)
     after = threading.active_count()
     assert after - before < 10, (before, after)
+    proxy.stop()
+
+
+def test_client_disconnect_cancels_inflight_call(serve_cluster, tmp_path):
+    """A client that hangs up mid-request must not leave the replica
+    computing a reply nobody reads: the proxy notices the EOF and cancels
+    the in-flight call through the cancellation plane (the replica
+    observes it via was_cancelled())."""
+    marker = str(tmp_path / "cancelled")
+
+    @serve.deployment(num_replicas=1)
+    def slow(payload):
+        ctx = ray_tpu.get_runtime_context()
+        for _ in range(payload["loops"]):
+            if ctx.was_cancelled():
+                open(payload["path"], "w").close()
+                return "cancelled"
+            time.sleep(0.05)
+        return "finished"
+
+    serve.run(slow.bind(), name="slowdep")
+    proxy = serve.start_http_proxy()
+    # warm the replica + route so the cold start doesn't eat the test
+    status, body = _post(
+        f"{proxy.address}/slowdep",
+        {"loops": 1, "path": str(tmp_path / "warm")},
+    )
+    assert status == 200 and json.loads(body)["result"] == "finished"
+
+    # raw socket request, then hang up while the replica is mid-call
+    payload = json.dumps({"loops": 400, "path": marker}).encode()
+    request = (
+        f"POST /slowdep HTTP/1.1\r\nHost: {proxy.host}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n"
+    ).encode() + payload
+    conn = socket.create_connection((proxy.host, proxy.port))
+    conn.sendall(request)
+    time.sleep(1.0)  # the replica is inside the 20s loop now
+    conn.close()  # client walks away
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not os.path.exists(marker):
+        time.sleep(0.1)
+    assert os.path.exists(marker), (
+        "replica call was not cancelled after the client disconnected"
+    )
     proxy.stop()
 
 
