@@ -4,6 +4,12 @@ Measures the same surfaces as the reference's microbenchmark suite
 (reference: python/ray/_private/ray_perf.py:93, archived results in
 release/release_logs/2.4.0/microbenchmark.json). Prints one JSON line per
 metric plus a summary line.
+
+``--attribute`` instead measures per-subsystem hot-path overhead (ns/op
+for the unarmed chaos hook, metrics inc, retry classification, and rpc
+phase recording — paired against an empty loop) and writes
+BENCH_ATTRIBUTION.json; the budget regression test in
+tests/test_perf_plane.py holds the always-on rows to fixed ceilings.
 """
 
 from __future__ import annotations
@@ -351,5 +357,53 @@ def main():
     return payload
 
 
+def attribute(iters: int = 200_000, repeats: int = 5):
+    """Per-subsystem ns/op attribution — no cluster needed, pure hot-path
+    loops (ray_tpu._private.perf.measure_overhead)."""
+    import os
+
+    from ray_tpu._private import perf as perf_mod
+
+    ns = perf_mod.measure_overhead(iters=iters, repeats=repeats)
+    for key in sorted(ns):
+        row = {"metric": f"overhead_{key}", "value": round(ns[key], 1),
+               "unit": "ns/op"}
+        budget = perf_mod.OVERHEAD_BUDGET_NS.get(key)
+        if budget is not None:
+            row["budget_ns"] = budget
+            row["within_budget"] = ns[key] <= budget
+        print(json.dumps(row), flush=True)
+    payload = {
+        "iters": iters,
+        "repeats": repeats,
+        "ns_per_op": {k: round(v, 1) for k, v in sorted(ns.items())},
+        "budget_ns": dict(perf_mod.OVERHEAD_BUDGET_NS),
+    }
+    artifact = os.environ.get(
+        "BENCH_ATTRIBUTION_ARTIFACT", "BENCH_ATTRIBUTION.json"
+    )
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           artifact), "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return payload
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--attribute", action="store_true",
+        help="measure per-subsystem hot-path overhead instead of the "
+        "cluster microbenchmarks",
+    )
+    parser.add_argument("--iters", type=int, default=200_000,
+                        help="--attribute: iterations per loop")
+    parser.add_argument("--repeats", type=int, default=5,
+                        help="--attribute: repeats (min taken)")
+    cli_args = parser.parse_args()
+    if cli_args.attribute:
+        attribute(iters=cli_args.iters, repeats=cli_args.repeats)
+    else:
+        main()

@@ -47,6 +47,10 @@ from ray_tpu.api import (
 # plain import — chaos.py itself lazy-imports the RPC layer on first call
 from ray_tpu import chaos
 
+# perf plane (ray_tpu.perf.profile/record/summarize_rpcs); also a plain
+# import — perf.py lazy-imports the RPC layer on first call
+from ray_tpu import perf
+
 
 def timeline(filename=None, *, address=None):
     """Chrome-tracing dump of all task execution — always on, no
@@ -64,6 +68,7 @@ __all__ = [
     "is_initialized",
     "timeline",
     "chaos",
+    "perf",
     "remote",
     "get",
     "put",

@@ -1621,6 +1621,19 @@ class GcsServer:
                         out["series"][key] = value
         return list(merged.values())
 
+    def rpc_perf_profile(self, conn, payload=None):
+        """Cluster sampling profiler, GCS leg: sample THIS process (the
+        handler blocks a dispatch-pool thread for the window — the pool
+        is dynamic, so concurrent control traffic keeps flowing)."""
+        from ray_tpu._private import perf as _perf_mod
+
+        p = payload or {}
+        return _perf_mod.sample_self(
+            min(float(p.get("duration_s", 2.0)), 30.0),
+            float(p.get("hz", 100.0)),
+            role="gcs",
+        )
+
     def stop(self):
         self._stopped.set()
         self.server.stop()

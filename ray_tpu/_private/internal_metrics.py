@@ -102,6 +102,17 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     # -- rpc ----------------------------------------------------------
     "ray_tpu_rpc_pump_failures": (
         "counter", "native poller pump-thread crashes (streams torn down)", ()),
+    "ray_tpu_rpc_phase_seconds": (
+        "histogram",
+        "per-phase RPC latency (client: serialize/send/wire/deserialize/"
+        "total; server: deserialize/queue/handler/reply) — exported by the "
+        "perf plane's ring/bucket accumulators, not Metric.observe",
+        ("method", "phase", "side")),
+    # -- perf plane ---------------------------------------------------
+    "ray_tpu_perf_profile_runs_total": (
+        "counter", "sampling-profiler runs executed in this process", ()),
+    "ray_tpu_perf_profile_samples_total": (
+        "counter", "stack samples collected by the sampling profiler", ()),
     # -- state API ----------------------------------------------------
     "ray_tpu_state_api_node_errors": (
         "counter",
@@ -192,3 +203,41 @@ def set_gauge(name: str, value: float,
         get(name).set(value, tags=tags)
     except Exception:
         pass
+
+
+# -- pre-bound series handles ------------------------------------------
+#
+# ``inc(name, tags={...})`` builds a dict, merges it with default tags and
+# sorts the items — per call. Hot paths (task execution, rpc retries)
+# resolve the series ONCE via these helpers and keep the returned handle:
+# its inc()/observe() is lock + add, nothing else.
+
+
+class _NullBound:
+    __slots__ = ()
+
+    def inc(self, value: float = 1.0) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+_NULL_BOUND = _NullBound()
+
+
+def bound_counter(name: str, tags: Optional[Dict[str, str]] = None):
+    """Allocation-free counter handle for a fixed (family, tags) series.
+    Never raises: falls back to a no-op handle on any error."""
+    try:
+        return get(name).bind(tags)
+    except Exception:
+        return _NULL_BOUND
+
+
+def bound_histogram(name: str, tags: Optional[Dict[str, str]] = None):
+    """Allocation-free histogram handle (see ``bound_counter``)."""
+    try:
+        return get(name).bind(tags)
+    except Exception:
+        return _NULL_BOUND

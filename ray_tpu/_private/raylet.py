@@ -2007,6 +2007,50 @@ class Raylet:
             t.join(duration + 15.0)
         return {"node_id": self.node_id.hex(), "workers": workers}
 
+    def rpc_perf_profile(self, conn, payload=None):
+        """Cluster sampling profiler, node leg: sample this raylet process
+        AND fan the per-worker ``profile`` RPC across registered workers,
+        all concurrently for the same window (``ray_tpu.perf.profile``
+        merges the per-node results; same fan-out as rpc_dump_stacks)."""
+        from ray_tpu._private import perf as _perf_mod
+
+        p = payload or {}
+        duration = min(float(p.get("duration_s", 2.0)), 30.0)
+        hz = float(p.get("hz", 100.0))
+        nid = self.node_id.hex()
+        with self._res_cv:
+            targets = [
+                (h.worker_id, tuple(h.address))
+                for h in self._workers.values()
+                if h.registered.is_set() and h.address and h.address[1]
+            ]
+        processes: Dict[str, Any] = {}
+
+        def _self():
+            processes[f"raylet:{nid[:8]}"] = _perf_mod.sample_self(
+                duration, hz, role="raylet"
+            )
+
+        def _one(wid: WorkerID, addr: Tuple[str, int]):
+            key = f"worker:{wid.hex()[:8]}@{nid[:8]}"
+            try:
+                processes[key] = self._peer_client(addr).call(
+                    "profile",
+                    {"duration_s": duration, "interval_s": 1.0 / max(hz, 1.0)},
+                    timeout=duration + 10.0,
+                )
+            except Exception as e:
+                processes[key] = {"error": repr(e)}
+
+        threads = [threading.Thread(target=_self, daemon=True)] + [
+            threading.Thread(target=_one, args=t, daemon=True) for t in targets
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(duration + 15.0)
+        return {"node_id": nid, "processes": processes}
+
     def stop(self, unregister: bool = True):
         object_store.unregister_local_store(self.server.address)
         if unregister:

@@ -36,6 +36,7 @@ from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import fault_injection as _fi
+from ray_tpu._private import perf as _perf
 from ray_tpu._private.config import GlobalConfig
 
 # Versioned wire header: magic + version + frame kind + payload length.
@@ -341,6 +342,23 @@ IDEMPOTENT_METHODS = frozenset({
 })
 
 
+_retry_counters: Dict[str, Any] = {}
+
+
+def _retry_counter(method: str):
+    """Per-method bound retry counter, resolved once (no tag-dict per
+    retry; see internal_metrics.bound_counter)."""
+    c = _retry_counters.get(method)
+    if c is None:
+        from ray_tpu._private import internal_metrics
+
+        c = internal_metrics.bound_counter(
+            "ray_tpu_rpc_retries_total", {"method": method}
+        )
+        _retry_counters[method] = c
+    return c
+
+
 def _wire_safe_exc(e: BaseException) -> BaseException:
     """Downcast an exception to one the peer's restricted unpickler will
     accept. A handler can raise anything (e.g. subprocess.TimeoutExpired out
@@ -373,7 +391,9 @@ class _SendState:
         self.sock = sock
 
     def send_frame(self, obj: Any):
-        parts = _encode_frame_parts(obj)
+        self.send_parts(_encode_frame_parts(obj))
+
+    def send_parts(self, parts: list):
         with self.lock:
             if self.buf:
                 for p in parts:
@@ -523,7 +543,10 @@ class _NativeSendState:
         self.stream = stream
 
     def send_frame(self, obj: Any):
-        rc = self._poller.loop.sendv(self._cid, _encode_frame_parts(obj))
+        self.send_parts(_encode_frame_parts(obj))
+
+    def send_parts(self, parts: list):
+        rc = self._poller.loop.sendv(self._cid, parts)
         if rc == 0:
             return
         if rc == -3:
@@ -1012,7 +1035,17 @@ class ServerConn:
             raise ConnectionLost("unauthenticated request")
         if kind != REQUEST:
             return
-        msg_id, method, payload = _decode_body(body)
+        if _perf._enabled:
+            td0 = time.monotonic_ns()
+            msg_id, method, payload = _decode_body(body)
+            enq_ns = time.monotonic_ns()
+            try:
+                _perf.record_server(method, deser_ns=enq_ns - td0)
+            except Exception:
+                pass
+        else:
+            enq_ns = 0
+            msg_id, method, payload = _decode_body(body)
         srv = self._server
         if _fi._armed is not None:
             decision = _fi.decide("recv", method, _fi.addr_key(self.addr),
@@ -1041,7 +1074,9 @@ class ServerConn:
             # resolving thread) — arrival order is execution order
             srv._dispatch_inline(self, msg_id, method, payload)
         else:
-            srv._pool.submit(srv._dispatch, self, msg_id, method, payload)
+            srv._pool.submit(
+                srv._dispatch, self, msg_id, method, payload, enq_ns
+            )
 
     def on_closed(self, exc: Exception):
         srv = self._server
@@ -1214,6 +1249,7 @@ class RpcServer:
 
     def _dispatch_inline(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
         handler = self._handlers[method]
+        t_start = time.monotonic_ns() if _perf._enabled else 0
         try:
             reply = handler(conn, payload)
         except Exception as e:  # noqa: BLE001
@@ -1226,7 +1262,19 @@ class RpcServer:
             reply.on_resolve(self._deferred_sender(conn, msg_id, method))
         else:
             try:
-                conn.sender.send_frame((RESPONSE, msg_id, method, reply))
+                if t_start:
+                    t_h = time.monotonic_ns()
+                    conn.sender.send_frame((RESPONSE, msg_id, method, reply))
+                    t_r = time.monotonic_ns()
+                    try:
+                        _perf.record_server(
+                            method, handler_ns=t_h - t_start,
+                            reply_ns=t_r - t_h,
+                        )
+                    except Exception:
+                        pass
+                else:
+                    conn.sender.send_frame((RESPONSE, msg_id, method, reply))
             except (ConnectionLost, OSError):
                 conn.closed.set()
 
@@ -1243,16 +1291,39 @@ class RpcServer:
 
         return _send
 
-    def _dispatch(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
+    def _dispatch(self, conn: ServerConn, msg_id: int, method: str,
+                  payload: Any, enq_ns: int = 0):
         handler = self._handlers.get(method)
+        t_start = time.monotonic_ns() if _perf._enabled else 0
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r} on {self.name}")
             reply = handler(conn, payload)
             if isinstance(reply, Deferred):
+                # queue time is real; handler/reply complete on the
+                # resolving thread, outside this frame — don't guess them
+                if t_start and enq_ns:
+                    try:
+                        _perf.record_server(method, queue_ns=t_start - enq_ns)
+                    except Exception:
+                        pass
                 reply.on_resolve(self._deferred_sender(conn, msg_id, method))
                 return
-            conn.sender.send_frame((RESPONSE, msg_id, method, reply))
+            if t_start:
+                t_h = time.monotonic_ns()
+                conn.sender.send_frame((RESPONSE, msg_id, method, reply))
+                t_r = time.monotonic_ns()
+                try:
+                    _perf.record_server(
+                        method,
+                        queue_ns=(t_start - enq_ns) if enq_ns else None,
+                        handler_ns=t_h - t_start,
+                        reply_ns=t_r - t_h,
+                    )
+                except Exception:
+                    pass
+            else:
+                conn.sender.send_frame((RESPONSE, msg_id, method, reply))
         except (ConnectionLost, OSError):
             conn.closed.set()
         except Exception as e:  # noqa: BLE001 - forwarded to caller
@@ -1363,7 +1434,13 @@ class RpcClient:
         self._frames.feed(self._sock, self._on_frame)
 
     def _on_frame(self, kind: int, body: bytes):
-        msg_id, method, payload = _decode_body(body)
+        if _perf._enabled:
+            td0 = time.monotonic_ns()
+            msg_id, method, payload = _decode_body(body)
+            td1 = time.monotonic_ns()
+        else:
+            td0 = td1 = 0
+            msg_id, method, payload = _decode_body(body)
         if kind == ERROR and msg_id == 0:
             # connection-level refusal (e.g. "authentication required"):
             # there is no per-call slot to route it to — fail everything
@@ -1383,6 +1460,13 @@ class RpcClient:
             slot = self._pending.pop(msg_id, None)
         if slot is None:
             return
+        if td1:
+            p = slot.get("perf")
+            if p is not None:
+                try:
+                    _perf.record_client(method, p[0], p[1], p[2], td0, td1)
+                except Exception:
+                    pass  # stats must never kill the poller thread
         if "callback" in slot:
             _get_callback_executor().submit(slot["callback"], kind, payload)
         else:
@@ -1466,11 +1550,7 @@ class RpcClient:
                 attempt += 1
                 if attempt >= attempts:
                     raise
-            from ray_tpu._private import internal_metrics
-
-            internal_metrics.inc(
-                "ray_tpu_rpc_retries_total", tags={"method": method}
-            )
+            _retry_counter(method).inc()
             # full jitter: each retrier draws uniformly in [0, capped
             # exponential] so a thundering herd decorrelates
             time.sleep(random.uniform(0.0, min(cap, base * (2 ** (attempt - 1)))))
@@ -1510,7 +1590,21 @@ class RpcClient:
         with self._pending_lock:
             self._pending[msg_id] = slot
         try:
-            self.sender.send_frame((REQUEST, msg_id, method, payload))
+            if _perf._enabled:
+                # phase timers: serialize / send stamped here, wire /
+                # deserialize completed by _on_frame off the stashed list
+                # (mutable + stashed pre-send: the reply can only arrive
+                # after the request left, so a racing _on_frame sees at
+                # worst an unset send delta, never a missing record)
+                t0 = time.monotonic_ns()
+                p = [t0, 0, 0]
+                slot["perf"] = p
+                parts = _encode_frame_parts((REQUEST, msg_id, method, payload))
+                p[1] = time.monotonic_ns() - t0
+                self.sender.send_parts(parts)
+                p[2] = time.monotonic_ns() - t0 - p[1]
+            else:
+                self.sender.send_frame((REQUEST, msg_id, method, payload))
             if duplicate:
                 self.sender.send_frame((REQUEST, msg_id, method, payload))
         except (ConnectionLost, OSError) as e:
@@ -1583,7 +1677,18 @@ class RpcClient:
 
         def _send():
             try:
-                self.sender.send_frame((REQUEST, msg_id, method, payload))
+                if _perf._enabled:
+                    t0 = time.monotonic_ns()
+                    p = [t0, 0, 0]
+                    slot["perf"] = p
+                    parts = _encode_frame_parts(
+                        (REQUEST, msg_id, method, payload)
+                    )
+                    p[1] = time.monotonic_ns() - t0
+                    self.sender.send_parts(parts)
+                    p[2] = time.monotonic_ns() - t0 - p[1]
+                else:
+                    self.sender.send_frame((REQUEST, msg_id, method, payload))
                 if duplicate:
                     self.sender.send_frame((REQUEST, msg_id, method, payload))
             except (ConnectionLost, OSError) as e:

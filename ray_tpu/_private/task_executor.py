@@ -46,6 +46,25 @@ class _NullGate:
 
 _NULL_GATE = _NullGate()
 
+# per-kind bound metric handles, resolved on first task of each kind:
+# the per-task path is lock + add, not tag-dict build + merge + sort
+_task_metric_handles: Dict[str, Tuple[Any, Any]] = {}
+
+
+def _task_metrics(kind: str) -> Tuple[Any, Any]:
+    h = _task_metric_handles.get(kind)
+    if h is None:
+        h = (
+            internal_metrics.bound_counter(
+                "ray_tpu_tasks_executed_total", {"kind": kind}
+            ),
+            internal_metrics.bound_histogram(
+                "ray_tpu_task_exec_latency_seconds", {"kind": kind}
+            ),
+        )
+        _task_metric_handles[kind] = h
+    return h
+
 
 class _ActorState:
     """Hosts one actor instance plus its in-order execution queue.
@@ -463,14 +482,9 @@ class TaskExecutor:
                 fn, args, kwargs, task_id, spec["name"], trace=spec.get("trace"),
                 attempt=spec.get("attempt", 0),
             )
-            internal_metrics.inc(
-                "ray_tpu_tasks_executed_total", tags={"kind": "normal"}
-            )
-            internal_metrics.observe(
-                "ray_tpu_task_exec_latency_seconds",
-                time.perf_counter() - exec_t0,
-                tags={"kind": "normal"},
-            )
+            executed, latency = _task_metrics("normal")
+            executed.inc()
+            latency.observe(time.perf_counter() - exec_t0)
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc)
         )
@@ -517,14 +531,9 @@ class TaskExecutor:
                     method, args, kwargs, task_id, spec["name"], loop=loop,
                     trace=spec.get("trace"), attempt=spec.get("attempt", 0),
                 )
-                internal_metrics.inc(
-                    "ray_tpu_tasks_executed_total", tags={"kind": "actor"}
-                )
-                internal_metrics.observe(
-                    "ray_tpu_task_exec_latency_seconds",
-                    time.perf_counter() - exec_t0,
-                    tags={"kind": "actor"},
-                )
+                executed, latency = _task_metrics("actor")
+                executed.inc()
+                latency.observe(time.perf_counter() - exec_t0)
         return self._reply(
             self._package_results(task_id, spec["num_returns"], value, is_exc)
         )

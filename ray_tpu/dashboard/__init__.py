@@ -14,8 +14,12 @@ APIs:
                           [&file=<name>&tail=N] reaches any node through
                           the raylet log plane)
   GET /api/stack         (all-workers stack report via dump_stacks)
+  GET /api/perf          (cluster-wide RPC phase stats via summarize_rpcs)
+  GET /api/perf_profile  (?duration=2&hz=100 — cluster flamegraph as
+                          speedscope JSON; save and open at speedscope.app)
   GET /metrics           (Prometheus exposition)
   GET /events            (event log view)
+  GET /perf              (RPC phase latency view)
   GET /logs              (cluster log browser)
   GET /logs/{node}/{file} (one log file, auto-refreshing tail)
   GET /                  (the UI)
@@ -46,7 +50,8 @@ _PAGE = """<!doctype html>
 <h2>Jobs</h2><div id="jobs"></div>
 <h2>Task summary</h2><div id="summary"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
-<h2>Events <a href="/events" style="font-size:.75rem">(full log)</a></h2>
+<h2>Events <a href="/events" style="font-size:.75rem">(full log)</a>
+<a href="/perf" style="font-size:.75rem">(rpc perf)</a></h2>
 <div id="events"></div>
 <script>
 function table(rows, cols){
@@ -157,6 +162,60 @@ async function refresh(){
 refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
+
+_PERF_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu perf</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1rem;font-family:monospace}
+ table{border-collapse:collapse;background:#fff}
+ th,td{border:1px solid #ddd;padding:.3rem .6rem;font-size:.82rem;text-align:left}
+ td.n{text-align:right;font-variant-numeric:tabular-nums}
+ th{background:#f0f0f0}
+ #updated{color:#888;font-size:.8rem}
+ .hint{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>RPC phase latency <a href="/" style="font-size:.8rem">dashboard</a>
+<span id="updated"></span></h1>
+<p class="hint">cluster-wide p50/p95/p99 per method and phase
+(client: serialize/send/wire/deserialize/total;
+server: deserialize/queue/handler/reply).
+<a href="/api/perf_profile?duration=2&hz=100" download="raytpu_profile.json">
+record 2s flamegraph</a> (open the download at speedscope.app)</p>
+<div id="out">loading…</div>
+<script>
+function us(s){
+  const v = s*1e6;
+  if(v >= 1e5) return (v/1e6).toFixed(2)+'s';
+  if(v >= 1e3) return (v/1e3).toFixed(1)+'ms';
+  return v.toFixed(1)+'us';
+}
+async function refresh(){
+  try{
+    const stats = await (await fetch('/api/perf')).json();
+    const methods = Object.keys(stats).sort();
+    let h = '';
+    for(const m of methods){
+      h += `<h2>${m}</h2><table><tr><th>phase</th><th>count</th>`+
+           '<th>mean</th><th>p50</th><th>p95</th><th>p99</th></tr>';
+      for(const ph of Object.keys(stats[m]).sort()){
+        const r = stats[m][ph];
+        h += `<tr><td>${ph}</td><td class="n">${r.count}</td>`+
+             `<td class="n">${us(r.mean_s)}</td><td class="n">${us(r.p50_s)}</td>`+
+             `<td class="n">${us(r.p95_s)}</td><td class="n">${us(r.p99_s)}</td></tr>`;
+      }
+      h += '</table>';
+    }
+    document.getElementById('out').innerHTML =
+      h || '<em>no RPC phase samples reported yet</em>';
+    document.getElementById('updated').textContent =
+      'updated '+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
 
 _LOGS_PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>ray_tpu logs</title>
@@ -482,6 +541,8 @@ class DashboardServer:
                 return b"", "text/plain"
         if base0 == "/events":
             return _EVENTS_PAGE.encode(), "text/html; charset=utf-8"
+        if base0 == "/perf":
+            return _PERF_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/logs":
             return _LOGS_PAGE.encode(), "text/html; charset=utf-8"
         if base0.startswith("/logs/"):
@@ -496,6 +557,7 @@ class DashboardServer:
             "/api/summary": lambda: s.summarize_tasks(address=a),
             "/api/cluster": lambda: self._cluster_overview(),
             "/api/stack": lambda: s.dump_stacks(address=a),
+            "/api/perf": lambda: s.summarize_rpcs(address=a),
         }
         base, _, query = path.partition("?")
         if base == "/api/events":
@@ -536,6 +598,20 @@ class DashboardServer:
                 json.dumps(_to_jsonable(self._task_detail(query))).encode(),
                 "application/json",
             )
+        if base == "/api/perf_profile":
+            # ?duration=2&hz=100 -> cluster flamegraph as speedscope JSON
+            # (blocks one handler thread for the window; the server is
+            # threading, so the UI keeps polling meanwhile)
+            from urllib.parse import parse_qs
+
+            from ray_tpu import perf as perf_mod
+
+            q = parse_qs(query)
+            duration = min(float((q.get("duration") or ["2.0"])[0]), 30.0)
+            hz = float((q.get("hz") or ["100.0"])[0])
+            result = perf_mod.profile(duration, hz, address=a)
+            doc = perf_mod.to_speedscope(result["processes"])
+            return json.dumps(doc).encode(), "application/json"
         if base == "/api/profile":
             # /api/profile?actor=<hex>&duration=2 -> folded stacks
             from urllib.parse import parse_qs

@@ -1,0 +1,162 @@
+"""Public perf plane: cluster-wide sampling profiler + RPC phase stats.
+
+``ray_tpu.perf.profile()`` fans a ``sys._current_frames()`` sampler into
+every process in the cluster — each raylet samples itself and its
+registered workers concurrently (``rpc_perf_profile`` in raylet.py), the
+GCS samples itself, and the connected driver samples in-process — then
+merges the folded stacks into one report. ``record()`` writes the merged
+report as a speedscope JSON flamegraph (drop it on speedscope.app).
+
+RPC phase percentiles live next door: cluster-wide via
+:func:`summarize_rpcs` (re-exported from ``ray_tpu.util.state``), exact
+process-local via :func:`local_rpc_stats`.
+
+Everything here lazy-imports the RPC layer: ``import ray_tpu`` pulls this
+module, and drivers that never profile must not pay for it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.perf import (  # re-exports: the process-local core
+    OVERHEAD_BUDGET_NS,
+    local_rpc_stats,
+    measure_overhead,
+    merge_reports,
+    sample_self,
+    to_speedscope,
+)
+
+__all__ = [
+    "profile",
+    "record",
+    "summarize_rpcs",
+    "local_rpc_stats",
+    "sample_self",
+    "merge_reports",
+    "to_speedscope",
+    "measure_overhead",
+    "OVERHEAD_BUDGET_NS",
+]
+
+#: dedup priority when several roles share one pid (in-process clusters
+#: run driver + raylets + GCS in one process) — lower keeps its report
+_ROLE_RANK = {"worker": 0, "driver": 1, "gcs": 2, "raylet": 3}
+
+
+def summarize_rpcs(*, address: Optional[str] = None,
+                   method: Optional[str] = None):
+    """Cluster-wide per-method RPC phase p50/p95/p99 (see
+    ``ray_tpu.util.state.summarize_rpcs``)."""
+    from ray_tpu.util import state as _state
+
+    return _state.summarize_rpcs(address=address, method=method)
+
+
+def profile(
+    duration_s: float = 2.0,
+    hz: float = 100.0,
+    *,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Sample every cluster process for ``duration_s`` at ``hz``.
+
+    Returns ``{"processes": {key: {pid, role?, samples, folded}},
+    "errors": {key: message}}`` where keys look like
+    ``worker:ab12cd34@node0012``, ``raylet:node0012``, ``gcs``,
+    ``driver``. Processes appearing under several roles (in-process
+    clusters share one pid) are deduplicated, keeping the most specific
+    role. Feed the result to :func:`to_speedscope` /
+    :func:`merge_reports`, or just call :func:`record`.
+    """
+    from ray_tpu.util.state import _gcs_call, _cached_client, list_nodes
+
+    duration_s = min(float(duration_s), 30.0)
+    raw: Dict[str, Any] = {}
+    errors: Dict[str, str] = {}
+    lock = threading.Lock()
+
+    def _node(nid: str, addr: str) -> None:
+        try:
+            res = _cached_client(addr).call(
+                "perf_profile",
+                {"duration_s": duration_s, "hz": hz},
+                timeout=duration_s + 30.0,
+            )
+            with lock:
+                raw.update(res.get("processes") or {})
+        except Exception as e:  # noqa: BLE001 — one dead node ≠ no profile
+            with lock:
+                errors[f"raylet:{nid[:8]}"] = repr(e)
+
+    def _gcs() -> None:
+        try:
+            res = _gcs_call(
+                "perf_profile",
+                {"duration_s": duration_s, "hz": hz},
+                address=address,
+            )
+            with lock:
+                raw["gcs"] = res
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors["gcs"] = repr(e)
+
+    threads = [threading.Thread(target=_gcs, daemon=True)]
+    for node in list_nodes(address=address):
+        if not node.get("alive"):
+            continue
+        nid = node["node_id"].hex()
+        threads.append(threading.Thread(
+            target=_node,
+            args=(nid, "{}:{}".format(*node["address"])),
+            daemon=True,
+        ))
+    for t in threads:
+        t.start()
+    if address is None:
+        # connected in-process: sample the driver too, same window
+        import ray_tpu._private.worker as worker_mod
+
+        if worker_mod.global_worker is not None:
+            raw["driver"] = sample_self(duration_s, hz, role="driver")
+    for t in threads:
+        t.join(duration_s + 35.0)
+
+    # pid-dedup: keep the most specific role's report per pid
+    processes: Dict[str, Any] = {}
+    by_pid: Dict[int, str] = {}
+    for key in sorted(
+        raw, key=lambda k: _ROLE_RANK.get(k.split(":", 1)[0], 9)
+    ):
+        report = raw[key]
+        if "error" in report:
+            errors[key] = report["error"]
+            continue
+        pid = report.get("pid")
+        if pid in by_pid:
+            continue
+        if pid is not None:
+            by_pid[pid] = key
+        processes[key] = report
+    return {"processes": processes, "errors": errors}
+
+
+def record(
+    path: str,
+    duration_s: float = 2.0,
+    hz: float = 100.0,
+    *,
+    address: Optional[str] = None,
+    name: str = "ray_tpu profile",
+) -> Dict[str, Any]:
+    """Profile the whole cluster and write a speedscope JSON flamegraph
+    to ``path``. Returns the :func:`profile` result dict."""
+    result = profile(duration_s, hz, address=address)
+    doc = to_speedscope(result["processes"], name=name)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return result

@@ -195,13 +195,66 @@ def cmd_list(args) -> int:
 
 
 def cmd_summary(args) -> int:
-    from ray_tpu.util.state import summarize_tasks
+    from ray_tpu.util.state import summarize_rpcs, summarize_tasks
 
-    print(
-        json.dumps(
-            summarize_tasks(address=_head_address(args.address)), indent=2
-        )
+    address = _head_address(args.address)
+    doc = {
+        "tasks": summarize_tasks(address=address),
+        "rpcs": summarize_rpcs(address=address),
+    }
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
+def _fmt_us(seconds: float) -> str:
+    us = seconds * 1e6
+    if us >= 100_000:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1000:
+        return f"{us / 1000:.1f}ms"
+    return f"{us:.1f}us"
+
+
+def cmd_perf(args) -> int:
+    """``raytpu perf rpcs`` / ``raytpu perf record`` — the perf plane."""
+    address = _head_address(args.address)
+    if args.perf_cmd == "rpcs":
+        from ray_tpu.util.state import summarize_rpcs
+
+        stats = summarize_rpcs(address=address, method=args.method)
+        if args.json:
+            print(json.dumps(stats, indent=2))
+            return 0
+        if not stats:
+            print("no RPC phase samples reported yet "
+                  "(processes flush every metrics_report_period_s)")
+            return 0
+        hdr = f"{'method':<24} {'phase':<20} {'count':>8} {'p50':>9} {'p95':>9} {'p99':>9}"
+        print(hdr)
+        print("-" * len(hdr))
+        for method in sorted(stats):
+            for phase in sorted(stats[method]):
+                row = stats[method][phase]
+                print(
+                    f"{method:<24} {phase:<20} {row['count']:>8} "
+                    f"{_fmt_us(row['p50_s']):>9} {_fmt_us(row['p95_s']):>9} "
+                    f"{_fmt_us(row['p99_s']):>9}"
+                )
+        return 0
+    # record: cluster-wide flamegraph
+    from ray_tpu import perf as perf_mod
+
+    result = perf_mod.record(
+        args.output, args.duration, args.hz, address=address
     )
+    procs = result["processes"]
+    total = sum(p.get("samples", 0) for p in procs.values())
+    print(
+        f"wrote speedscope profile of {len(procs)} process(es) "
+        f"({total} sampling sweeps) to {args.output}"
+    )
+    for key, err in sorted(result["errors"].items()):
+        print(f"!! {key}: {err}")
     return 0
 
 
@@ -428,9 +481,34 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--address")
     s.set_defaults(fn=cmd_list)
 
-    s = sub.add_parser("summary", help="task counts by name and state")
+    s = sub.add_parser(
+        "summary", help="task counts by name/state + RPC phase stats"
+    )
     s.add_argument("--address")
     s.set_defaults(fn=cmd_summary)
+
+    s = sub.add_parser(
+        "perf",
+        help="perf plane: RPC phase stats and cluster flamegraphs",
+        description="`perf rpcs` prints cluster-wide per-method RPC phase "
+        "percentiles; `perf record` samples every process in the cluster "
+        "and writes a speedscope flamegraph (open at speedscope.app).",
+    )
+    perf_sub = s.add_subparsers(dest="perf_cmd", required=True)
+    d = perf_sub.add_parser("rpcs", help="per-method RPC phase p50/p95/p99")
+    d.add_argument("--address")
+    d.add_argument("--method", help="only this RPC method")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.set_defaults(fn=cmd_perf)
+    d = perf_sub.add_parser("record", help="cluster-wide sampling profile")
+    d.add_argument("--address")
+    d.add_argument("-o", "--output", default="raytpu_profile.json",
+                   help="speedscope JSON output path")
+    d.add_argument("--duration", type=float, default=2.0,
+                   help="sampling window seconds (max 30)")
+    d.add_argument("--hz", type=float, default=100.0,
+                   help="samples per second (max 1000)")
+    d.set_defaults(fn=cmd_perf)
 
     s = sub.add_parser(
         "logs",

@@ -68,6 +68,16 @@ def main() -> int:
         host, port = args.address.rsplit(":", 1)
         node = Node(head=False, gcs_address=(host, int(port)), **kwargs)
 
+    # no global_worker in a standalone node process: report this
+    # process's metrics (raylet gauges, server-side rpc phase stats)
+    # through the raylet's own GCS client instead
+    from ray_tpu.util import metrics as user_metrics
+
+    user_metrics.configure_node_reporter(
+        node.raylet.gcs.call,
+        f"node:{node.raylet.node_id.hex()}:{os.getpid()}",
+    )
+
     dashboard = None
     dashboard_addr = None
     if args.head and args.dashboard_port >= 0:

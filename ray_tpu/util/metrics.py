@@ -11,6 +11,7 @@ scraping.
 
 from __future__ import annotations
 
+import bisect
 import os
 import threading
 import time
@@ -23,6 +24,21 @@ DEFAULT_BUCKETS = (
 _registry_lock = threading.Lock()
 _registry: List["Metric"] = []
 _reporter_started = False
+
+#: standalone node processes (CLI-started raylet/GCS hosts) have no
+#: global_worker; they report through this (gcs_call, reporter_key)
+#: fallback instead — set once by node_runner via configure_node_reporter
+_node_reporter: Optional[Tuple[Any, str]] = None
+
+
+def configure_node_reporter(gcs_call, reporter_key: str) -> None:
+    """Report this process's registry through ``gcs_call`` under
+    ``reporter_key`` (must be cluster-unique). For processes that host a
+    raylet/GCS without a connected worker — in-process drivers must NOT
+    call this, their worker reporter already covers the registry."""
+    global _node_reporter
+    _node_reporter = (gcs_call, reporter_key)
+    _ensure_reporter()
 
 
 def _ensure_reporter():
@@ -58,15 +74,19 @@ def flush():
     import ray_tpu._private.worker as worker_mod
 
     gcs = _gcs_client()
-    if gcs is None:
+    if gcs is not None:
+        # reporter key must be cluster-unique: pids collide across nodes
+        reporter = f"{worker_mod.global_worker.core.worker_id.hex()}:{os.getpid()}"
+        call = gcs.call
+    elif _node_reporter is not None:
+        call, reporter = _node_reporter
+    else:
         return
     with _registry_lock:
         records = [m._snapshot() for m in _registry]
     records = [r for r in records if r["series"]]
     if records:
-        # reporter key must be cluster-unique: pids collide across nodes
-        reporter = f"{worker_mod.global_worker.core.worker_id.hex()}:{os.getpid()}"
-        gcs.call("report_metrics", (reporter, records), timeout=5.0)
+        call("report_metrics", (reporter, records), timeout=5.0)
 
 
 class Metric:
@@ -112,6 +132,52 @@ class Metric:
         return value
 
 
+class BoundCounter:
+    """A counter series with its tag key resolved once at bind time: the
+    per-call path is lock + add, no dict merge, no sorted-tuple build."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: "Counter", key):
+        self._metric = metric
+        self._key = key
+        with metric._lock:
+            metric._series.setdefault(key, 0.0)
+
+    def inc(self, value: float = 1.0):
+        m = self._metric
+        with m._lock:
+            m._series[self._key] += value
+
+
+class BoundHistogram:
+    """A histogram series pre-resolved at bind time (see BoundCounter)."""
+
+    __slots__ = ("_metric", "_state", "_boundaries")
+
+    def __init__(self, metric: "Histogram", key):
+        self._metric = metric
+        self._boundaries = metric.boundaries
+        with metric._lock:
+            state = metric._series.get(key)
+            if state is None:
+                state = {
+                    "buckets": [0] * (len(metric.boundaries) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+                metric._series[key] = state
+            self._state = state
+
+    def observe(self, value: float):
+        idx = bisect.bisect_left(self._boundaries, value)
+        state = self._state
+        with self._metric._lock:
+            state["buckets"][idx] += 1
+            state["sum"] += value
+            state["count"] += 1
+
+
 class Counter(Metric):
     TYPE = "counter"
 
@@ -121,6 +187,11 @@ class Counter(Metric):
         key = self._key(tags)
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + value
+
+    def bind(self, tags: Optional[Dict[str, str]] = None) -> BoundCounter:
+        """Pre-resolve a tag set; the returned handle's ``inc()`` is
+        allocation-free (hot paths call this once, not per increment)."""
+        return BoundCounter(self, self._key(tags))
 
 
 class Gauge(Metric):
@@ -161,6 +232,10 @@ class Histogram(Metric):
             state["count"] += 1
         # exported with boundaries so aggregation can merge
         return value
+
+    def bind(self, tags: Optional[Dict[str, str]] = None) -> BoundHistogram:
+        """Pre-resolve a tag set for allocation-free ``observe()``."""
+        return BoundHistogram(self, self._key(tags))
 
     def _export(self, value):
         return {**value, "boundaries": self.boundaries}

@@ -35,6 +35,7 @@ __all__ = [
     "list_placement_groups",
     "list_tasks",
     "read_log_chunk",
+    "summarize_rpcs",
     "summarize_tasks",
     "timeline",
 ]
@@ -295,6 +296,79 @@ def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, Any]:
     return out
 
 
+def _bucket_quantile(
+    boundaries: List[float], buckets: List[int], q: float
+) -> float:
+    """Quantile estimate from histogram bins: linear interpolation inside
+    the bin where the rank lands (Prometheus histogram_quantile style);
+    the overflow bin clamps to the top boundary."""
+    total = sum(buckets)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= rank and c:
+            if i >= len(boundaries):
+                return float(boundaries[-1])
+            lo = float(boundaries[i - 1]) if i > 0 else 0.0
+            hi = float(boundaries[i])
+            frac = (rank - (cum - c)) / c
+            return lo + (hi - lo) * frac
+    return float(boundaries[-1])
+
+
+def summarize_rpcs(
+    *,
+    address: Optional[str] = None,
+    method: Optional[str] = None,
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Cluster-wide RPC phase latency summary, merged across every
+    reporting process from the ``ray_tpu_rpc_phase_seconds`` histogram
+    family: ``{method: {"client.serialize": {count, mean_s, p50_s,
+    p95_s, p99_s}, ..., "server.handler": {...}}}``.
+
+    Percentiles are bucket-interpolated (cluster-wide merge keeps only
+    histogram buckets); for this process's exact ring-based numbers use
+    ``ray_tpu._private.perf.local_rpc_stats()``."""
+    if address is None:
+        # fold this driver's not-yet-reported phase deltas in first —
+        # the reporter loop only pushes every metrics_report_period_s
+        try:
+            from ray_tpu.util import metrics as user_metrics
+
+            user_metrics.flush()
+        except Exception:  # noqa: BLE001 — summary must not require flush
+            pass
+    records = _gcs_call(
+        "get_metrics", "ray_tpu_rpc_phase_seconds", address=address
+    )
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for rec in records or ():
+        for key, val in rec["series"].items():
+            tags = dict(key)
+            m = tags.get("method", "?")
+            if method is not None and m != method:
+                continue
+            boundaries = list(val.get("boundaries") or ())
+            buckets = list(val.get("buckets") or ())
+            count = int(val.get("count") or 0)
+            if not count or not boundaries:
+                continue
+            row = {
+                "count": count,
+                "mean_s": float(val.get("sum") or 0.0) / count,
+                "p50_s": _bucket_quantile(boundaries, buckets, 0.50),
+                "p95_s": _bucket_quantile(boundaries, buckets, 0.95),
+                "p99_s": _bucket_quantile(boundaries, buckets, 0.99),
+            }
+            out.setdefault(m, {})[
+                f"{tags.get('side', '?')}.{tags.get('phase', '?')}"
+            ] = row
+    return out
+
+
 def list_cluster_events(
     *,
     address: Optional[str] = None,
@@ -393,6 +467,34 @@ def timeline(
                 "args": {"task_id": tid, "state": "RUNNING"},
             }
         )
+    # driver-side RPC slices from the perf plane share the task timebase
+    # (wall clock), so control-plane latency lines up under the task rows
+    try:
+        from ray_tpu._private import perf as _perf_mod
+
+        for (method, start_s, total_s, ser_s, send_s, wire_s,
+             deser_s) in _perf_mod.recent_slices():
+            pid, lane = "rpc (driver)", method
+            lanes_seen.setdefault((pid, lane))
+            trace.append(
+                {
+                    "name": method,
+                    "cat": "rpc",
+                    "ph": "X",
+                    "ts": start_s * 1e6,
+                    "dur": total_s * 1e6,
+                    "pid": pid,
+                    "tid": lane,
+                    "args": {
+                        "serialize_us": ser_s * 1e6,
+                        "send_us": send_s * 1e6,
+                        "wire_us": wire_s * 1e6,
+                        "deserialize_us": deser_s * 1e6,
+                    },
+                }
+            )
+    except Exception:  # noqa: BLE001 — timeline must not require perf
+        pass
     # metadata records name the lanes in trace viewers
     for pid, lane in lanes_seen:
         trace.append(

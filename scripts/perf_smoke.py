@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Per-PR perf-plane smoke (<60 s): phase tracing, cluster profiler,
+overhead budgets — end to end on a real 2-node in-process cluster.
+
+Hard-fails (nonzero exit) when any leg breaks:
+  1. RPC phase tracing: summarize_rpcs() reports client+server phase
+     percentiles for the control-plane methods the acceptance bar names
+     (store_put / ping / task submission).
+  2. Cluster profiler: perf.record() writes a speedscope flamegraph
+     merging >= 2 distinct OS processes.
+  3. Overhead budgets: the always-on hot-path hooks stay under their
+     fixed ns/op ceilings (quick 20k-iteration pass of the same harness
+     bench_core.py --attribute runs at full length).
+
+Usage: env JAX_PLATFORMS=cpu python scripts/perf_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL perf_smoke: {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    t_start = time.time()
+    import ray_tpu
+    from ray_tpu._private import perf as perf_core
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(
+        initialize_head=True, head_node_args={"num_cpus": 2}
+    )
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address, log_level="ERROR")
+
+    @ray_tpu.remote
+    def big(i):
+        return b"x" * 200_000  # over the inline cap -> real store_put RPC
+
+    ray_tpu.get([big.remote(i) for i in range(20)])
+
+    # --- leg 1: phase tracing, driver-visible methods immediately
+    from ray_tpu.util.state import summarize_rpcs
+
+    stats = summarize_rpcs()
+    submit = next(
+        (m for m in ("push_task_batch", "push_task", "request_worker_lease")
+         if m in stats), None,
+    )
+    if submit is None:
+        fail(f"no task-submit method in summarize_rpcs: {sorted(stats)}")
+    row = stats[submit]["client.total"]
+    if not (row["count"] > 0 and row["p50_s"] <= row["p99_s"]):
+        fail(f"bad percentiles for {submit}: {row}")
+    print(f"OK   rpc phases: {submit} n={row['count']} "
+          f"p50={row['p50_s']*1e6:.0f}us p99={row['p99_s']*1e6:.0f}us")
+
+    # --- leg 2: cluster flamegraph
+    out = os.path.join(tempfile.mkdtemp(prefix="raytpu_perf_"), "prof.json")
+    result = ray_tpu.perf.record(out, duration_s=0.8, hz=50)
+    procs = result["processes"]
+    pids = {p["pid"] for p in procs.values()}
+    if len(pids) < 2:
+        fail(f"profile merged <2 processes: {sorted(procs)} "
+             f"errors={result['errors']}")
+    with open(out) as f:
+        doc = json.load(f)
+    if len(doc.get("profiles", ())) != len(procs) or not doc["shared"]["frames"]:
+        fail(f"malformed speedscope doc at {out}")
+    print(f"OK   profiler: {len(procs)} processes ({len(pids)} pids), "
+          f"{len(doc['shared']['frames'])} frames -> {out}")
+
+    # --- leg 3: worker-side phases aggregate within ~2 report periods
+    deadline = time.time() + 15.0
+    count = 0
+    while time.time() < deadline:
+        sp = summarize_rpcs().get("store_put", {})
+        count = sp.get("client.total", {}).get("count", 0)
+        if count >= 20 and "server.handler" in sp:
+            break
+        time.sleep(1.0)
+    if count < 20:
+        fail(f"store_put phases never aggregated (count={count})")
+    print(f"OK   cluster aggregation: store_put n={count} both sides")
+
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+    # --- leg 4: overhead budgets (quick pass)
+    ns = perf_core.measure_overhead(iters=20_000, repeats=3)
+    for key, budget in perf_core.OVERHEAD_BUDGET_NS.items():
+        if ns[key] > budget:
+            fail(f"overhead {key} = {ns[key]:.0f} ns/op > {budget:.0f}")
+    print("OK   overhead budgets: " + " ".join(
+        f"{k}={ns[k]:.0f}ns" for k in sorted(perf_core.OVERHEAD_BUDGET_NS)))
+
+    print(f"PASS perf_smoke in {time.time() - t_start:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

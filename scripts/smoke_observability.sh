@@ -12,5 +12,8 @@ python scripts/check_metrics_catalog.py
 env JAX_PLATFORMS=cpu python scripts/bench_smoke.py
 # seeded chaos run: fault injection + gray-failure lifecycle end to end
 bash scripts/chaos_smoke.sh
+# perf plane end to end: phase tracing, cluster flamegraph, overhead budgets
+env JAX_PLATFORMS=cpu python scripts/perf_smoke.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
-    tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py "$@"
+    tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py \
+    tests/test_perf_plane.py "$@"

@@ -19,7 +19,13 @@ from ray_tpu.models.training import (
     make_train_step,
 )
 from ray_tpu.parallel.mesh import MeshSpec
+from ray_tpu.parallel import pipeline as _pl
 from ray_tpu.parallel.pipeline import make_pp_train_step, pipeline_apply, stage_split
+
+requires_partial_manual = pytest.mark.skipif(
+    not _pl.PARTIAL_MANUAL_SUPPORTED,
+    reason="partial-manual shard_map (axis_names=/lax.pcast) needs jax>=0.8",
+)
 
 
 def _nano():
@@ -37,6 +43,7 @@ def test_stage_split_shapes():
         stage_split({"w": jnp.zeros((3, 2))}, 2)
 
 
+@requires_partial_manual
 def test_pipeline_apply_matches_sequential():
     """A toy stacked-linear network: pipelined output == sequential scan."""
     mesh = MeshSpec(dp=2, pp=4).build()
@@ -60,6 +67,7 @@ def test_pipeline_apply_matches_sequential():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-5)
 
 
+@requires_partial_manual
 def test_pipeline_apply_gradients_match():
     mesh = MeshSpec(dp=2, pp=4).build()
     L, D, M, mb = 4, 8, 4, 2
@@ -84,6 +92,7 @@ def test_pipeline_apply_gradients_match():
     np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq), atol=1e-4)
 
 
+@requires_partial_manual
 def test_pp_train_step_matches_dense():
     """Full pipelined GPT train step: loss equals the non-pipelined step."""
     cfg = _nano()
@@ -148,6 +157,7 @@ def test_multislice_mesh_train_step():
     assert float(metrics["loss"]) > 0.0
 
 
+@requires_partial_manual
 def test_pp_composes_with_fsdp_tp():
     """pp x fsdp x tp on one mesh: state sharded at rest over all three
     axes via shd.pp_rules, loss finite and step runs (VERDICT r2 weak #4)."""
