@@ -116,6 +116,24 @@ class _Config:
         # poller when the lib can't build (RAYTPU_RPC_NATIVE_TRANSPORT=0
         # forces the fallback)
         "rpc_native_transport": True,
+        # same-process fast path: clients constructed with prefer_local
+        # deliver frames straight into the target server's dispatch,
+        # skipping the socket (phase stats record them under side=local)
+        "rpc_local_fastpath": True,
+        # Nagle-style outbound coalescing for latency-tolerant small
+        # frames (async requests, notify pushes): frames queue per
+        # connection and flush as ONE write when the next immediate send
+        # drains them, the queued bytes/frames cross these thresholds, or
+        # the armed flush job runs — whichever happens first
+        "rpc_coalesce": True,
+        "rpc_coalesce_flush_bytes": 64 * 1024,
+        "rpc_coalesce_max_frames": 128,
+        # frames larger than this are never held back by the coalescer
+        "rpc_coalesce_max_frame_bytes": 32 * 1024,
+        # grant-ahead window for worker leases: one request_worker_lease
+        # round-trip may return up to this many already-idle workers when
+        # the caller's queue is deep (extras park in the idle-lease cache)
+        "lease_grant_window": 8,
         # --- task events / observability ---
         "task_events_enabled": True,
         "log_to_driver": True,  # stream worker stdout/stderr to the driver

@@ -163,7 +163,9 @@ class CoreWorker:
         self._chaos_node_identity = fault_injection.identity_for(
             None, tuple(raylet_address)
         )
-        self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
+        self.gcs = RpcClient(
+            gcs_address, on_notify=self._on_gcs_notify, prefer_local=True
+        )
         self.gcs.chaos_identity = self._chaos_node_identity
         if mode == "driver":
             # proactive actor-cache updates are a driver-side optimization;
@@ -193,7 +195,7 @@ class CoreWorker:
             # worker stdout/stderr streamed back via the log monitors
             # (reference: log_monitor.py -> gcs pubsub -> driver)
             self.gcs.call("subscribe", "logs")
-        self.raylet = RpcClient(raylet_address)
+        self.raylet = RpcClient(raylet_address, prefer_local=True)
         self.raylet.chaos_identity = self._chaos_node_identity
         reg = self.raylet.call(
             "register_worker",
@@ -664,8 +666,13 @@ class CoreWorker:
         plasma_ids: List[ObjectID] = []
         for oid in object_ids:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            # ownership BEFORE the store read: completion stores the result
+            # and THEN pops the task from _pending, so reading in the other
+            # order can classify an in-flight inline reply as
+            # plasma-resident and wait on a store it will never reach
+            owned = self._owns(oid)
             data = self.memory_store.get(oid, timeout=0)
-            if data is None and self._owns(oid):
+            if data is None and owned:
                 # owned but still pending: wait for the reply
                 data = self.memory_store.get(oid, timeout=remaining)
                 if data is None:
@@ -715,6 +722,10 @@ class CoreWorker:
             for oid in plasma_ids:
                 if self.plasma.contains(oid):
                     continue
+                # a reply that raced the ownership check lands inline in the
+                # memory store, which this loop cannot see — promote it so
+                # the next get_views pass picks it up (no-op otherwise)
+                self._promote_to_plasma(oid)
                 binary = oid.binary()
                 with self._locations_lock:
                     lost = binary in self._lost_objects and binary not in self._pulls_inflight
@@ -947,8 +958,9 @@ class CoreWorker:
         """Owner-side dependency resolution: make every dep readable by the
         executing worker. Inline values get promoted to plasma."""
         for oid in list(deps) + list(nested):
+            owned = self._owns(oid)  # before the store read (see get())
             data = self.memory_store.get(oid, timeout=0)
-            if data is None and self._owns(oid):
+            if data is None and owned:
                 # still in flight: wait for the reply, then re-read
                 data = self.memory_store.get(oid, timeout=None)
             if data is not None and data != PLASMA_MARKER:
@@ -1198,7 +1210,16 @@ class CoreWorker:
             waiting = len(self._lease_waiting.get(sig) or ())
             idle = len(self._idle_leases.get(sig) or ())
             inflight = self._lease_inflight.get(sig, 0)
-            need = min(waiting - idle - inflight, 32 - inflight)
+            # an in-flight request guarantees exactly ONE worker — its
+            # grant-ahead extras are opportunistic (only already-idle
+            # workers), so discount inflight at face value and divide only
+            # the REMAINING deficit by the window. Discounting the full
+            # window per request starves the raylet's parked-request queue,
+            # which is the autoscaler's demand signal (and spillback's
+            # chance to parallelize a saturated shape).
+            window = max(1, int(GlobalConfig.lease_grant_window))
+            deficit = waiting - idle - inflight
+            need = min(-(-deficit // window), 32 - inflight)
             if need <= 0:
                 return
             self._lease_inflight[sig] = inflight + need
@@ -1222,6 +1243,13 @@ class CoreWorker:
                     # reading it here (not from a side map) can't race with
                     # any cache eviction
                     runtime_env = waiting[0].get("runtime_env") or None
+                    # grant-ahead window: one round-trip may bring back up
+                    # to lease_grant_window already-idle workers when the
+                    # backlog warrants more than one
+                    count = min(
+                        max(1, int(GlobalConfig.lease_grant_window)),
+                        max(1, len(waiting) // max(1, GlobalConfig.task_push_batch)),
+                    )
                 try:
                     # short raylet-side wait: a request whose demand has
                     # since drained must not pin a submitter thread (nor
@@ -1234,6 +1262,7 @@ class CoreWorker:
                             "runtime_env": runtime_env,
                             "allow_spill": hops == 0,
                             "timeout": 1.0,
+                            "count": count,
                         },
                         timeout=GlobalConfig.worker_lease_timeout_s * 2,
                     )
@@ -1250,12 +1279,27 @@ class CoreWorker:
                     lease_raylet = self._get_raylet_client(tuple(lease["retry_at"]))
                     hops += 1
                     continue
+                extra = lease.pop("extra", None) or ()
                 try:
                     client = self._get_worker_client(tuple(lease["address"]))
                 except (ConnectionLost, OSError):
                     self._return_lease(lease, lease_raylet)
+                    client = None
+                if client is not None:
+                    self._on_worker_idle(
+                        sig, lease, lease_raylet, client, stash_ok=False
+                    )
+                # grant-ahead extras: feed the backlog, park surplus in the
+                # idle-lease cache (stash_ok) or return it to the raylet
+                for g in extra:
+                    try:
+                        c = self._get_worker_client(tuple(g["address"]))
+                    except (ConnectionLost, OSError):
+                        self._return_lease(g, lease_raylet)
+                        continue
+                    self._on_worker_idle(sig, g, lease_raylet, c, stash_ok=True)
+                if client is None:
                     continue
-                self._on_worker_idle(sig, lease, lease_raylet, client, stash_ok=False)
                 return
         except Exception as e:  # noqa: BLE001 - fail one waiting spec
             with self._lease_lock:
@@ -1624,7 +1668,7 @@ class CoreWorker:
             client = self._raylet_clients.get(tuple(addr))
             if client is not None and not client.closed:
                 return client
-            client = RpcClient(tuple(addr))
+            client = RpcClient(tuple(addr), prefer_local=True)
             self._raylet_clients[tuple(addr)] = client
             return client
 
@@ -1636,7 +1680,10 @@ class CoreWorker:
             # inline notify: streamed batch-item replies must be handled in
             # frame order ahead of their batch's terminal response
             client = RpcClient(
-                addr, on_notify=self._on_worker_notify, inline_notify=True
+                addr,
+                on_notify=self._on_worker_notify,
+                inline_notify=True,
+                prefer_local=True,
             )
             # serializes mark-template-sent with the frame write so a racing
             # push can never reference a template whose defining frame lost

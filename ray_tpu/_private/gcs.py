@@ -881,7 +881,7 @@ class GcsServer:
             address, worker_id, node_id = info.address, info.worker_id, info.node_id
         if address is not None:
             try:
-                client = RpcClient(address, connect_timeout=2.0)
+                client = RpcClient(address, connect_timeout=2.0, prefer_local=True)
                 client.call("kill_self", None, timeout=2.0)
                 client.close()
             except Exception:
@@ -936,7 +936,7 @@ class GcsServer:
             if client is not None and not client.closed:
                 self._worker_clients.move_to_end(addr)
                 return client
-        client = RpcClient(addr, connect_timeout=5.0)
+        client = RpcClient(addr, connect_timeout=5.0, prefer_local=True)
         with self._lock:
             racer = self._worker_clients.get(addr)
             if racer is not None and not racer.closed:
@@ -971,7 +971,7 @@ class GcsServer:
             client = self._raylet_clients.get(node.node_id)
             if client is not None and not client.closed:
                 return client
-            client = RpcClient(node.address)
+            client = RpcClient(node.address, prefer_local=True)
             client.chaos_identity = self._chaos_identity()
             self._raylet_clients[node.node_id] = client
             return client

@@ -102,6 +102,16 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     # -- rpc ----------------------------------------------------------
     "ray_tpu_rpc_pump_failures": (
         "counter", "native poller pump-thread crashes (streams torn down)", ()),
+    "ray_tpu_rpc_coalesced_frames_total": (
+        "counter",
+        "small outbound frames that left the coalescer as part of a "
+        "multi-frame write (one syscall carrying several logical calls)",
+        ()),
+    "ray_tpu_rpc_local_calls_total": (
+        "counter",
+        "RPCs served over the same-process fast path (no socket; phase "
+        "stats record these under side=local)",
+        ()),
     "ray_tpu_rpc_phase_seconds": (
         "histogram",
         "per-phase RPC latency (client: serialize/send/wire/deserialize/"

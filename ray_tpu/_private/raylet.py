@@ -360,7 +360,9 @@ class Raylet:
         # get_nodes RPC per decision (reference: ray_syncer.h:39 — the
         # NodeResourceInfo downstream half)
         self._peer_view: Dict[str, Any] = {"at": 0.0, "nodes": []}
-        self.gcs = RpcClient(gcs_address, on_notify=self._on_gcs_notify)
+        self.gcs = RpcClient(
+            gcs_address, on_notify=self._on_gcs_notify, prefer_local=True
+        )
         self.gcs.chaos_identity = self._chaos_identity
         self.gcs.call(
             "register_node",
@@ -836,16 +838,22 @@ class Raylet:
                 return self._lease_loop_locked(
                     resources, actor_id, deadline, allow_spill, need_tpu,
                     spill_checked, env_hash, renv,
+                    count=max(1, int(payload.get("count", 1))),
                 )
             finally:
                 self._demand.pop(demand_key, None)
 
     def _lease_loop_locked(
         self, resources, actor_id, deadline, allow_spill, need_tpu,
-        spill_checked, env_hash=(), runtime_env=None,
+        spill_checked, env_hash=(), runtime_env=None, count=1,
     ):
         """The parked-request wait loop; runs with _res_cv held (the caller
-        registered this request in self._demand for heartbeat reporting)."""
+        registered this request in self._demand for heartbeat reporting).
+
+        ``count > 1`` is the grant-ahead window: once the FIRST worker is
+        granted, additional already-idle workers (no waiting, no spawning)
+        are granted in the same reply under ``"extra"`` — a deep task
+        queue pays one lease round-trip per window instead of per task."""
         my_spawned = False  # this request's one in-flight spawn credit
         while not self._stopped.is_set():
             if self._draining:
@@ -871,14 +879,24 @@ class Raylet:
                 else None
             )
             if have_resources and idle is not None:
-                for k, v in effective.items():
-                    self.available[k] = self.available.get(k, 0) - v
-                idle.idle = False
-                idle.lease_resources = dict(effective)
-                if actor_id is not None:
-                    idle.actor_ids.append(actor_id)
-                internal_metrics.inc("ray_tpu_worker_leases_granted_total")
-                return {"worker_id": idle.worker_id, "address": idle.address}
+                grant = self._grant_worker_locked(effective, idle, actor_id)
+                extras = []
+                # pipelined extras: only what is idle RIGHT NOW and only
+                # for plain task leases (an actor binds to exactly one
+                # worker) — never park or spawn for them
+                while actor_id is None and len(extras) < count - 1:
+                    eff = self._expand_pg_request_locked(resources)
+                    if eff is None or not all(
+                        self.available.get(k, 0) >= v for k, v in eff.items()
+                    ):
+                        break
+                    w = self._pop_idle_locked(need_tpu, env_hash)
+                    if w is None:
+                        break
+                    extras.append(self._grant_worker_locked(eff, w, None))
+                if extras:
+                    grant["extra"] = extras
+                return grant
             if have_resources and idle is None:
                 self._reap_dead_locked()
                 spawning = sum(
@@ -951,6 +969,16 @@ class Raylet:
                 return None
             self._res_cv.wait(min(remaining, 0.5))
         return None
+
+    def _grant_worker_locked(self, effective, idle, actor_id):
+        for k, v in effective.items():
+            self.available[k] = self.available.get(k, 0) - v
+        idle.idle = False
+        idle.lease_resources = dict(effective)
+        if actor_id is not None:
+            idle.actor_ids.append(actor_id)
+        internal_metrics.inc("ray_tpu_worker_leases_granted_total")
+        return {"worker_id": idle.worker_id, "address": idle.address}
 
     def _reap_dead_locked(self):
         """Remove workers whose process exited before registering (e.g. the
@@ -1252,45 +1280,53 @@ class Raylet:
         pg_id, index = payload
         victims: List[WorkerHandle] = []
         with self._res_cv:
-            resources = self._prepared_bundles.pop((pg_id, index), None)
-            if resources is not None:
-                for k, v in resources.items():
-                    self.available[k] = self.available.get(k, 0.0) + v
-                self._res_cv.notify_all()
-                return True
-            resources = self._committed_bundles.pop((pg_id, index), None)
-            if resources is None:
-                return False
-            suffix = f"_group_{index}_{pg_id.hex()}"
-            for handle in self._workers.values():
-                if any(k.endswith(suffix) for k in handle.lease_resources):
-                    handle.lease_resources = {}  # disconnect must not re-credit
-                    victims.append(handle)
-            names = self.bundle_resource_names(pg_id, index, resources)
-            for k, v in names.items():
-                parsed = self._parse_bundle_key(k)
-                if parsed is not None and parsed[1] is not None:
-                    # indexed pool: dies with the bundle regardless of leases
-                    self.total_resources.pop(k, None)
-                    self.available.pop(k, None)
-                else:
-                    # wildcard pool: other bundles of the group may remain
-                    self.total_resources[k] = self.total_resources.get(k, 0.0) - v
-                    if self.total_resources.get(k, 0.0) <= 1e-9:
-                        self.total_resources.pop(k, None)
-                        self.available.pop(k, None)
-                    else:
-                        self.available[k] = max(
-                            0.0, self.available.get(k, 0.0) - v
-                        )
-            for k, v in resources.items():
-                self.available[k] = self.available.get(k, 0.0) + v
-            self._res_cv.notify_all()
+            ok, heartbeat = self._return_bundle_locked(pg_id, index, victims)
         for handle in victims:
             if handle.proc is not None and handle.proc.poll() is None:
                 handle.proc.terminate()
-        self._heartbeat_now()
-        return True
+        if heartbeat:
+            self._heartbeat_now()
+        return ok
+
+    def _return_bundle_locked(self, pg_id, index, victims) -> Tuple[bool, bool]:
+        """Release one prepared/committed bundle (``_res_cv`` held).
+        Appends still-leased workers to ``victims`` (killed by the caller,
+        outside the lock) and returns (ok, needs_heartbeat)."""
+        resources = self._prepared_bundles.pop((pg_id, index), None)
+        if resources is not None:
+            for k, v in resources.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+            self._res_cv.notify_all()
+            return True, False
+        resources = self._committed_bundles.pop((pg_id, index), None)
+        if resources is None:
+            return False, False
+        suffix = f"_group_{index}_{pg_id.hex()}"
+        for handle in self._workers.values():
+            if any(k.endswith(suffix) for k in handle.lease_resources):
+                handle.lease_resources = {}  # disconnect must not re-credit
+                victims.append(handle)
+        names = self.bundle_resource_names(pg_id, index, resources)
+        for k, v in names.items():
+            parsed = self._parse_bundle_key(k)
+            if parsed is not None and parsed[1] is not None:
+                # indexed pool: dies with the bundle regardless of leases
+                self.total_resources.pop(k, None)
+                self.available.pop(k, None)
+            else:
+                # wildcard pool: other bundles of the group may remain
+                self.total_resources[k] = self.total_resources.get(k, 0.0) - v
+                if self.total_resources.get(k, 0.0) <= 1e-9:
+                    self.total_resources.pop(k, None)
+                    self.available.pop(k, None)
+                else:
+                    self.available[k] = max(
+                        0.0, self.available.get(k, 0.0) - v
+                    )
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+        self._res_cv.notify_all()
+        return True, True
 
     # Batched bundle RPCs: the GCS groups a placement group's bundles by
     # target raylet and issues ONE prepare/commit/return call per raylet
@@ -1342,10 +1378,23 @@ class Raylet:
         return ok
 
     def rpc_return_bundles(self, conn, payload):
+        """Release several bundles under ONE lock acquisition: victims are
+        terminated in a single pass and one resource heartbeat covers the
+        whole batch (per-bundle lock+heartbeat dominated pg remove)."""
         pg_id, indices = payload
         ok = True
-        for index in indices:
-            ok = self.rpc_return_bundle(conn, (pg_id, index)) and ok
+        heartbeat = False
+        victims: List[WorkerHandle] = []
+        with self._res_cv:
+            for index in indices:
+                one_ok, one_hb = self._return_bundle_locked(pg_id, index, victims)
+                ok = ok and one_ok
+                heartbeat = heartbeat or one_hb
+        for handle in victims:
+            if handle.proc is not None and handle.proc.poll() is None:
+                handle.proc.terminate()
+        if heartbeat:
+            self._heartbeat_now()
         return ok
 
     def _report_store_gauges(self):
@@ -1426,6 +1475,7 @@ class Raylet:
                     self.gcs_address,
                     on_notify=self._on_gcs_notify,
                     connect_timeout=2.0,
+                    prefer_local=True,
                 )
                 new_client.chaos_identity = self._chaos_identity
                 old, self.gcs = self.gcs, new_client
@@ -1546,7 +1596,7 @@ class Raylet:
             client = self._peers.get(addr)
             if client is not None and not client.closed:
                 return client
-            client = RpcClient(addr)
+            client = RpcClient(addr, prefer_local=True)
             client.chaos_identity = self._chaos_identity
             self._peers[addr] = client
             return client

@@ -374,7 +374,140 @@ def _wire_safe_exc(e: BaseException) -> BaseException:
         return RpcError(f"{type(e).__name__}: {e}")
 
 
-class _SendState:
+_coalesced_counter = None
+
+
+def _count_coalesced(n: int) -> None:
+    """Count frames that left in a multi-frame write (n > 1)."""
+    global _coalesced_counter
+    c = _coalesced_counter
+    if c is None:
+        try:
+            from ray_tpu._private import internal_metrics
+
+            c = internal_metrics.bound_counter(
+                "ray_tpu_rpc_coalesced_frames_total"
+            )
+        except Exception:
+            return
+        _coalesced_counter = c
+    c.inc(float(n))
+
+
+_local_call_counter = None
+
+
+def _count_local_call() -> None:
+    global _local_call_counter
+    c = _local_call_counter
+    if c is None:
+        try:
+            from ray_tpu._private import internal_metrics
+
+            c = internal_metrics.bound_counter(
+                "ray_tpu_rpc_local_calls_total"
+            )
+        except Exception:
+            return
+        _local_call_counter = c
+    c.inc(1.0)
+
+
+class _CoalesceMixin:
+    """Nagle-style outbound coalescing shared by both socket senders.
+
+    ``send_lazy`` queues a small single-segment frame instead of writing
+    it; queued frames leave as ONE write (one syscall / one writev) when
+    (a) the next immediate ``send_parts`` drains them ahead of its own
+    frame, (b) queued bytes/frames cross the flush thresholds, or (c) the
+    armed flush job runs on the callback executor — whichever is first.
+    Chaos and retry semantics are untouched: injection decisions happen
+    per logical call at the ``_call_once``/``call_async``/``_on_frame``
+    boundaries ABOVE this layer, and the server decodes each frame of a
+    coalesced write individually."""
+
+    __slots__ = ()
+
+    # a lazy send this close behind the previous one is part of a burst
+    # and worth holding for the batch; an isolated send goes out straight
+    # away (Nagle's immediate-first-packet: no latency tax, and no flusher
+    # wakeup at all, when there is nothing to coalesce with)
+    _BURST_WINDOW_S = 0.0002
+
+    def _init_coalesce(self):
+        self._lazy: list = []
+        self._lazy_bytes = 0
+        self._flush_armed = False
+        self._last_lazy = 0.0
+
+    def send_lazy(self, parts: list):
+        if (
+            len(parts) != 1
+            or not isinstance(parts[0], (bytes, bytearray))
+            or len(parts[0]) > GlobalConfig.rpc_coalesce_max_frame_bytes
+            or not GlobalConfig.rpc_coalesce
+        ):
+            self.send_parts(parts)
+            return
+        now = time.monotonic()
+        with self.lock:
+            burst = now - self._last_lazy < self._BURST_WINDOW_S
+            self._last_lazy = now
+            if not burst and not self._lazy and not self._flush_armed:
+                self._send_parts_locked(parts)
+                return
+            self._lazy.append(parts[0])
+            self._lazy_bytes += len(parts[0])
+            if (
+                self._lazy_bytes >= GlobalConfig.rpc_coalesce_flush_bytes
+                or len(self._lazy) >= GlobalConfig.rpc_coalesce_max_frames
+            ):
+                batch, self._lazy, self._lazy_bytes = self._lazy, [], 0
+                _count_coalesced(len(batch))
+                self._send_parts_locked(batch)
+                return
+            if self._flush_armed:
+                return
+            self._flush_armed = True
+        _get_flusher().submit(self._flush_lazy)
+
+    def _drain_lazy_locked(self, parts: list) -> list:
+        """Prepend queued lazy frames to ``parts`` (called under lock) —
+        every immediate send drains the queue first, so the wire order is
+        exactly the send order."""
+        if not self._lazy:
+            return parts
+        batch, self._lazy, self._lazy_bytes = self._lazy, [], 0
+        _count_coalesced(len(batch) + 1)
+        batch.extend(parts)
+        return batch
+
+    def _flush_lazy(self):
+        try:
+            with self.lock:
+                self._flush_armed = False
+                if not self._lazy:
+                    return
+                batch, self._lazy, self._lazy_bytes = self._lazy, [], 0
+                if len(batch) > 1:
+                    _count_coalesced(len(batch))
+                self._send_parts_locked(batch)
+        except (ConnectionLost, OSError) as e:
+            # no caller to surface this to: tear the stream down the way
+            # the overflow path does, so waiters see ConnectionLost
+            # instead of silence (the _buffer cap path already did both)
+            self._teardown_after_flush_error(e)
+
+    def _teardown_after_flush_error(self, e: Exception):
+        try:
+            self.stream.on_closed(
+                e if isinstance(e, ConnectionLost) else ConnectionLost(str(e))
+            )
+        except Exception:
+            pass
+
+
+class _SendState(_CoalesceMixin):
     """Per-connection outbound state: a lock for frame atomicity plus a
     buffer for bytes the kernel wouldn't take. When the buffer is non-empty
     the poller watches the socket for writability and flushes — senders
@@ -382,19 +515,32 @@ class _SendState:
     stall every connection in the process). A peer that stops draining
     trips the buffer cap and the connection is declared lost."""
 
-    __slots__ = ("lock", "buf", "stream", "sock")
+    __slots__ = ("lock", "buf", "stream", "sock",
+                 "_lazy", "_lazy_bytes", "_flush_armed", "_last_lazy")
 
     def __init__(self, sock: socket.socket, stream: Any):
         self.lock = threading.Lock()
         self.buf = bytearray()
         self.stream = stream  # poller callbacks (on_writable/on_closed)
         self.sock = sock
+        self._init_coalesce()
 
     def send_frame(self, obj: Any):
         self.send_parts(_encode_frame_parts(obj))
 
     def send_parts(self, parts: list):
         with self.lock:
+            self._send_parts_locked(self._drain_lazy_locked(parts))
+
+    def _teardown_after_flush_error(self, e: Exception):
+        _Poller.get().unregister(self.sock)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        super()._teardown_after_flush_error(e)
+
+    def _send_parts_locked(self, parts: list):
             if self.buf:
                 for p in parts:
                     self._buffer(bytes(p) if isinstance(p, memoryview) else p)
@@ -528,24 +674,31 @@ def _encode_frame_parts(obj) -> list:
     return merged
 
 
-class _NativeSendState:
+class _NativeSendState(_CoalesceMixin):
     """Sender backed by the C++ loop: encode the frame, hand the scatter
     list to the extension's sendv (atomic per frame; partial writes are
     buffered in C++ and flushed by the loop on EPOLLOUT). The extension
     takes the buffer protocol directly — out-of-band memoryviews ship with
-    zero copies."""
+    zero copies. Coalesced lazy frames ride ONE sendv call (one writev)."""
 
-    __slots__ = ("_poller", "_cid", "stream")
+    __slots__ = ("_poller", "_cid", "stream", "lock",
+                 "_lazy", "_lazy_bytes", "_flush_armed", "_last_lazy")
 
     def __init__(self, poller: "_NativePoller", cid: int, stream: Any):
         self._poller = poller
         self._cid = cid
         self.stream = stream
+        self.lock = threading.Lock()
+        self._init_coalesce()
 
     def send_frame(self, obj: Any):
         self.send_parts(_encode_frame_parts(obj))
 
     def send_parts(self, parts: list):
+        with self.lock:
+            self._send_parts_locked(self._drain_lazy_locked(parts))
+
+    def _send_parts_locked(self, parts: list):
         rc = self._poller.loop.sendv(self._cid, parts)
         if rc == 0:
             return
@@ -1093,8 +1246,13 @@ class ServerConn:
                 pass  # pool shut down: server is stopping anyway
 
     def notify(self, method: str, payload: Any):
+        # lazy: notifies are latency-tolerant (acks, pubsub pushes) and a
+        # following RESPONSE on the same connection drains them into the
+        # same write — one syscall for ack + reply
         try:
-            self.sender.send_frame((NOTIFY, 0, method, payload))
+            self.sender.send_lazy(
+                _encode_frame_parts((NOTIFY, 0, method, payload))
+            )
         except (ConnectionLost, OSError):
             self.closed.set()
 
@@ -1110,6 +1268,200 @@ class ServerConn:
             self.sock.close()
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# same-process fast path
+# ---------------------------------------------------------------------------
+#
+# In-process clusters (ray_tpu.init) host the driver, GCS, and raylet in
+# one process, so their control RPCs used to pay two syscalls and two
+# poller wakeups to cross a thread boundary. Servers register themselves
+# here by listen address; a client constructed with ``prefer_local=True``
+# that targets a registered server skips the socket entirely — frames are
+# encoded with the normal wire codec (identical restricted-unpickler
+# policy and copy semantics) and delivered straight into the server's
+# dispatch. Chaos rules still apply per logical call: the client-side
+# ``decide("send", ...)`` runs before delivery with the REAL target
+# address (partitions keep matching), and the server-side
+# ``decide("recv", ...)`` runs in ``_on_frame`` exactly as for a socket
+# frame. Phase tracing records these calls under side="local".
+
+_local_servers: Dict[Tuple[str, int], "RpcServer"] = {}
+_local_servers_lock = threading.Lock()
+#: fork guard — a forked/forkserver worker inherits this module's state
+#: but must never dispatch into the parent's server objects
+_local_servers_pid = os.getpid()
+_local_conn_ids = itertools.count(1)
+
+
+def _register_local_server(srv: "RpcServer") -> None:
+    with _local_servers_lock:
+        _local_servers[(srv.host, srv.port)] = srv
+
+
+def _unregister_local_server(srv: "RpcServer") -> None:
+    with _local_servers_lock:
+        key = (srv.host, srv.port)
+        if _local_servers.get(key) is srv:
+            del _local_servers[key]
+
+
+def _local_server_for(address) -> Optional["RpcServer"]:
+    if os.getpid() != _local_servers_pid:
+        return None
+    try:
+        key = (address[0], int(address[1]))
+    except (TypeError, ValueError, IndexError):
+        return None
+    with _local_servers_lock:
+        srv = _local_servers.get(key)
+    if srv is None or srv._stopped.is_set():
+        return None
+    return srv
+
+
+def _iter_local_frames(parts: list):
+    """Split encoded wire parts back into (kind, body memoryview) frames.
+    Single-part frames (every small call) are zero-extra-copy."""
+    if len(parts) == 1:
+        view = memoryview(parts[0])
+    else:
+        view = memoryview(b"".join(
+            p.tobytes() if isinstance(p, memoryview) else bytes(p)
+            for p in parts
+        ))
+    off = 0
+    n = len(view)
+    while off < n:
+        magic, version, kind, length = _HEADER.unpack_from(view, off)
+        if magic != _MAGIC or version != _WIRE_VERSION:
+            raise RpcError(
+                f"bad frame header (magic={magic:#x} version={version})"
+            )
+        end = off + _HEADER.size + length
+        yield kind, view[off + _HEADER.size : end]
+        off = end
+
+
+class _LocalConn(ServerConn):
+    """Server-side view of a same-process client. Reuses ServerConn's
+    ``_on_frame`` (auth gate, chaos recv hook, inline/pool dispatch) with
+    no socket underneath; replies and notifies are delivered back into
+    the client by ``_LocalReplySender``."""
+
+    def __init__(self, server: "RpcServer", client: "RpcClient"):
+        self.sock = None
+        # unmatchable peer key, like a socket conn's ephemeral port —
+        # recv-side chaos rules match on method/identity, not this
+        self.addr = ("local", next(_local_conn_ids))
+        self.closed = threading.Event()
+        # same process == same session: the AUTH handshake is skipped
+        self.meta: Dict[str, Any] = {"authed": True}
+        self._server = server
+        self._frames = None
+        self._poller = None
+        self._client_ref = weakref.ref(client)
+        # serializes frame intake per connection — the role the single
+        # pump thread plays for socket conns (inline handlers and inline
+        # notifies must never run concurrently); reentrant so an inline
+        # handler may reply/notify on its own connection
+        self._inline_lock = threading.RLock()
+        self.sender = _LocalReplySender(self)
+
+    def on_readable(self):  # no socket to read
+        pass
+
+    def close(self):
+        if self.closed.is_set():
+            return
+        client = self._client_ref()
+        err = ConnectionLost("local connection closed")
+        # pops from the server's conn table and fires on_disconnect (the
+        # poller does this for socket conns when the fd dies)
+        self.on_closed(err)
+        if client is not None and getattr(client, "_local_conn", None) is self:
+            client._local_conn = None
+            try:
+                client.on_closed(err)
+            except Exception:
+                pass
+
+
+class _LocalSender:
+    """Client->server half of the fast path: encoded frames are decoded
+    and dispatched in-process. Implements the socket senders' surface
+    (send_frame / send_parts / send_lazy); lazy sends deliver immediately
+    — there is no syscall to coalesce away."""
+
+    __slots__ = ("_conn", "_client_ref")
+
+    def __init__(self, conn: _LocalConn, client: "RpcClient"):
+        self._conn = conn
+        self._client_ref = weakref.ref(client)
+
+    def send_frame(self, obj: Any):
+        self.send_parts(_encode_frame_parts(obj))
+
+    def send_lazy(self, parts: list):
+        self.send_parts(parts)
+
+    def send_parts(self, parts: list):
+        conn = self._conn
+        srv = conn._server
+        if conn.closed.is_set() or srv._stopped.is_set():
+            raise ConnectionLost("local server stopped")
+        try:
+            with conn._inline_lock:
+                for kind, body in _iter_local_frames(parts):
+                    if kind == REQUEST:
+                        _count_local_call()
+                    conn._on_frame(kind, body)
+        except (ConnectionLost, OSError) as e:
+            # auth refusal / chaos disconnect: mirror the socket path,
+            # where the poller tears the server conn down and the client
+            # sees EOF
+            err = (
+                e if isinstance(e, ConnectionLost) else ConnectionLost(str(e))
+            )
+            conn.on_closed(err)
+            client = self._client_ref()
+            if client is not None:
+                try:
+                    client.on_closed(err)
+                except Exception:
+                    pass
+            raise err
+
+
+class _LocalReplySender:
+    """Server->client half: delivers RESPONSE/ERROR/NOTIFY frames into
+    the owning client's ``_on_frame``. Notifies serialize on the conn's
+    intake lock (pump-thread parity for inline_notify consumers);
+    responses only touch the lock-protected slot table."""
+
+    __slots__ = ("_conn",)
+
+    def __init__(self, conn: _LocalConn):
+        self._conn = conn
+
+    def send_frame(self, obj: Any):
+        self.send_parts(_encode_frame_parts(obj))
+
+    def send_lazy(self, parts: list):
+        self.send_parts(parts)
+
+    def send_parts(self, parts: list):
+        conn = self._conn
+        client = conn._client_ref()
+        if client is None or conn.closed.is_set():
+            raise ConnectionLost("local peer gone")
+        for kind, body in _iter_local_frames(parts):
+            if kind == NOTIFY:
+                with conn._inline_lock:
+                    client._on_frame(kind, body)
+            else:
+                client._on_frame(kind, body)
 
 
 class Deferred:
@@ -1195,6 +1547,7 @@ class RpcServer:
         self._conns: Dict[int, ServerConn] = {}
         self._conns_lock = threading.Lock()
         self._stopped = threading.Event()
+        _register_local_server(self)
         self.on_disconnect: Optional[Callable[[ServerConn], None]] = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"{name}-accept", daemon=True
@@ -1334,6 +1687,7 @@ class RpcServer:
 
     def stop(self):
         self._stopped.set()
+        _unregister_local_server(self)
         try:
             self._listener.close()
         except OSError:
@@ -1341,7 +1695,7 @@ class RpcServer:
         with self._conns_lock:
             conns = list(self._conns.values())
         for c in conns:
-            if c._poller is None:
+            if c._poller is None and c.sock is not None:
                 _Poller.get().unregister(c.sock)
             c.close()
         self._pool.shutdown(wait=False)
@@ -1364,8 +1718,16 @@ class RpcClient:
         on_notify: Optional[Callable[[str, Any], None]] = None,
         connect_timeout: Optional[float] = None,
         inline_notify: bool = False,
+        prefer_local: bool = False,
     ):
         self.address = address
+        # opt-in same-process fast path (runtime interconnects set this;
+        # bare test clients keep exercising the real wire). Checked at
+        # every (re)connect, so a server restarting on its well-known
+        # port re-attaches locally and a vanished one falls back to the
+        # socket path.
+        self._prefer_local = prefer_local
+        self._local_conn: Optional[_LocalConn] = None
         # chaos attribution (see RpcServer.chaos_identity): owners set
         # this so partition rules resolve "which side am I on" per client
         self.chaos_identity = None
@@ -1390,6 +1752,21 @@ class RpcClient:
         """Establish (or re-establish) the transport. Fresh socket, frame
         buffer, closed-event and sender each time — the old connection's
         state never bleeds into the new one."""
+        if self._prefer_local and GlobalConfig.rpc_local_fastpath:
+            srv = _local_server_for(self.address)
+            if srv is not None:
+                conn = _LocalConn(srv, self)
+                with srv._conns_lock:
+                    srv._conns[id(conn)] = conn
+                self._local_conn = conn
+                self._sock = None
+                self._poller = None
+                self._frames = None
+                self.sender = _LocalSender(conn, self)
+                self._closed = threading.Event()
+                self._conn_gen += 1
+                return
+        self._local_conn = None
         deadline = time.monotonic() + timeout
         while True:
             try:
@@ -1464,7 +1841,10 @@ class RpcClient:
             p = slot.get("perf")
             if p is not None:
                 try:
-                    _perf.record_client(method, p[0], p[1], p[2], td0, td1)
+                    if self._local_conn is not None:
+                        _perf.record_local(method, p[0], p[1], p[2], td0, td1)
+                    else:
+                        _perf.record_client(method, p[0], p[1], p[2], td0, td1)
                 except Exception:
                     pass  # stats must never kill the poller thread
         if "callback" in slot:
@@ -1676,6 +2056,10 @@ class RpcClient:
             self._pending[msg_id] = slot
 
         def _send():
+            # async requests go out lazily: the caller is not parked on
+            # this reply, so small frames may wait one coalescer tick and
+            # ride a single write with their burst-mates (see
+            # _CoalesceMixin; big frames pass straight through)
             try:
                 if _perf._enabled:
                     t0 = time.monotonic_ns()
@@ -1685,12 +2069,16 @@ class RpcClient:
                         (REQUEST, msg_id, method, payload)
                     )
                     p[1] = time.monotonic_ns() - t0
-                    self.sender.send_parts(parts)
+                    self.sender.send_lazy(parts)
                     p[2] = time.monotonic_ns() - t0 - p[1]
                 else:
-                    self.sender.send_frame((REQUEST, msg_id, method, payload))
+                    self.sender.send_lazy(
+                        _encode_frame_parts((REQUEST, msg_id, method, payload))
+                    )
                 if duplicate:
-                    self.sender.send_frame((REQUEST, msg_id, method, payload))
+                    self.sender.send_lazy(
+                        _encode_frame_parts((REQUEST, msg_id, method, payload))
+                    )
             except (ConnectionLost, OSError) as e:
                 with self._pending_lock:
                     self._pending.pop(msg_id, None)
@@ -1730,14 +2118,23 @@ class RpcClient:
     def _teardown(self, err: ConnectionLost):
         """Tear the current transport down (fails all pending slots) but
         leave the client reconnectable — unlike close()."""
-        try:
-            self._poller.unregister(self._sock)
-        except Exception:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        conn = self._local_conn
+        if conn is not None:
+            self._local_conn = None
+            try:
+                conn.on_closed(err)  # pops srv conn table, disconnect hook
+            except Exception:
+                pass
+        elif self._sock is not None:
+            try:
+                if self._poller is not None:
+                    self._poller.unregister(self._sock)
+            except Exception:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
         if not self._closed.is_set():
             self.on_closed(err)
 
@@ -1750,13 +2147,13 @@ class _CallbackExecutor:
     """Small shared pool that runs RPC completion callbacks off the poller
     thread, so a slow callback can't stall frame demultiplexing."""
 
-    def __init__(self, num_threads: int = 4):
+    def __init__(self, num_threads: int = 4, name: str = "rpc-cb"):
         import queue as _q
 
         self._q: "_q.Queue" = _q.Queue()
         for i in range(num_threads):
             threading.Thread(
-                target=self._loop, name=f"rpc-cb-{i}", daemon=True
+                target=self._loop, name=f"{name}-{i}", daemon=True
             ).start()
 
     def _loop(self):
@@ -1775,6 +2172,7 @@ class _CallbackExecutor:
 
 _callback_executor: Optional[_CallbackExecutor] = None
 _callback_executor_lock = threading.Lock()
+_flusher: Optional[_CallbackExecutor] = None
 
 
 def _get_callback_executor() -> _CallbackExecutor:
@@ -1783,6 +2181,17 @@ def _get_callback_executor() -> _CallbackExecutor:
         if _callback_executor is None:
             _callback_executor = _CallbackExecutor()
         return _callback_executor
+
+
+def _get_flusher() -> _CallbackExecutor:
+    """Single dedicated thread draining armed coalescer queues — the
+    "event-loop tick". Separate from the callback executor so a slow user
+    callback can never delay a pending flush."""
+    global _flusher
+    with _callback_executor_lock:
+        if _flusher is None:
+            _flusher = _CallbackExecutor(num_threads=1, name="rpc-flush")
+        return _flusher
 
 
 # ---------------------------------------------------------------------------

@@ -101,7 +101,7 @@ def init(
             # connect to an existing cluster: ask GCS for a local raylet
             from ray_tpu._private.rpc import RpcClient
 
-            gcs = RpcClient(gcs_address)
+            gcs = RpcClient(gcs_address, prefer_local=True)
             nodes = gcs.call("get_nodes")
             gcs.close()
             if not nodes:

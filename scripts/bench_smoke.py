@@ -1,17 +1,26 @@
 #!/usr/bin/env python3
-"""Per-PR put/get/submit micro-smoke (<60 s) with warn-only floors.
+"""Per-PR control-plane micro-smoke (<90 s) with failing floors.
 
 Runs a tiny slice of bench_core.py's matrix — small put/get, async task
-submission, one large in-place put — and compares each rate against a floor
-derived from the newest archived ``BENCH_CORE_r*.json`` round artifact.
-Floors are deliberately loose (``FLOOR_FRACTION`` of the archived value)
-and violations WARN instead of failing: this runs on shared boxes whose
-steal time can halve any single run, so a hard gate would flap. The point
-is a visible per-PR signal when the put path regresses by integer factors
-(the class of bug this PR's zero-copy rework exists to prevent).
+submission, sync tasks, sync actor calls, placement-group create/remove,
+one large in-place put — and compares each rate against a floor.
 
-Usage: python scripts/bench_smoke.py  (exit code is always 0 unless the
-runtime itself breaks; warnings go to stdout as WARN lines)
+Two tiers of check:
+
+- **Failing floors** (exit 1) for the rows the control-plane hot-path PR
+  claims: tasks_sync, actor_calls_sync, pg_create_remove, put_small.
+  Floors derive from the archived r05 values times ``FAIL_FLOOR_FRACTION``.
+  The fraction is deliberately small (0.10): a same-day control run of
+  unmodified code measured this shared box at ~1/8th of the r05-era
+  recording (fewer vCPUs / heavier tenancy), and single runs still swing
+  >2x on top of that — the gate exists to catch integer-factor
+  regressions in the RPC/lease/PG paths, not box drift. Claimed rows are
+  measured best-of-2 to shave the worst of the noise.
+- **Warn-only floors** for the remaining rows (``FLOOR_FRACTION`` of the
+  newest archived ``BENCH_CORE_r*.json`` round artifact), as before.
+
+Usage: python scripts/bench_smoke.py  (exit 1 when a failing floor is
+violated; warnings go to stdout as WARN lines)
 """
 
 from __future__ import annotations
@@ -28,14 +37,34 @@ sys.path.insert(0, REPO)
 FLOOR_FRACTION = 0.3  # warn below 30% of the archived round value
 CHECKS = ("put_small_per_s", "get_small_per_s", "tasks_async_per_s", "put_gbps")
 
+# hard gate: fraction of the archived r05 value (BENCH_CORE_r05.json) the
+# claimed rows must clear on ANY box state — see module docstring for why
+# the fraction is this small
+FAIL_FLOOR_FRACTION = 0.10
+R05_VALUES = {
+    "tasks_sync_per_s": 2610.97,
+    "actor_calls_sync_per_s": 2477.87,
+    "pg_create_remove_per_s": 887.85,
+    "put_small_per_s": 26070.84,
+}
+
 
 def _load_baseline() -> dict:
-    """Newest round artifact's results (BENCH_CORE_r06.json > r05 > ...)."""
+    """Newest round artifact's results (BENCH_CORE_r07.json > r06 > ...)."""
     rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_CORE_r*.json")))
     if not rounds:
         return {}
     with open(rounds[-1]) as f:
         return json.load(f).get("results", {})
+
+
+def _best_of(rounds: int, n: int, fn) -> float:
+    rates = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        fn(n)
+        rates.append(n / (time.perf_counter() - t0))
+    return max(rates)
 
 
 def main() -> int:
@@ -50,6 +79,15 @@ def main() -> int:
     def _noop():
         return None
 
+    @ray_tpu.remote
+    class _Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
     results = {}
     # warmup keeps this honest without bench_core's full 2000-task ramp
     ray_tpu.get([_noop.remote() for _ in range(200)], timeout=60)
@@ -58,17 +96,49 @@ def main() -> int:
     ray_tpu.get([_noop.remote() for _ in range(1000)], timeout=60)
     results["tasks_async_per_s"] = 1000 / (time.perf_counter() - t0)
 
+    def _tasks_sync(n):
+        for _ in range(n):
+            ray_tpu.get(_noop.remote(), timeout=30)
+
+    results["tasks_sync_per_s"] = _best_of(2, 100, _tasks_sync)
+
+    actor = _Counter.remote()
+    ray_tpu.get(actor.inc.remote(), timeout=30)
+
+    def _actor_sync(n):
+        for _ in range(n):
+            ray_tpu.get(actor.inc.remote(), timeout=30)
+
+    results["actor_calls_sync_per_s"] = _best_of(2, 200, _actor_sync)
+    ray_tpu.kill(actor)
+
     small = np.arange(16)
-    t0 = time.perf_counter()
-    for _ in range(500):
-        ray_tpu.put(small)
-    results["put_small_per_s"] = 500 / (time.perf_counter() - t0)
+
+    def _put_small(n):
+        for _ in range(n):
+            ray_tpu.put(small)
+
+    results["put_small_per_s"] = _best_of(2, 500, _put_small)
 
     ref = ray_tpu.put(small)
     t0 = time.perf_counter()
     for _ in range(500):
         ray_tpu.get(ref, timeout=10)
     results["get_small_per_s"] = 500 / (time.perf_counter() - t0)
+
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    def _pg_cycle(n):
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1.0}])
+            pg.wait(timeout_seconds=10)
+            remove_placement_group(pg)
+
+    _pg_cycle(3)  # warm the PG machinery (cold first cycles are ~10x slower)
+    results["pg_create_remove_per_s"] = _best_of(2, 20, _pg_cycle)
 
     big = np.zeros(16 * 1024 * 1024 // 8)  # 16 MB
     ray_tpu.put(big)  # warm the arena chunks once
@@ -80,8 +150,34 @@ def main() -> int:
 
     ray_tpu.shutdown()
 
+    failed = False
+    for key, r05 in R05_VALUES.items():
+        value = results[key]
+        floor = r05 * FAIL_FLOOR_FRACTION
+        print(
+            json.dumps(
+                {
+                    "metric": key,
+                    "value": round(value, 2),
+                    "fail_floor": round(floor, 2),
+                    "r05": r05,
+                }
+            ),
+            flush=True,
+        )
+        if value < floor:
+            failed = True
+            print(
+                f"FAIL: {key} = {value:.2f} below hard floor {floor:.2f} "
+                f"({FAIL_FLOOR_FRACTION:.0%} of r05 {r05:.2f}) — "
+                "control-plane hot-path regression",
+                flush=True,
+            )
+
     warned = False
     for key in CHECKS:
+        if key in R05_VALUES:
+            continue  # already hard-gated above
         value = results.get(key)
         base = baseline.get(key)
         floor = base * FLOOR_FRACTION if base else None
@@ -99,6 +195,9 @@ def main() -> int:
                 "put-path regression (or shared-box noise; re-run to confirm)",
                 flush=True,
             )
+    if failed:
+        print("bench smoke: FAILING floors violated", flush=True)
+        return 1
     if not warned:
         print("bench smoke: all floors met", flush=True)
     return 0

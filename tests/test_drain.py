@@ -54,6 +54,30 @@ def _await(predicate, timeout, what):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+def _await_running(name, count, timeout=15):
+    """Condition-poll the GCS task-event stream until ``count`` tasks whose
+    name contains ``name`` report RUNNING — replaces the fixed sleeps that
+    made the drain tests flake under load (a 0.4 s nap is not "leased and
+    running" on a contended box)."""
+    from ray_tpu.util.state import list_tasks
+
+    def _running():
+        try:
+            rows = list_tasks()
+        except Exception:
+            return False
+        return (
+            sum(
+                1
+                for r in rows
+                if name in (r.get("name") or "") and r["state"] == "RUNNING"
+            )
+            >= count
+        )
+
+    _await(_running, timeout, f"{count} RUNNING {name} task(s)")
+
+
 def _node_row(cluster, name):
     for n in cluster.list_nodes():
         if n["labels"].get("node_name") == name:
@@ -140,7 +164,7 @@ def test_drain_retires_node_with_zero_reconstructions():
         # a second pin1 task queued at drain time could never re-lease
         # elsewhere (no peer offers pin1)
         slow_ref = slow.remote(0)
-        time.sleep(0.4)  # leased and running on node1
+        _await_running("slow", 1)  # leased and running on node1
 
         reply = ray_tpu.drain_node(node1_hex, deadline_s=20.0)
         assert reply["status"] == "draining"
@@ -227,7 +251,7 @@ def test_node_killed_mid_drain_reconstructs_unmigrated_objects():
             return i
 
         slow_refs = [slow.remote(i) for i in range(2)]
-        time.sleep(0.4)
+        _await_running("slow", 2)
 
         assert ray_tpu.drain_node(node1_hex, deadline_s=30.0)["status"] == (
             "draining"
@@ -292,7 +316,7 @@ def test_draining_node_rejects_new_leases():
             return "held"
 
         hold_ref = hold.remote()
-        time.sleep(0.4)
+        _await_running("hold", 1)
         assert ray_tpu.drain_node(node1_hex, deadline_s=20.0)["status"] == (
             "draining"
         )

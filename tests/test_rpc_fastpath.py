@@ -1,0 +1,315 @@
+"""Coalesced RPC framing + same-process fast path.
+
+The control-plane hot path batches small outbound frames per connection
+(Nagle-style: isolated sends go straight out, burst sends queue and leave
+as one write) and routes same-process calls around the socket entirely.
+Both layers must be invisible to everything above them: chaos
+drop/duplicate/partition rules apply per LOGICAL call (the server decodes
+and fault-injects each frame of a coalesced write individually),
+idempotency-classified retry is untouched, and phase tracing reports
+fast-path calls under side="local" so `perf rpcs` stays honest."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu  # noqa: F401  (registers control classes)
+from ray_tpu._private import fault_injection as fi
+from ray_tpu._private import perf as perf_mod
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.rpc import (
+    ERROR,
+    RESPONSE,
+    ConnectionLost,
+    RpcClient,
+    RpcServer,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    perf_mod.reset_stats()
+    yield
+    fi.disarm()
+    perf_mod.reset_stats()
+
+
+@pytest.fixture
+def recorder_server():
+    srv = RpcServer(name="fastpath-test")
+    state = {"calls": [], "lock": threading.Lock(), "kv": {}}
+
+    def echo(conn, payload):
+        with state["lock"]:
+            state["calls"].append(payload)
+        return payload
+
+    def kv_get(conn, payload):
+        with state["lock"]:
+            state["calls"].append(("kv_get", payload))
+        return state["kv"].get(payload)
+
+    srv.register("echo", echo)
+    srv.register("kv_get", kv_get)
+    yield srv, state
+    srv.stop()
+
+
+def _await(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# same-process fast path
+# ---------------------------------------------------------------------------
+
+
+def test_local_fastpath_skips_socket_and_records_local_side(recorder_server):
+    srv, state = recorder_server
+    client = RpcClient(srv.address, prefer_local=True)
+    try:
+        assert client._local_conn is not None  # registry hit: no socket
+        assert client._sock is None
+        assert client.call("echo", 41, timeout=10) == 41
+        stats = perf_mod.local_rpc_stats()
+        sides = {
+            key.split(".")[0]
+            for rows in stats.values()
+            for key in rows
+        }
+        assert "local" in sides  # perf rpcs stays honest about the path
+        # the wire-side client tables must NOT have claimed this call
+        assert all(
+            not key.startswith("client.")
+            for key in stats.get("echo", {})
+        )
+    finally:
+        client.close()
+
+
+def test_default_client_keeps_the_socket(recorder_server):
+    srv, state = recorder_server
+    client = RpcClient(srv.address)  # no prefer_local: tests the real wire
+    try:
+        assert client._local_conn is None
+        assert client._sock is not None
+        assert client.call("echo", 1, timeout=10) == 1
+    finally:
+        client.close()
+
+
+def test_local_fastpath_async_and_server_stop(recorder_server):
+    srv, state = recorder_server
+    client = RpcClient(srv.address, prefer_local=True)
+    done = threading.Event()
+    out = {}
+
+    def cb(kind, payload):
+        out["kind"], out["payload"] = kind, payload
+        done.set()
+
+    client.call_async("echo", "x", cb)
+    assert done.wait(10)
+    assert out["kind"] == RESPONSE and out["payload"] == "x"
+    srv.stop()
+    with pytest.raises(ConnectionLost):
+        client.call("echo", 1, timeout=5)
+
+
+def test_local_fastpath_chaos_drop_retries_per_logical_call(recorder_server):
+    """Chaos decisions key on the DIALED address, so drop rules hit the
+    fast path exactly as they hit the wire — and idempotent retry still
+    recovers the call."""
+    srv, state = recorder_server
+    client = RpcClient(srv.address, prefer_local=True)
+    try:
+        state["kv"]["k"] = 7
+        fi.arm(
+            {
+                "seed": 0,
+                "rules": [
+                    {"action": "drop", "method": "kv_get", "nth": 1}
+                ],
+            }
+        )
+        t0 = time.monotonic()
+        assert client.call("kv_get", "k", timeout=1.0) == 7
+        assert time.monotonic() - t0 >= 0.9  # first send really dropped
+        assert fi.local_report()["counts"].get("drop") == 1
+    finally:
+        client.close()
+
+
+def test_local_fastpath_partition_by_dialed_address(recorder_server):
+    srv, state = recorder_server
+    host, port = srv.address
+    nodes = [
+        {"node_id": "aa", "node_name": "node-a", "addresses": ["h:1"]},
+        {
+            "node_id": "bb",
+            "node_name": "node-b",
+            "addresses": [f"{host}:{port}"],
+        },
+    ]
+    client = RpcClient(srv.address, prefer_local=True)
+    try:
+        fi.arm(
+            {
+                "seed": 0,
+                "cluster_nodes": nodes,
+                "rules": [
+                    {"action": "partition", "nodes": ["node-a", "node-b"]}
+                ],
+            }
+        )
+        client.chaos_identity = fi.identity_for("aa", "h:1")
+        with pytest.raises((ConnectionLost, TimeoutError)):
+            client.call("echo", 1, timeout=1.0)
+        fi.disarm()
+        assert client.call("echo", 2, timeout=10) == 2  # heals
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# coalesced framing (socket path)
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_burst_completes_in_order(recorder_server):
+    srv, state = recorder_server
+    client = RpcClient(srv.address)
+    n = 200
+    done = threading.Event()
+    replies = []
+    rlock = threading.Lock()
+
+    def cb(kind, payload):
+        with rlock:
+            replies.append((kind, payload))
+            if len(replies) == n:
+                done.set()
+
+    try:
+        for i in range(n):
+            client.call_async("echo", i, cb)
+        assert done.wait(30)
+        assert all(kind == RESPONSE for kind, _ in replies)
+        # server saw every logical call, in send order (immediate sends
+        # drain the lazy queue first, so wire order == send order)
+        assert state["calls"] == list(range(n))
+        from ray_tpu._private import internal_metrics
+
+        snap = internal_metrics.get(
+            "ray_tpu_rpc_coalesced_frames_total"
+        )._snapshot()
+        assert sum(snap["series"].values()) > 0  # burst really shared writes
+    finally:
+        client.close()
+
+
+def test_sync_call_drains_lazy_queue_ahead_of_itself(recorder_server):
+    """A sync call issued right after async sends must not overtake them
+    on the wire."""
+    srv, state = recorder_server
+    client = RpcClient(srv.address)
+    try:
+        for i in range(10):
+            client.call_async("echo", i, lambda kind, payload: None)
+        assert client.call("echo", "sync", timeout=10) == "sync"
+        # every async frame was delivered before the sync frame
+        assert state["calls"][-1] == "sync"
+        assert state["calls"][:-1] == list(range(10))
+    finally:
+        client.close()
+
+
+def test_chaos_duplicate_applies_per_logical_call_on_coalesced_conn(
+    recorder_server,
+):
+    srv, state = recorder_server
+    client = RpcClient(srv.address)
+    n = 50
+    done = threading.Event()
+    count = [0]
+
+    def cb(kind, payload):
+        assert kind == RESPONSE, payload
+        count[0] += 1
+        if count[0] == n:
+            done.set()
+
+    try:
+        fi.arm(
+            {
+                "seed": 0,
+                "rules": [
+                    {"action": "duplicate", "method": "echo", "nth": 5}
+                ],
+            }
+        )
+        for i in range(n):
+            client.call_async("echo", i, cb)
+        assert done.wait(30)  # every logical call still got its reply
+        # exactly ONE call was duplicated — not one per coalesced write
+        assert _await(lambda: len(state["calls"]) == n + 1)
+        assert fi.local_report()["counts"].get("duplicate") == 1
+    finally:
+        client.close()
+
+
+def test_chaos_drop_swallows_one_logical_call_not_the_batch(recorder_server):
+    srv, state = recorder_server
+    client = RpcClient(srv.address)
+    n = 10
+    got = []
+    glock = threading.Lock()
+
+    def cb(kind, payload):
+        if kind == RESPONSE:
+            with glock:
+                got.append(payload)
+
+    try:
+        fi.arm(
+            {
+                "seed": 0,
+                "rules": [{"action": "drop", "method": "echo", "nth": 3}],
+            }
+        )
+        for i in range(n):
+            client.call_async("echo", i, cb)
+        # batchmates of the dropped frame are unaffected
+        assert _await(lambda: len(state["calls"]) == n - 1, timeout=15)
+        expected = [i for i in range(n) if i != 2]  # nth=3 -> third call
+        assert state["calls"] == expected
+        assert _await(lambda: sorted(got) == expected, timeout=15)
+        assert fi.local_report()["counts"].get("drop") == 1
+    finally:
+        client.close()
+
+
+def test_coalescing_respects_max_frame_bytes(recorder_server):
+    """Frames above the coalescer threshold must pass straight through
+    (they are latency-sensitive bulk, not chattiness)."""
+    srv, state = recorder_server
+    client = RpcClient(srv.address)
+    big = b"x" * (GlobalConfig.rpc_coalesce_max_frame_bytes + 1)
+    done = threading.Event()
+
+    def cb(kind, payload):
+        assert kind == RESPONSE, payload
+        done.set()
+
+    try:
+        client.call_async("echo", big, cb)
+        assert done.wait(30)
+        assert state["calls"] == [big]
+    finally:
+        client.close()
