@@ -103,6 +103,63 @@ class _Counter:
         return self.n
 
 
+@ray_tpu.remote(num_cpus=0)
+class _ColRank:
+    """One collective rank joined to both backends (star store vs ring)."""
+
+    def __init__(self, world, rank):
+        from ray_tpu.util import collective as col
+
+        self.col = col
+        self.rank = rank
+        col.init_collective_group(world, rank, backend="host", group_name="bench_st")
+        col.init_collective_group(world, rank, backend="ring", group_name="bench_rg")
+
+    def ready(self):
+        return self.rank
+
+    def _run(self, op, group, x, quantized):
+        if op == "allreduce":
+            return self.col.allreduce(x, group, quantized=quantized)
+        if op == "reducescatter":
+            return self.col.reducescatter(x, group)
+        return self.col.allgather(x, group)
+
+    def bench_op(self, op, group, nelems, iters, quantized=False):
+        rng = np.random.default_rng(self.rank)
+        x = rng.standard_normal(nelems).astype(np.float32)
+        self._run(op, group, x, quantized)  # warmup (group rendezvous etc.)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            self._run(op, group, x, quantized)
+        return time.perf_counter() - t0
+
+    def quantized_error(self, nelems):
+        rng = np.random.default_rng(self.rank)
+        x = rng.standard_normal(nelems).astype(np.float32)
+        exact = self.col.allreduce(x, "bench_st")
+        quant = self.col.allreduce(x, "bench_rg", quantized=True)
+        gmax = self.col.allreduce(
+            np.array([np.abs(x).max()], np.float32), "bench_st", op="max"
+        )
+        return float(np.max(np.abs(quant - exact))), float(gmax[0])
+
+    def bench_sharded_step(self, nelems, steps):
+        from ray_tpu.train.sharded_update import ShardedUpdate
+
+        rng = np.random.default_rng(0)
+        params = rng.standard_normal(nelems).astype(np.float32)
+        upd = ShardedUpdate(
+            params, group_name="bench_rg", optimizer="sgd", lr=0.01, sharded=True
+        )
+        grad = rng.standard_normal(nelems).astype(np.float32)
+        upd.step(grad)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            upd.step(grad)
+        return (time.perf_counter() - t0) / steps
+
+
 def main():
     ray_tpu.init(num_cpus=4, log_level="ERROR")
     results = {}
@@ -246,6 +303,79 @@ def main():
 
     results["pg_create_remove_per_s"] = _bench("pg_create_remove_per_s", 100, pg_cycle)
 
+    # --- collective plane: ring vs star-store backends (world 4, 1 MiB) ---
+    # rows have no REFERENCE entry (nothing comparable in the reference's
+    # microbenchmark table), so they don't move the geomean; the acceptance
+    # bar is ring >= store at this size, recorded in the round artifact
+    from ray_tpu.util.collective import quantization as _quant
+
+    world = 4
+    col_ranks = [_ColRank.remote(world, r) for r in range(world)]
+    ray_tpu.get([r.ready.remote() for r in col_ranks], timeout=120)
+    nelems = 1_048_576  # 4 MiB of fp32 per rank (>= the 1 MiB acceptance bar)
+    nbytes = nelems * 4
+    col_iters = 4
+
+    def _col_row(name, op, group, quantized=False):
+        # best-of-2 (timeshared box) with a GC pause between rounds: the
+        # star backend's exchange results free via async ref GC, and
+        # back-to-back 16 MB rounds can outrun it into arena pressure
+        rates = []
+        for _ in range(2):
+            walls = ray_tpu.get(
+                [r.bench_op.remote(op, group, nelems, col_iters, quantized)
+                 for r in col_ranks],
+                timeout=600,
+            )
+            rates.append(nbytes * col_iters / max(walls) / 1e9)
+            time.sleep(2.0)
+        gbps = max(rates)
+        results[name] = gbps
+        print(json.dumps({"metric": name, "value": round(gbps, 3),
+                          "unit": "GB/s", "vs_baseline": None,
+                          "rounds": [round(r, 3) for r in rates]}), flush=True)
+        return gbps
+
+    _col_row("allreduce_store_gbps", "allreduce", "bench_st")
+    _col_row("allreduce_gbps", "allreduce", "bench_rg")
+    _col_row("reducescatter_store_gbps", "reducescatter", "bench_st")
+    _col_row("reducescatter_gbps", "reducescatter", "bench_rg")
+
+    # quantized allreduce: bandwidth + the accuracy half of the trade
+    _col_row("allreduce_quantized_gbps", "allreduce", "bench_rg", quantized=True)
+    sample = np.random.default_rng(0).standard_normal(nelems).astype(np.float32)
+    ratio = _quant.packed_nbytes(_quant.quantize(sample)) / sample.nbytes
+    results["allreduce_quantized_bytes_ratio"] = ratio
+    errs = ray_tpu.get(
+        [r.quantized_error.remote(nelems) for r in col_ranks], timeout=300
+    )
+    max_err = max(e for e, _ in errs)
+    bound = _quant.allreduce_error_bound(max(g for _, g in errs), world)
+    results["allreduce_quantized_max_err"] = max_err
+    results["allreduce_quantized_err_bound"] = bound
+    print(json.dumps({"metric": "allreduce_quantized_vs_fp32",
+                      "bytes_ratio": round(ratio, 4),
+                      "max_err": round(max_err, 5),
+                      "err_bound": round(bound, 5)}), flush=True)
+
+    # sharded weight update: full RS -> shard step -> AG cycle on 4 MiB
+    walls = ray_tpu.get(
+        [r.bench_sharded_step.remote(1_048_576, 5) for r in col_ranks],
+        timeout=600,
+    )
+    step_ms = max(walls) * 1e3
+    results["sharded_update_step_ms"] = step_ms
+    print(json.dumps({"metric": "sharded_update_step_ms",
+                      "value": round(step_ms, 2), "unit": "ms",
+                      "vs_baseline": None}), flush=True)
+    for r in col_ranks:
+        ray_tpu.kill(r)
+    for gname in ("bench_st", "bench_rg"):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(f"__collective_store__{gname}"))
+        except Exception:
+            pass
+
     # Ray Client analogue: 1:1 sync actor calls through the raytpu:// proxy
     # bridge, measured from a real external client process (ray_perf.py
     # "client: 1:1 actor calls sync", reference 570 calls/s)
@@ -368,10 +498,10 @@ def main():
 
     # archive as a round artifact (reference archives its microbenchmark
     # results under release/release_logs/<version>/microbenchmark.json)
-    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r07.json")
+    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r08.json")
     payload = {
         "results": {
-            k: round(v, 2) if isinstance(v, (int, float)) else v
+            k: round(v, 4) if isinstance(v, (int, float)) else v
             for k, v in results.items()
         },
         "vs_baseline": {

@@ -148,6 +148,15 @@ class _Config:
         "tpu_topology_env": "",  # override detected topology, e.g. "v5e-8"
         # --- train ---
         "train_heartbeat_period_s": 5.0,
+        # --- collectives ---
+        # end-to-end deadline for one collective op (was hardcoded 120 s)
+        "collective_timeout_s": 120.0,
+        # ring-backend groups fall back to the rendezvous actor below this
+        # tensor size: chunking overhead beats the star only once the
+        # payload amortizes the per-chunk put/pull round trips
+        "collective_ring_min_bytes": 64 * 1024,
+        # elements per scale block for quantized allreduce (EQuARX-style)
+        "collective_quantize_block": 256,
     }
 
     def __init__(self):

@@ -375,7 +375,11 @@ class CoreWorker:
         with self._counter_lock:
             self._task_counter += 1
             counter = self._task_counter
-        parent = getattr(self._task_ctx, "task_id", self._current_task_id)
+        # `or` (not getattr default): _run restores task_id to None after a
+        # task, so code running outside a task on a pooled thread — e.g. an
+        # actor constructor submitting to another actor — must still fall
+        # back to the root task id
+        parent = getattr(self._task_ctx, "task_id", None) or self._current_task_id
         if actor_id is not None:
             return TaskID.for_actor_task(self.job_id, parent, counter, actor_id)
         return TaskID.for_normal_task(self.job_id, parent, counter)
@@ -384,7 +388,7 @@ class CoreWorker:
         """Record the parent->child edge for recursive cancellation. TaskIDs
         hash the parent, so parentage is not recoverable from an ID — this
         registry is the explicit edge set, pruned as children complete."""
-        parent = getattr(self._task_ctx, "task_id", self._current_task_id)
+        parent = getattr(self._task_ctx, "task_id", None) or self._current_task_id
         parent_bin = parent.binary()
         spec["_parent_bin"] = parent_bin
         with self._pending_lock:
@@ -411,7 +415,7 @@ class CoreWorker:
         with self._counter_lock:
             self._put_counter += 1
             counter = self._put_counter
-        parent = getattr(self._task_ctx, "task_id", self._current_task_id)
+        parent = getattr(self._task_ctx, "task_id", None) or self._current_task_id
         return ObjectID.from_put(parent, counter)
 
     # ------------------------------------------------------------------

@@ -981,12 +981,13 @@ class GcsServer:
 
         return fi.identity_for("gcs", self.server.address)
 
-    def _schedule_actor(self, info: ActorInfo):
+    def _schedule_actor(self, info: ActorInfo, deadline: Optional[float] = None):
         spec = info.spec
         resources = spec["options"].get("resources_spec", {"CPU": 1.0})
         affinity = spec["options"].get("scheduling_node")
         soft = spec["options"].get("scheduling_soft", False)
-        deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
+        if deadline is None:
+            deadline = time.monotonic() + GlobalConfig.worker_lease_timeout_s * 4
         while time.monotonic() < deadline:
             node = self._pick_node(resources, node_id=affinity)
             if node is None and affinity is not None and soft:
@@ -997,9 +998,6 @@ class GcsServer:
                 with self._lock:
                     self._lock.wait(0.5)
                 continue
-            lease = None
-            client = None
-            worker_addr = None
             try:
                 client = self._raylet_client(node)
                 lease = client.call(
@@ -1015,25 +1013,37 @@ class GcsServer:
                     },
                     timeout=GlobalConfig.worker_lease_timeout_s,
                 )
-                if lease is None or "retry_at" in lease:
-                    time.sleep(0.05)
-                    continue
-                worker_addr = tuple(lease["address"])
-                # pooled connection: a fresh TCP connect + AUTH per actor
-                # was ~2 round-trips of pure overhead in the many_actors
-                # envelope (one create_actor call per worker lifetime is
-                # the common case, but restarts and multi-actor workers
-                # reuse it)
-                wclient = self._worker_client(worker_addr)
-                wclient.call(
-                    "create_actor",
-                    {
-                        "actor_id": info.actor_id,
-                        "spec": spec,
-                        "num_restarts": info.num_restarts,
-                    },
-                    timeout=GlobalConfig.gcs_rpc_timeout_s * 10,
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "actor %s lease attempt failed: %r", info.actor_id.hex()[:8], e
                 )
+                time.sleep(0.2)
+                continue
+            if lease is None or "retry_at" in lease:
+                time.sleep(0.05)
+                continue
+            self._dispatch_actor_creation(info, node, client, lease, deadline)
+            return
+        with self._lock:
+            info.state = DEAD
+            info.death_cause = "scheduling failed: no feasible node in time"
+        self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
+        self._publish("actors", info.public_view())
+
+    def _dispatch_actor_creation(self, info, node, client, lease, deadline):
+        """Send ``create_actor`` and wait for the constructor WITHOUT
+        holding a scheduler-pool thread: the pool is 4 threads on a 1-core
+        box, so four concurrent long-running constructors used to fill it
+        and any creation submitted from INSIDE a constructor (a nested
+        named actor, e.g. a collective rendezvous store) deadlocked
+        behind its own dependents. The constructor wait is a call_async
+        slot; success/failure resumes on the RPC callback executor."""
+        from ray_tpu._private.rpc import ERROR, ConnectionLost, RpcError
+
+        worker_addr = tuple(lease["address"])
+
+        def _done(kind, payload):
+            if kind != ERROR:
                 with self._lock:
                     info.state = ALIVE
                     info.address = worker_addr
@@ -1042,37 +1052,52 @@ class GcsServer:
                 self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
                 self._publish("actors", info.public_view())
                 return
-            except Exception as e:  # noqa: BLE001
-                if worker_addr is not None:
-                    # the pooled connection may be mid-teardown: drop it so
-                    # the retry (or the next actor) dials fresh
-                    self._drop_worker_client(worker_addr)
-                # return the lease so a failed creation doesn't leak resources
-                if lease is not None and client is not None:
-                    try:
-                        client.call("return_worker", {"worker_id": lease["worker_id"]})
-                    except Exception:
-                        pass
-                from ray_tpu._private.rpc import ConnectionLost, RpcError
+            e = payload
+            # the pooled connection may be mid-teardown: drop it so the
+            # retry (or the next actor) dials fresh
+            self._drop_worker_client(worker_addr)
+            # return the lease so a failed creation doesn't leak resources
+            try:
+                client.call("return_worker", {"worker_id": lease["worker_id"]})
+            except Exception:
+                pass
+            if not isinstance(e, (ConnectionLost, TimeoutError, OSError, RpcError)):
+                # the actor constructor itself raised: surface the real
+                # error instead of retrying (the user's bug won't go away)
+                with self._lock:
+                    info.state = DEAD
+                    info.death_cause = f"actor constructor failed: {e!r}"
+                self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
+                self._publish("actors", info.public_view())
+                return
+            logger.warning(
+                "actor %s scheduling attempt failed: %r", info.actor_id.hex()[:8], e
+            )
+            try:
+                self._actor_sched_pool.submit(self._reschedule_after, info, deadline)
+            except RuntimeError:
+                pass  # pool shut down mid-teardown
 
-                if not isinstance(e, (ConnectionLost, TimeoutError, OSError, RpcError)):
-                    # the actor constructor itself raised: surface the real
-                    # error instead of retrying (the user's bug won't go away)
-                    with self._lock:
-                        info.state = DEAD
-                        info.death_cause = f"actor constructor failed: {e!r}"
-                    self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
-                    self._publish("actors", info.public_view())
-                    return
-                logger.warning(
-                    "actor %s scheduling attempt failed: %r", info.actor_id.hex()[:8], e
-                )
-                time.sleep(0.2)
-        with self._lock:
-            info.state = DEAD
-            info.death_cause = "scheduling failed: no feasible node in time"
-        self._publish(f"actor:{info.actor_id.hex()}", info.public_view())
-        self._publish("actors", info.public_view())
+        try:
+            # pooled connection: a fresh TCP connect + AUTH per actor was
+            # ~2 round-trips of pure overhead in the many_actors envelope
+            wclient = self._worker_client(worker_addr)
+            wclient.call_async(
+                "create_actor",
+                {
+                    "actor_id": info.actor_id,
+                    "spec": info.spec,
+                    "num_restarts": info.num_restarts,
+                },
+                _done,
+                timeout=GlobalConfig.gcs_rpc_timeout_s * 10,
+            )
+        except Exception as e:  # noqa: BLE001
+            _done(ERROR, e if isinstance(e, Exception) else ConnectionLost(str(e)))
+
+    def _reschedule_after(self, info, deadline):
+        time.sleep(0.2)
+        self._schedule_actor(info, deadline)
 
     def rpc_report_worker_death(self, conn, payload):
         """Raylet tells us a worker died; restart or mark-dead its actors

@@ -85,6 +85,19 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "histogram", "collective op wall time (rendezvous round trip)", ("op",)),
     "ray_tpu_collective_duty_cycle": (
         "gauge", "fraction of the last step spent inside collectives", ()),
+    "ray_tpu_collective_ring_chunks_total": (
+        "counter", "shard chunks sealed by the ring backend", ("op",)),
+    "ray_tpu_collective_chunk_retries_total": (
+        "counter", "ring chunk pulls retried (peer not sealed yet / drop)",
+        ("op",)),
+    "ray_tpu_collective_throughput_gbps": (
+        "gauge", "wire throughput of the last collective op", ("op", "backend")),
+    "ray_tpu_collective_quantized_bytes_total": (
+        "counter", "quantized payload bytes moved by collectives", ("op",)),
+    "ray_tpu_train_sharded_update_seconds": (
+        "histogram", "sharded weight-update phase wall time", ("phase",)),
+    "ray_tpu_train_optimizer_state_bytes": (
+        "gauge", "per-rank optimizer state footprint", ("mode",)),
     # -- serve --------------------------------------------------------
     "ray_tpu_serve_requests_total": (
         "counter", "requests handled by replicas", ("deployment",)),

@@ -28,6 +28,7 @@ from ray_tpu.train.gbdt_trainer import (
     XGBoostTrainer,
 )
 from ray_tpu.train.result import Result
+from ray_tpu.train.sharded_update import ShardedUpdate
 from ray_tpu.train.tensorflow_trainer import (
     TensorflowTrainer,
     prepare_dataset_shard,
@@ -76,6 +77,7 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "ShardedUpdate",
     "TrainingFailedError",
     "WorkerGroup",
     "get_checkpoint",

@@ -73,10 +73,18 @@ class BackendExecutor:
         scaling: ScalingConfig,
         backend: Optional[JaxConfig] = None,
         collective_group: str = "train",
+        sharded_update: bool = False,
+        collective_backend: Optional[str] = None,
     ):
         self.scaling = scaling
         self.backend = backend or JaxConfig()
         self.collective_group = collective_group
+        self.sharded_update = sharded_update
+        # sharded updates want the ring plane (shard-chunk RS/AG beats the
+        # star actor on exactly the large flat tensors they move)
+        self.collective_backend = collective_backend or (
+            "ring" if sharded_update else "host"
+        )
         self.group: Optional[WorkerGroup] = None
         self._pg = None
 
@@ -116,7 +124,10 @@ class BackendExecutor:
         # join every rank to the host collective group (unique per run so
         # restarts don't collide with a stale rendezvous actor)
         group_name = f"{self.collective_group}-{time.monotonic_ns()}"
-        self.group.execute("setup_collective", group_name, timeout=120.0)
+        self.group.execute(
+            "setup_collective", group_name, self.collective_backend,
+            self.sharded_update, timeout=120.0,
+        )
         self.active_collective_group = group_name
         if getattr(self.backend, "tf_config", False):
             # every rank needs its OWN serving address (tf multi-worker),

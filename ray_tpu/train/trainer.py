@@ -89,6 +89,7 @@ class DataParallelTrainer(BaseTrainer):
         train_loop_config: Optional[Dict[str, Any]] = None,
         backend_config: Optional[JaxConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        sharded_update: bool = False,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -96,6 +97,10 @@ class DataParallelTrainer(BaseTrainer):
         self.train_loop_config = train_loop_config or {}
         self.backend_config = backend_config
         self.datasets = datasets or {}
+        # opt-in cross-replica sharding of the weight update: workers get
+        # a ring collective group + env defaults so ShardedUpdate shards
+        # optimizer state 1/N per rank (see train/sharded_update.py)
+        self.sharded_update = sharded_update
 
     # -- dataset sharding -------------------------------------------------
 
@@ -128,7 +133,11 @@ class DataParallelTrainer(BaseTrainer):
         attempt = 0
         while True:
             attempt += 1
-            executor = BackendExecutor(self.scaling_config, self.backend_config)
+            executor = BackendExecutor(
+                self.scaling_config,
+                self.backend_config,
+                sharded_update=self.sharded_update,
+            )
             error: Optional[BaseException] = None
             try:
                 executor.start()

@@ -82,14 +82,24 @@ class TrainWorker:
         )
         return True
 
-    def setup_collective(self, group_name: str) -> bool:
+    def setup_collective(
+        self,
+        group_name: str,
+        backend: str = "host",
+        sharded_update: bool = False,
+    ) -> bool:
         """Join the gang's host collective group (the DDP-equivalent plane
-        for host tensors; device tensors use in-program XLA collectives)."""
+        for host tensors; device tensors use in-program XLA collectives).
+        The env exports are what ``ShardedUpdate`` reads for its defaults,
+        so a user loop needs no plumbing beyond ``sharded_update=True`` on
+        the trainer."""
         from ray_tpu.util import collective
 
+        os.environ["RAYTPU_TRAIN_COLLECTIVE_GROUP"] = group_name
+        os.environ["RAYTPU_TRAIN_SHARDED_UPDATE"] = "1" if sharded_update else "0"
         if not collective.is_group_initialized(group_name):
             collective.init_collective_group(
-                self.world_size, self.rank, backend="host", group_name=group_name
+                self.world_size, self.rank, backend=backend, group_name=group_name
             )
         return True
 

@@ -1,6 +1,7 @@
-"""Collective communication for actors/tasks (host + xla backends)."""
+"""Collective communication for actors/tasks (host + ring + xla backends)."""
 
 from ray_tpu.util.collective.collective import (
+    CollectiveTimeoutError,
     ReduceOp,
     allgather,
     allreduce,
@@ -16,8 +17,10 @@ from ray_tpu.util.collective.collective import (
     reducescatter,
     send,
 )
+from ray_tpu.util.collective import quantization
 
 __all__ = [
+    "CollectiveTimeoutError",
     "ReduceOp",
     "allgather",
     "allreduce",
@@ -29,6 +32,7 @@ __all__ = [
     "get_rank",
     "init_collective_group",
     "is_group_initialized",
+    "quantization",
     "recv",
     "reducescatter",
     "send",

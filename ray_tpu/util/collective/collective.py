@@ -9,15 +9,29 @@ send:531, recv:594), with TPU-first backends instead of NCCL/GLOO:
   through a rendezvous actor backed by the shared-memory object plane. This
   is the control-plane path — weight broadcast to rollout workers, metric
   reduction, small-tensor sync — the role GLOO plays in the reference.
+- ``"ring"``: peer-to-peer ring collectives over the zero-copy object
+  plane (``ring.py``): reduce-scatter / all-gather / allreduce exchange
+  shard-sized chunks between ring neighbours through plasma — no actor in
+  the data path, ``(N-1)/N`` of the star backend's wire bytes. Tensors
+  below ``collective_ring_min_bytes`` (and ops with no ring form, like
+  broadcast/barrier/send/recv) still ride the rendezvous actor, which
+  every ring group keeps as its control plane and fallback.
 - ``"xla"``: device tensors inside an SPMD program do NOT use this API at
   all: jitted code already contains psum/all_gather/ppermute over ICI via
   pjit/shard_map (see ray_tpu.parallel). The "xla" backend exists for
   host-driven device arrays: it stages through host memory and device_puts
   the result back, preserving shardings where possible.
 
+``allreduce(..., quantized=True)`` trades bounded error for bandwidth:
+block-wise int8 with per-block fp32 scales and fp32 accumulation
+(EQuARX-style; see ``quantization.py`` for the documented error bound).
+
 Every rank must call each collective in the same order (the usual SPMD
 contract); operations are matched by a per-group monotonically increasing
-sequence number.
+sequence number. Op deadlines come from ``collective_timeout_s``
+(``RAYTPU_COLLECTIVE_TIMEOUT_S``) unless a per-call ``timeout`` is given;
+a missed deadline raises :class:`CollectiveTimeoutError` naming the
+group/op/rank/seq instead of a bare get-timeout.
 """
 
 from __future__ import annotations
@@ -27,6 +41,11 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu.util.collective import quantization
+from ray_tpu.util.collective.ring import CollectiveTimeoutError, RingTransport
+from ray_tpu.util.collective import ring as ring_mod
 
 
 class ReduceOp:
@@ -53,6 +72,7 @@ class _Group:
         self.store = store  # ActorHandle of the rendezvous actor
         self.seq = 0
         self.p2p_seq: Dict[tuple, int] = {}
+        self.ring: Optional[RingTransport] = None
 
     def next_seq(self) -> int:
         self.seq += 1
@@ -63,6 +83,11 @@ class _Group:
         self.p2p_seq[key] = self.p2p_seq.get(key, 0) + 1
         return self.p2p_seq[key]
 
+    def ring_transport(self) -> RingTransport:
+        if self.ring is None:
+            self.ring = RingTransport(self)
+        return self.ring
+
 
 _groups: Dict[str, _Group] = {}
 _groups_lock = threading.Lock()
@@ -72,7 +97,11 @@ def _store_actor_name(group_name: str) -> str:
     return f"__collective_store__{group_name}"
 
 
-def _get_or_create_store(group_name: str, world_size: int):
+def _get_or_create_store(group_name: str, world_size: int, create: bool = True):
+    """``create=False`` ranks only poll for the named actor: when every
+    rank raced to create it, ≥4 concurrent losers flooded the actor
+    manager with doomed name-conflict creations and the group never came
+    up — rank 0 (or the driver) is the sole creator."""
     import ray_tpu
     from ray_tpu.util.collective.store import CollectiveStore
 
@@ -91,6 +120,9 @@ def _get_or_create_store(group_name: str, world_size: int):
             return handle
         except ValueError:
             pass
+        if not create:
+            time.sleep(0.05)
+            continue
         try:
             handle = (
                 ray_tpu.remote(CollectiveStore)
@@ -102,7 +134,10 @@ def _get_or_create_store(group_name: str, world_size: int):
             return handle
         except Exception:
             time.sleep(0.05)
-    raise TimeoutError(f"could not create collective store for {group_name!r}")
+    raise TimeoutError(
+        f"could not {'create' if create else 'find'} collective store for "
+        f"{group_name!r}"
+    )
 
 
 def init_collective_group(
@@ -112,14 +147,23 @@ def init_collective_group(
     group_name: str = "default",
 ) -> None:
     """Join this process to a named collective group (call once per rank)."""
-    if backend not in ("host", "xla"):
-        raise ValueError(f"unknown backend {backend!r}; use 'host' or 'xla'")
+    if backend not in ("host", "xla", "ring"):
+        raise ValueError(
+            f"unknown backend {backend!r}; use 'host', 'ring' or 'xla'"
+        )
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    if backend == "ring" and not ring_mod.available():
+        # fail at init, not mid-op: a group where some ranks ring and
+        # others can't would deadlock on its first large collective
+        raise RuntimeError(
+            "backend='ring' needs a plasma-attached worker in this process "
+            "(driver without a local object store?); use backend='host'"
+        )
     with _groups_lock:
         if group_name in _groups:
             raise RuntimeError(f"group {group_name!r} already initialized here")
-    store = _get_or_create_store(group_name, world_size)
+    store = _get_or_create_store(group_name, world_size, create=(rank == 0))
     with _groups_lock:
         _groups[group_name] = _Group(group_name, world_size, rank, backend, store)
 
@@ -144,7 +188,11 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
     with _groups_lock:
         group = _groups.pop(group_name, None)
-    if group is not None and group.rank == 0:
+    if group is None:
+        return
+    if group.ring is not None:
+        group.ring.close()
+    if group.rank == 0:
         try:
             ray_tpu.kill(group.store)
         except Exception:
@@ -203,30 +251,71 @@ def _to_host(tensor: Any):
     return value, lambda out: out
 
 
+# ---------------------------------------------------------------------------
+# timeouts / metrics / dispatch
+# ---------------------------------------------------------------------------
+
+
+def _resolve_timeout(timeout: Optional[float]) -> float:
+    if timeout is not None:
+        return float(timeout)
+    return float(GlobalConfig.collective_timeout_s)
+
+
+def _is_timeout(exc: BaseException) -> bool:
+    from ray_tpu._private.core_worker import TaskError
+
+    if isinstance(exc, TimeoutError):
+        return True
+    return isinstance(exc, TaskError) and isinstance(exc.cause, TimeoutError)
+
+
+def _timeout_error(
+    group: _Group, op: str, seq: int, timeout: float, cause: BaseException
+) -> CollectiveTimeoutError:
+    return CollectiveTimeoutError(
+        f"collective {op!r} on group {group.name!r} timed out after "
+        f"{timeout:.1f}s at rank {group.rank} (world {group.world_size}, "
+        f"seq {seq}): {cause}"
+    )
+
+
 # duty-cycle state: when the previous collective on this process finished
 _last_collective_end = 0.0
 
 
-def _exchange(group: _Group, tag: str, value: np.ndarray) -> List[np.ndarray]:
-    """All ranks contribute; returns the full list ordered by rank."""
+def _record(
+    group: _Group,
+    op: str,
+    logical_bytes: int,
+    dt: float,
+    backend: str,
+    moved_bytes: Optional[int] = None,
+    quantized_bytes: int = 0,
+) -> None:
     global _last_collective_end
-    import ray_tpu
     from ray_tpu._private import internal_metrics
 
-    key = f"{group.name}:{tag}:{group.next_seq()}"
-    t0 = time.perf_counter()
-    gathered = ray_tpu.get(
-        group.store.exchange.remote(key, group.rank, value),
-        timeout=120.0,
-    )
-    dt = time.perf_counter() - t0
-    internal_metrics.inc("ray_tpu_collective_ops_total", tags={"op": tag})
+    internal_metrics.inc("ray_tpu_collective_ops_total", tags={"op": op})
     internal_metrics.inc(
-        "ray_tpu_collective_bytes_total", float(value.nbytes), tags={"op": tag}
+        "ray_tpu_collective_bytes_total", float(logical_bytes), tags={"op": op}
     )
     internal_metrics.observe(
-        "ray_tpu_collective_latency_seconds", dt, tags={"op": tag}
+        "ray_tpu_collective_latency_seconds", dt, tags={"op": op}
     )
+    if dt > 0:
+        internal_metrics.set_gauge(
+            "ray_tpu_collective_throughput_gbps",
+            (moved_bytes if moved_bytes is not None else logical_bytes)
+            * 8.0 / dt / 1e9,
+            tags={"op": op, "backend": backend},
+        )
+    if quantized_bytes:
+        internal_metrics.inc(
+            "ray_tpu_collective_quantized_bytes_total",
+            float(quantized_bytes),
+            tags={"op": op},
+        )
     now = time.monotonic()
     gap = now - _last_collective_end
     _last_collective_end = now
@@ -234,7 +323,38 @@ def _exchange(group: _Group, tag: str, value: np.ndarray) -> List[np.ndarray]:
         internal_metrics.set_gauge(
             "ray_tpu_collective_duty_cycle", min(1.0, dt / gap)
         )
-    return gathered
+
+
+def _use_ring(group: _Group, value: np.ndarray) -> bool:
+    """Identical on every rank by the SPMD contract (backend and world are
+    group-wide; nbytes matches because collective shapes must)."""
+    return (
+        group.backend == "ring"
+        and group.world_size > 1
+        and value.nbytes >= int(GlobalConfig.collective_ring_min_bytes)
+    )
+
+
+def _exchange(
+    group: _Group, tag: str, value: Any, timeout: Optional[float] = None
+) -> List[Any]:
+    """All ranks contribute; returns the full list ordered by rank."""
+    import ray_tpu
+
+    timeout = _resolve_timeout(timeout)
+    seq = group.next_seq()
+    key = f"{group.name}:{tag}:{seq}"
+    try:
+        return ray_tpu.get(
+            # the store's internal deadline is shorter than ours so ITS
+            # error (with arrival counts) reaches us, not a bare timeout
+            group.store.exchange.remote(key, group.rank, value, timeout * 0.75),
+            timeout=timeout,
+        )
+    except Exception as exc:
+        if _is_timeout(exc):
+            raise _timeout_error(group, tag, seq, timeout, exc) from exc
+        raise
 
 
 # ---------------------------------------------------------------------------
@@ -242,66 +362,188 @@ def _exchange(group: _Group, tag: str, value: np.ndarray) -> List[np.ndarray]:
 # ---------------------------------------------------------------------------
 
 
-def allreduce(tensor: Any, group_name: str = "default", op: str = ReduceOp.SUM):
+def allreduce(
+    tensor: Any,
+    group_name: str = "default",
+    op: str = ReduceOp.SUM,
+    quantized: bool = False,
+    timeout: Optional[float] = None,
+):
+    """Reduce ``tensor`` across all ranks; every rank gets the full result.
+
+    ``quantized=True`` moves block-int8 + per-block scales instead of raw
+    elements (~4x fewer wire bytes for fp32) with fp32 accumulation; the
+    absolute error is bounded by ``quantization.allreduce_error_bound``.
+    """
     group = _get_group(group_name)
+    resolved = _resolve_timeout(timeout)
     value, restore = _to_host(tensor)
-    parts = _exchange(group, "allreduce", value)
+    t0 = time.perf_counter()
+    if _use_ring(group, value):
+        rt = group.ring_transport()
+        out = rt.allreduce(value, op, resolved, quantized=quantized)
+        dt = time.perf_counter() - t0
+        _record(
+            group, "allreduce", value.nbytes, dt, "ring",
+            moved_bytes=rt.last_bytes_moved,
+            quantized_bytes=rt.last_bytes_moved if quantized else 0,
+        )
+        return restore(out.astype(value.dtype, copy=False))
+    if quantized:
+        block = int(GlobalConfig.collective_quantize_block)
+        packed = quantization.quantize(value, block)
+        parts = _exchange(group, "allreduce", packed, timeout)
+        stacked = np.stack([quantization.dequantize(p) for p in parts])
+        out = _REDUCERS[op](stacked)
+        dt = time.perf_counter() - t0
+        _record(
+            group, "allreduce", value.nbytes, dt, group.backend,
+            moved_bytes=quantization.packed_nbytes(packed) * group.world_size,
+            quantized_bytes=quantization.packed_nbytes(packed),
+        )
+        return restore(out.astype(value.dtype, copy=False))
+    parts = _exchange(group, "allreduce", value, timeout)
     out = _REDUCERS[op](np.stack(parts))
+    dt = time.perf_counter() - t0
+    _record(group, "allreduce", value.nbytes, dt, group.backend)
     return restore(out.astype(value.dtype, copy=False))
 
 
-def allgather(tensor: Any, group_name: str = "default") -> List[Any]:
+def allgather(
+    tensor: Any, group_name: str = "default", timeout: Optional[float] = None
+) -> List[Any]:
     group = _get_group(group_name)
+    resolved = _resolve_timeout(timeout)
     value, restore = _to_host(tensor)
-    parts = _exchange(group, "allgather", value)
+    t0 = time.perf_counter()
+    if _use_ring(group, value):
+        rt = group.ring_transport()
+        parts = rt.allgather(value, resolved)
+        _record(
+            group, "allgather", value.nbytes, time.perf_counter() - t0,
+            "ring", moved_bytes=rt.last_bytes_moved,
+        )
+        return [restore(p) for p in parts]
+    parts = _exchange(group, "allgather", value, timeout)
+    _record(group, "allgather", value.nbytes, time.perf_counter() - t0,
+            group.backend)
     return [restore(p) for p in parts]
 
 
-def reducescatter(tensor: Any, group_name: str = "default", op: str = ReduceOp.SUM):
+def reducescatter(
+    tensor: Any,
+    group_name: str = "default",
+    op: str = ReduceOp.SUM,
+    timeout: Optional[float] = None,
+):
     """Reduce across ranks, then each rank keeps its 1/world_size shard along
     axis 0 (tensor's leading dim must divide evenly)."""
     group = _get_group(group_name)
+    resolved = _resolve_timeout(timeout)
     value, restore = _to_host(tensor)
     if value.shape[0] % group.world_size != 0:
         raise ValueError(
             f"leading dim {value.shape[0]} not divisible by world {group.world_size}"
         )
-    parts = _exchange(group, "reducescatter", value)
+    t0 = time.perf_counter()
+    if _use_ring(group, value):
+        rt = group.ring_transport()
+        chunks = np.split(value, group.world_size, axis=0)
+        shard = rt.reducescatter(chunks, op, resolved)
+        _record(
+            group, "reducescatter", value.nbytes, time.perf_counter() - t0,
+            "ring", moved_bytes=rt.last_bytes_moved,
+        )
+        return restore(shard.astype(value.dtype, copy=False))
+    parts = _exchange(group, "reducescatter", value, timeout)
     reduced = _REDUCERS[op](np.stack(parts))
     shard = np.split(reduced, group.world_size, axis=0)[group.rank]
+    _record(group, "reducescatter", value.nbytes, time.perf_counter() - t0,
+            group.backend)
     return restore(shard.astype(value.dtype, copy=False))
 
 
-def broadcast(tensor: Any, src_rank: int = 0, group_name: str = "default"):
-    group = _get_group(group_name)
-    value, restore = _to_host(tensor)
-    if group.rank == src_rank:
-        parts = _exchange(group, "broadcast", value)
-        return restore(value)
-    # non-src contributes a placeholder and takes the src's tensor
-    parts = _exchange(group, "broadcast", np.zeros(0, dtype=np.uint8))
-    return restore(parts[src_rank])
-
-
-def barrier(group_name: str = "default") -> None:
-    group = _get_group(group_name)
-    _exchange(group, "barrier", np.zeros(0, dtype=np.uint8))
-
-
-def send(tensor: Any, dst_rank: int, group_name: str = "default") -> None:
+def broadcast(
+    tensor: Any,
+    src_rank: int = 0,
+    group_name: str = "default",
+    timeout: Optional[float] = None,
+):
+    """src puts its tensor ONCE; every other rank fetches it — no
+    placeholder contributions, no N-way exchange of one tensor."""
     import ray_tpu
 
     group = _get_group(group_name)
+    timeout = _resolve_timeout(timeout)
+    value, restore = _to_host(tensor)
+    seq = group.next_seq()
+    key = f"{group.name}:broadcast:{seq}"
+    if group.world_size == 1:
+        return restore(value)
+    t0 = time.perf_counter()
+    try:
+        if group.rank == src_rank:
+            ray_tpu.get(
+                group.store.put_bcast.remote(key, value, group.world_size - 1),
+                timeout=timeout,
+            )
+            out = value
+        else:
+            out = ray_tpu.get(
+                group.store.take_bcast.remote(key, timeout * 0.75),
+                timeout=timeout,
+            )
+    except Exception as exc:
+        if _is_timeout(exc):
+            raise _timeout_error(group, "broadcast", seq, timeout, exc) from exc
+        raise
+    _record(group, "broadcast", value.nbytes, time.perf_counter() - t0,
+            group.backend)
+    return restore(out)
+
+
+def barrier(group_name: str = "default", timeout: Optional[float] = None) -> None:
+    group = _get_group(group_name)
+    _exchange(group, "barrier", np.zeros(0, dtype=np.uint8), timeout)
+
+
+def send(
+    tensor: Any,
+    dst_rank: int,
+    group_name: str = "default",
+    timeout: Optional[float] = None,
+) -> None:
+    import ray_tpu
+
+    group = _get_group(group_name)
+    timeout = _resolve_timeout(timeout)
     value, _ = _to_host(tensor)
     seq = group.next_p2p_seq(group.rank, dst_rank)
     key = f"{group.name}:p2p:{group.rank}->{dst_rank}:{seq}"
-    ray_tpu.get(group.store.put_one.remote(key, value), timeout=120.0)
+    try:
+        ray_tpu.get(group.store.put_one.remote(key, value), timeout=timeout)
+    except Exception as exc:
+        if _is_timeout(exc):
+            raise _timeout_error(group, "send", seq, timeout, exc) from exc
+        raise
 
 
-def recv(src_rank: int, group_name: str = "default"):
+def recv(
+    src_rank: int,
+    group_name: str = "default",
+    timeout: Optional[float] = None,
+):
     import ray_tpu
 
     group = _get_group(group_name)
+    timeout = _resolve_timeout(timeout)
     seq = group.next_p2p_seq(src_rank, group.rank)
     key = f"{group.name}:p2p:{src_rank}->{group.rank}:{seq}"
-    return ray_tpu.get(group.store.take_one.remote(key), timeout=120.0)
+    try:
+        return ray_tpu.get(
+            group.store.take_one.remote(key, timeout * 0.75), timeout=timeout
+        )
+    except Exception as exc:
+        if _is_timeout(exc):
+            raise _timeout_error(group, "recv", seq, timeout, exc) from exc
+        raise

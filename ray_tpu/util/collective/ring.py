@@ -1,0 +1,379 @@
+"""Ring collectives over the zero-copy object plane.
+
+Instead of the star-shaped rendezvous actor (``store.py`` — every rank
+ships its FULL tensor into one process and reads N full tensors back),
+each rank exchanges shard-sized chunks with its ring neighbours directly
+through the plasma object plane: the producer seals a chunk under a
+DETERMINISTIC object id derived from ``(group, seq, op, step, src)`` and
+the consumer — who computes the same id without any coordination — reads
+it from shared memory (same node) or pulls it through the idempotent
+``store_pull`` raylet path (cross node). No actor sits in the data path.
+
+Why deterministic keys: a re-put after a chaos-injected drop no-ops
+(``store_put`` is duplicate-tolerant since PR 4), a re-pull is
+idempotent, and the consumer needs no ref plumbing — so every exchange
+step retries cleanly under the fault plane.
+
+Algorithms (grounded in "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training", PAPERS.md):
+
+- **reduce-scatter**: N-1 steps; at step ``t`` rank ``r`` seals its
+  partial sum for chunk ``(r-t-1) mod N`` and pulls the partial for
+  chunk ``(r-t-2) mod N`` from rank ``r-1`` — after the last step rank
+  ``r`` owns the fully-reduced chunk ``r``. Wire bytes per rank:
+  ``(N-1)/N * T`` instead of the star's ``N * T``.
+- **all-gather**: chunk ``c`` is sealed once by its owner; at step ``t``
+  rank ``r`` pulls chunk ``(r-t-1) mod N`` from its PREDECESSOR'S node.
+  A cross-node pull lands the chunk in the local store under the same
+  id, so the next rank down the ring pulls from there — the classic
+  bandwidth-balanced ring relay, with the relay copy provided for free
+  by the pull itself.
+- **allreduce** = reduce-scatter + all-gather, with optional
+  EQuARX-style block-int8 quantization of every exchanged chunk
+  (fp32 accumulation, ``quantization.py``).
+
+Lifetime: chunk ids are unique per ``(group, seq)``, so completed ops
+must free their objects — but a rank may only delete chunks its
+SUCCESSOR has consumed, and data flows strictly ``r-1 -> r``. Each op
+therefore ends with a tiny ``fin`` token per rank: rank ``r`` blocks on
+``fin(r+1)`` (its consumer) before batch-deleting every object the op
+created or pulled locally. The rank's own ``fin`` is deleted one op
+later — by then the predecessor has provably consumed it (it cannot
+have produced this op's chunks otherwise). The fin wait makes every
+ring op a neighbour barrier, which the SPMD calling contract implies
+anyway.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private import internal_metrics
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.util.collective import quantization
+
+
+class CollectiveTimeoutError(TimeoutError):
+    """A collective op did not complete before its deadline; the message
+    names the group, op, rank, seq (and peer) so a hung gang is
+    attributable without packet archaeology."""
+
+
+_ACCUMULATORS: Dict[str, Callable] = {
+    "sum": np.add,
+    "product": np.multiply,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def _oid(key: str) -> ObjectID:
+    """Deterministic ObjectID: any rank derives the same id from the same
+    (group, seq, op, step, src) key — the coordination-free rendezvous."""
+    return ObjectID(hashlib.sha256(key.encode()).digest()[: ObjectID.SIZE])
+
+
+def _core():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.get_global_worker().core
+
+
+def available() -> bool:
+    """Ring transport needs a plasma-backed worker (client-mode drivers
+    without a local store fall back to the rendezvous actor)."""
+    try:
+        return _core().plasma is not None
+    except Exception:
+        return False
+
+
+class RingTransport:
+    """Per-group chunk-exchange plane; lazily attached to a ``_Group``."""
+
+    def __init__(self, group):
+        self.group = group  # collective._Group
+        self._addrs: Optional[List[tuple]] = None
+        # own fin tokens awaiting deferred deletion (safe one op later)
+        self._fin_backlog: List[ObjectID] = []
+        #: wire bytes the most recent op put+pulled (throughput metering)
+        self.last_bytes_moved = 0
+
+    # -- membership -----------------------------------------------------
+
+    def addrs(self) -> List[tuple]:
+        """rank -> raylet (host, port), exchanged once through the
+        rendezvous actor (control-plane only; no tensor bytes)."""
+        if self._addrs is None:
+            import ray_tpu
+
+            own = tuple(_core().raylet.address)
+            key = f"{self.group.name}:ring:addrs"
+            gathered = ray_tpu.get(
+                self.group.store.exchange.remote(key, self.group.rank, own),
+                timeout=GlobalConfig.collective_timeout_s,
+            )
+            self._addrs = [tuple(a) for a in gathered]
+        return self._addrs
+
+    def close(self) -> None:
+        """Drop deferred fin tokens (group teardown)."""
+        if self._fin_backlog:
+            try:
+                _core().plasma.delete_batch(self._fin_backlog)
+            except Exception:
+                pass
+            self._fin_backlog = []
+
+    # -- collectives ----------------------------------------------------
+
+    def reducescatter(
+        self,
+        chunks: List[np.ndarray],
+        op: str,
+        timeout: float,
+        quantized: bool = False,
+    ) -> np.ndarray:
+        """``chunks[c]`` is this rank's contribution to chunk ``c``
+        (equal shapes); returns the fully-reduced chunk ``rank``."""
+        ctx = _OpCtx(self, "reducescatter", self.group.next_seq(), timeout)
+        try:
+            out = self._reduce_phase(ctx, chunks, op, quantized)
+            if quantized:
+                out = out.astype(np.float32, copy=False)
+            out = np.array(out, copy=True)  # detach from any plasma view
+        except BaseException:
+            ctx.abort()
+            raise
+        ctx.finish()
+        return out
+
+    def allgather(self, value: np.ndarray, timeout: float) -> List[np.ndarray]:
+        ctx = _OpCtx(self, "allgather", self.group.next_seq(), timeout)
+        try:
+            out = self._gather_phase(ctx, value, quantized=False)
+        except BaseException:
+            ctx.abort()
+            raise
+        ctx.finish()
+        return out
+
+    def allreduce(
+        self,
+        value: np.ndarray,
+        op: str,
+        timeout: float,
+        quantized: bool = False,
+    ) -> np.ndarray:
+        """Reduce-scatter over flat equal chunks, then ring all-gather of
+        the reduced shards; returns the full reduced tensor."""
+        world = self.group.world_size
+        ctx = _OpCtx(self, "allreduce", self.group.next_seq(), timeout)
+        try:
+            flat = np.ascontiguousarray(value).ravel()
+            pad = (-flat.size) % world
+            if pad:
+                flat = np.concatenate([flat, np.zeros(pad, dtype=flat.dtype)])
+            chunks = list(flat.reshape(world, -1))
+            reduced = self._reduce_phase(ctx, chunks, op, quantized)
+            parts = self._gather_phase(ctx, reduced, quantized)
+            out = np.concatenate([np.asarray(p).ravel() for p in parts])
+        except BaseException:
+            ctx.abort()
+            raise
+        ctx.finish()
+        if pad:
+            out = out[: value.size]
+        return out.reshape(value.shape)
+
+    # -- phases ---------------------------------------------------------
+
+    def _reduce_phase(self, ctx, chunks, op, quantized):
+        world, rank = self.group.world_size, self.group.rank
+        acc_fn = _ACCUMULATORS[op]
+        pred = (rank - 1) % world
+        acc = None  # running partial for the chunk received last step
+        if quantized:
+            chunks = [np.asarray(c, dtype=np.float32) for c in chunks]
+        for t in range(world - 1):
+            send_idx = (rank - t - 1) % world
+            recv_idx = (rank - t - 2) % world
+            outgoing = chunks[send_idx] if t == 0 else acc
+            ctx.put(f"rs:{t}:{rank}",
+                    quantization.quantize(outgoing, ctx.qblock)
+                    if quantized else outgoing)
+            incoming = ctx.get(f"rs:{t}:{pred}", src=pred, step=t)
+            if quantized:
+                incoming = quantization.dequantize(incoming)
+            # fresh array each step: never accumulate into a plasma view
+            acc = acc_fn(chunks[recv_idx], incoming)
+        if acc is None:  # world == 1
+            acc = np.array(chunks[rank], copy=True)
+        return acc
+
+    def _gather_phase(self, ctx, value, quantized):
+        world, rank = self.group.world_size, self.group.rank
+        pred = (rank - 1) % world
+        out: List[Any] = [None] * world
+        out[rank] = np.asarray(value)
+        ctx.put(f"ag:{rank}",
+                quantization.quantize(value, ctx.qblock)
+                if quantized else out[rank])
+        for t in range(world - 1):
+            c = (rank - t - 1) % world
+            # pull from the PREDECESSOR's node: its earlier pull (or its
+            # own put) already landed chunk c there — the ring relay
+            got = ctx.get(f"ag:{c}", src=pred, step=t)
+            if quantized:
+                out[c] = quantization.dequantize(got)
+            else:
+                out[c] = np.array(got, copy=True)  # outlives ctx cleanup
+        return out
+
+
+class _OpCtx:
+    """One collective op: tracked puts/gets/pins + end-of-op cleanup."""
+
+    def __init__(self, ring: RingTransport, op: str, seq: int, timeout: float):
+        self.ring = ring
+        self.group = ring.group
+        self.op = op
+        self.seq = seq
+        self.deadline = time.monotonic() + timeout
+        self.timeout = timeout
+        self.qblock = int(GlobalConfig.collective_quantize_block)
+        self.core = _core()
+        self._oids: List[ObjectID] = []   # created or pulled locally
+        self._pinned: List[ObjectID] = []  # store_get pins to release
+        self.bytes_moved = 0
+
+    # -- plumbing -------------------------------------------------------
+
+    def _key(self, subkey: str) -> str:
+        return f"col:{self.group.name}:{self.seq}:{self.op}:{subkey}"
+
+    def put(self, subkey: str, value: Any) -> None:
+        from ray_tpu._private import serialization
+
+        oid = _oid(self._key(subkey))
+        sobj = serialization.serialize(value)
+        self.bytes_moved += sobj.total_size()
+        # duplicate-tolerant: a chaos-retried put of a sealed id no-ops
+        self.core.plasma.put_serialized(oid, sobj)
+        self._oids.append(oid)
+        internal_metrics.inc(
+            "ray_tpu_collective_ring_chunks_total", tags={"op": self.op}
+        )
+
+    def get(self, subkey: str, src: int, step: int = -1) -> Any:
+        """Blocking chunk read: shared-memory when the producer's store is
+        local, idempotent ``store_pull`` relay otherwise. The view stays
+        pinned until ``finish`` so eviction cannot race the op."""
+        from ray_tpu._private import serialization
+
+        oid = _oid(self._key(subkey))
+        plasma = self.core.plasma
+        src_addr = tuple(self.ring.addrs()[src])
+        own_addr = tuple(self.core.raylet.address)
+        retries = 0
+        while True:
+            remaining = self.deadline - time.monotonic()
+            if remaining <= 0:
+                raise CollectiveTimeoutError(
+                    f"collective {self.op!r} on group {self.group.name!r} "
+                    f"timed out after {self.timeout:.1f}s at rank "
+                    f"{self.group.rank} (world {self.group.world_size}, "
+                    f"seq {self.seq}, step {step}): chunk {subkey!r} from "
+                    f"rank {src} ({src_addr}) never arrived "
+                    f"({retries} pull retries)"
+                )
+            if src_addr != own_addr and not plasma.contains(oid):
+                # cross-node: ask our raylet to pull from the producer's
+                # node; False = producer hasn't sealed it yet — retry.
+                # Per-attempt timeout is a FRACTION of the remaining
+                # deadline: a lost pull frame (chaos drop, flaky link)
+                # must leave budget for retries — and a pull that
+                # completed server-side after its response was lost is
+                # found by the contains() re-check, so short attempts
+                # never forfeit transferred bytes
+                try:
+                    ok = self.core.raylet.call(
+                        "store_pull", (oid, src_addr),
+                        timeout=min(max(5.0, remaining / 3.0), 70.0),
+                    )
+                except Exception:
+                    ok = False
+                if not ok:
+                    retries += 1
+                    internal_metrics.inc(
+                        "ray_tpu_collective_chunk_retries_total",
+                        tags={"op": self.op},
+                    )
+                    time.sleep(min(0.02 * retries, 0.25))
+                    continue
+            views = plasma.get_views([oid], timeout=min(remaining, 2.0))
+            if views is None:
+                continue  # seal pending (same-node producer); re-check clock
+            self._pinned.append(oid)
+            self._oids.append(oid)
+            view = views[oid]
+            self.bytes_moved += view.nbytes
+            return serialization.deserialize_from(view)
+
+    # -- cleanup --------------------------------------------------------
+
+    def _release_pins(self) -> None:
+        plasma = self.core.plasma
+        for oid in self._pinned:
+            try:
+                plasma.release(oid)
+            except Exception:
+                pass
+        self._pinned = []
+
+    def abort(self) -> None:
+        """Failed-op cleanup: release pins but delete NOTHING — peers may
+        still be reading chunks this rank sealed; unpinned objects fall to
+        the store's eviction policy instead."""
+        self.ring.last_bytes_moved = self.bytes_moved
+        self._release_pins()
+
+    def finish(self) -> None:
+        """Fin-token neighbour barrier, then free this op's objects."""
+        group, ring = self.group, self.ring
+        world, rank = group.world_size, group.rank
+        plasma = self.core.plasma
+        own_fin = _oid(self._key(f"fin:{rank}"))
+        ring.last_bytes_moved = self.bytes_moved
+        # small grace past the op deadline: the data phase completed, the
+        # fin round trip is tiny and losing it would leak the whole op
+        self.deadline = max(self.deadline, time.monotonic() + 15.0)
+        try:
+            if world > 1:
+                self.put(f"fin:{rank}", b"\x01")
+                self._oids.pop()  # own fin survives this op (deferred)
+                succ = (rank + 1) % world
+                self.get(f"fin:{succ}", src=succ)
+        except BaseException:
+            self._release_pins()  # no delete: successor may still read
+            raise
+        # the successor's fin proves it consumed every chunk this op
+        # sealed here; pulled copies are local-only — free them all
+        try:
+            plasma.delete_batch(self._oids)
+        except Exception:
+            pass
+        self._release_pins()
+        # previous ops' own fins: the predecessor consumed them before
+        # producing anything this op read, so they are dead now
+        backlog, ring._fin_backlog = ring._fin_backlog, [own_fin]
+        if backlog:
+            try:
+                plasma.delete_batch(backlog)
+            except Exception:
+                pass

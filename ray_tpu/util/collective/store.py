@@ -24,14 +24,20 @@ class CollectiveStore:
         self._pending: Dict[str, Dict[int, Any]] = {}
         self._done: Dict[str, Dict[str, Any]] = {}
         self._mailbox: Dict[str, Any] = {}
+        # broadcast slots: src puts ONCE, each non-src reader decrements
+        self._bcast: Dict[str, Dict[str, Any]] = {}
 
     def world(self) -> int:
         return self.world_size
 
-    def exchange(self, key: str, rank: int, value: Any) -> List[Any]:
+    def exchange(
+        self, key: str, rank: int, value: Any, timeout: float = 90.0
+    ) -> List[Any]:
         """Contribute rank's tensor; blocks until all ranks arrive, returns
         the rank-ordered list. Runs under the actor's concurrency pool, so
-        all ranks can block here simultaneously."""
+        all ranks can block here simultaneously. ``timeout`` is this
+        actor's INTERNAL deadline — callers pass a fraction of their own
+        so this error (with arrival counts) wins the race."""
         with self._cv:
             slot = self._pending.setdefault(key, {})
             slot[rank] = value
@@ -43,9 +49,7 @@ class CollectiveStore:
                 del self._pending[key]
                 self._cv.notify_all()
             else:
-                # shorter than the clients' RPC timeout so THIS error (with
-                # arrival counts) reaches the caller, not a bare get-timeout
-                deadline = time.monotonic() + 90.0
+                deadline = time.monotonic() + timeout
                 while key not in self._done:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -73,6 +77,32 @@ class CollectiveStore:
             if entry["remaining"] <= 0:
                 del self._done[key]
             return values
+
+    def put_bcast(self, key: str, value: Any, readers: int) -> bool:
+        """Broadcast source: store the tensor once for ``readers`` takers
+        (the last taker frees the slot)."""
+        if readers <= 0:
+            return True
+        with self._cv:
+            self._bcast[key] = {"value": value, "remaining": readers}
+            self._cv.notify_all()
+        return True
+
+    def take_bcast(self, key: str, timeout: float = 90.0) -> Any:
+        with self._cv:
+            deadline = time.monotonic() + timeout
+            while key not in self._bcast:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"broadcast {key} timed out waiting for src put"
+                    )
+                self._cv.wait(min(remaining, 1.0))
+            entry = self._bcast[key]
+            entry["remaining"] -= 1
+            if entry["remaining"] <= 0:
+                del self._bcast[key]
+            return entry["value"]
 
     def put_one(self, key: str, value: Any) -> bool:
         with self._cv:

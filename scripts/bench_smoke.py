@@ -35,7 +35,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 FLOOR_FRACTION = 0.3  # warn below 30% of the archived round value
-CHECKS = ("put_small_per_s", "get_small_per_s", "tasks_async_per_s", "put_gbps")
+CHECKS = (
+    "put_small_per_s",
+    "get_small_per_s",
+    "tasks_async_per_s",
+    "put_gbps",
+    "allreduce_gbps",
+    "reducescatter_gbps",
+)
+# lower-is-better rows: warn when the measured value exceeds the archived
+# value divided by FLOOR_FRACTION (the mirror image of the floor checks)
+CEILING_CHECKS = ("sharded_update_step_ms",)
 
 # hard gate: fraction of the archived r05 value (BENCH_CORE_r05.json) the
 # claimed rows must clear on ANY box state — see module docstring for why
@@ -148,6 +158,56 @@ def main() -> int:
         ray_tpu.put(big)
     results["put_gbps"] = 16 * iters / 1024 / (time.perf_counter() - t0)
 
+    # collective/weight-update plane (warn-only rows): a short world-4 ring
+    # run at bench_core's tensor size so rates compare against the archive
+    @ray_tpu.remote(num_cpus=0)
+    class _ColRank:
+        def __init__(self, world, rank):
+            from ray_tpu.util import collective as col
+
+            self.col = col
+            col.init_collective_group(
+                world, rank, backend="ring", group_name="smoke_rg"
+            )
+
+        def bench(self, op, nelems, iters):
+            x = np.random.default_rng(0).standard_normal(nelems).astype(np.float32)
+            getattr(self.col, op)(x, "smoke_rg")  # warmup/rendezvous
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                getattr(self.col, op)(x, "smoke_rg")
+            return time.perf_counter() - t0
+
+        def sharded_step(self, nelems, steps):
+            from ray_tpu.train.sharded_update import ShardedUpdate
+
+            rng = np.random.default_rng(0)
+            upd = ShardedUpdate(
+                rng.standard_normal(nelems).astype(np.float32),
+                group_name="smoke_rg", optimizer="sgd", sharded=True,
+            )
+            grad = rng.standard_normal(nelems).astype(np.float32)
+            upd.step(grad)  # warmup
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                upd.step(grad)
+            return (time.perf_counter() - t0) / steps
+
+    world, nelems, col_iters = 4, 1_048_576, 2
+    ranks = [_ColRank.remote(world, r) for r in range(world)]
+    for op, key in (("allreduce", "allreduce_gbps"),
+                    ("reducescatter", "reducescatter_gbps")):
+        walls = ray_tpu.get(
+            [r.bench.remote(op, nelems, col_iters) for r in ranks], timeout=300
+        )
+        results[key] = nelems * 4 * col_iters / max(walls) / 1e9
+    walls = ray_tpu.get(
+        [r.sharded_step.remote(nelems, 2) for r in ranks], timeout=300
+    )
+    results["sharded_update_step_ms"] = max(walls) * 1e3
+    for r in ranks:
+        ray_tpu.kill(r)
+
     ray_tpu.shutdown()
 
     failed = False
@@ -193,6 +253,25 @@ def main() -> int:
                 f"WARN: {key} = {value:.2f} below floor {floor:.2f} "
                 f"({FLOOR_FRACTION:.0%} of archived {base:.2f}) — possible "
                 "put-path regression (or shared-box noise; re-run to confirm)",
+                flush=True,
+            )
+    for key in CEILING_CHECKS:
+        value = results.get(key)
+        base = baseline.get(key)
+        ceiling = base / FLOOR_FRACTION if base else None
+        line = {
+            "metric": key,
+            "value": round(value, 2),
+            "ceiling": round(ceiling, 2) if ceiling else None,
+        }
+        print(json.dumps(line), flush=True)
+        if ceiling and value > ceiling:
+            warned = True
+            print(
+                f"WARN: {key} = {value:.2f} above ceiling {ceiling:.2f} "
+                f"(archived {base:.2f} / {FLOOR_FRACTION:.0%}) — possible "
+                "collective-plane regression (or shared-box noise; re-run "
+                "to confirm)",
                 flush=True,
             )
     if failed:

@@ -572,6 +572,7 @@ def test_chaos_yaml_roundtrip(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~16 s chaos training soak
 def test_node_kill_during_training_recovers(tmp_path):
     """Two train workers SPREAD over two nodes; the non-head node dies
     mid-run; a replacement node joins (what the autoscaler would do) and

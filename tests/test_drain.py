@@ -103,6 +103,7 @@ def _metric_total(family, tag=None):
     return total
 
 
+@pytest.mark.slow  # ~21 s drain soak; flakes under parallel file load
 def test_drain_retires_node_with_zero_reconstructions():
     """The acceptance scenario: running tasks + restartable actor +
     primary plasma objects on the drained node; the drain completes
@@ -214,6 +215,7 @@ def test_drain_retires_node_with_zero_reconstructions():
         _teardown_cluster(cluster, saved)
 
 
+@pytest.mark.slow  # ~18 s kill-mid-drain soak
 def test_node_killed_mid_drain_reconstructs_unmigrated_objects():
     """Kill the raylet while the drain is still waiting on running work
     (before migration started): the node falls back to normal death

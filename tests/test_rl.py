@@ -80,6 +80,7 @@ def test_ppo_learner_reduces_loss():
     assert m2["vf_loss"] < m1["vf_loss"], (m1, m2)
 
 
+@pytest.mark.slow  # ~14 s of learning
 def test_ppo_learns_cartpole(ray_start_regular):
     """The learning test: mean episode return must cross the threshold
     (reference pass-criteria style: reward >= X within a budget)."""

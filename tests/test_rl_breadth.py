@@ -185,6 +185,7 @@ def test_offline_dqn_training(ray_rl, tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~13 s of learning; flaky-slow on 1-CPU CI boxes
 def test_appo_learns_cartpole(ray_rl):
     """APPO (async clipped-surrogate over the IMPALA pipeline) must learn
     CartPole (reference: rllib/algorithms/appo/)."""
@@ -242,6 +243,7 @@ def test_td3_update_mechanics(ray_rl):
         algo.stop()
 
 
+@pytest.mark.slow  # ~32 s of learning
 def test_td3_improves_pendulum(ray_rl):
     """TD3 should clearly beat the random-action baseline on Pendulum."""
     from ray_tpu.rl import TD3Config
@@ -401,6 +403,7 @@ def test_a2c_learns_cartpole(ray_rl):
         algo.stop()
 
 
+@pytest.mark.slow  # ~15 s of learning
 def test_es_improves_cartpole(ray_rl):
     """Evolution strategies: seed-encoded mirrored perturbations, rank
     fitness, gradient-free update (reference: rllib/algorithms/es/)."""

@@ -15,6 +15,7 @@ from ray_tpu.rl import ApexDQNConfig, DQNConfig, PolicyClient, PolicyServer
 from ray_tpu.rl.env import make_env
 
 
+@pytest.mark.slow  # ~14 s of learning behind a socket
 def test_policy_client_server_external_cartpole():
     """The verdict-#4 contract: an external CartPole loop (the env lives in
     THIS process, policy + learning live behind a socket) improves over
@@ -80,6 +81,7 @@ def test_policy_server_unknown_episode_errors():
         server.stop()
 
 
+@pytest.mark.slow  # ~30 s of learning across 2 rollout workers
 def test_apex_mechanics_and_learning(ray_start_regular):
     """Shards fill from worker pushes (not via the driver), priorities are
     written back, and the learner improves on CartPole."""

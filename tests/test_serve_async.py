@@ -118,6 +118,7 @@ def test_client_disconnect_cancels_inflight_call(serve_cluster, tmp_path):
     proxy.stop()
 
 
+@pytest.mark.slow  # ~90 s on a 1-CPU box and timing-sensitive
 def test_streaming_ndjson_response(serve_cluster):
     @serve.deployment()
     def tokens(n):

@@ -11,6 +11,7 @@ import ray_tpu
 from ray_tpu.train import RunConfig, ScalingConfig, TensorflowTrainer
 
 
+@pytest.mark.slow  # ~15 s: TF graph build + 2-rank mirrored training
 def test_tensorflow_trainer_multiworker(ray_start_regular, tmp_path):
     """Two ranks form a MultiWorkerMirroredStrategy cluster from TF_CONFIG;
     synchronized training descends the loss; replica count checks out."""

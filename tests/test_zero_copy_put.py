@@ -85,6 +85,7 @@ def test_spill_restore_of_inplace_written_object(ray_start_small_store):
         del out
 
 
+@pytest.mark.slow  # ~17 s two-thread hammer soak
 def test_concurrent_put_delete_during_promote(ray_start_regular):
     """Promote (inline → plasma for a borrower) racing ref deletion must
     neither deadlock nor leak: hammer put/submit/delete from two threads."""
