@@ -447,6 +447,48 @@ def main():
         print(json.dumps({"metric": "client_actor_calls_sync_per_s",
                           "error": str(e)[-400:]}), flush=True)
 
+    # --- serve plane: continuous batching, overload recovery, mux swap ---
+    # rows have no REFERENCE entry (nothing comparable in the reference's
+    # microbenchmark table), so they don't move the geomean; the per-PR
+    # bars live in scripts/bench_smoke.py — a warn floor on batched
+    # tokens/s and ceilings on swap latency and shed-recovery time. Same
+    # parameters as scripts/serve_smoke.py so rounds stay comparable.
+    from ray_tpu import serve as _serve
+    from ray_tpu.serve import loadgen as _loadgen
+
+    try:
+        cb = _loadgen.measure_continuous_batching(
+            concurrency=32, tokens=6, step_ms=4.0)
+        results["serve_batched_tokens_per_s"] = cb["batched_tokens_per_s"]
+        results["serve_batch_speedup_x"] = cb["speedup_x"]
+        print(json.dumps({"metric": "serve_batched_tokens_per_s",
+                          "value": round(cb["batched_tokens_per_s"], 1),
+                          "unit": "tokens/s", "vs_baseline": None,
+                          "speedup_x": round(cb["speedup_x"], 2)}), flush=True)
+        ov = _loadgen.measure_overload(
+            sleep_ms=25.0, max_concurrent=2, max_queued=8,
+            rate_multiplier=2.0, burst_s=2.5, seed=20260807)
+        if ov["recovery_s"] is not None and not ov["stuck"]:
+            results["serve_shed_recovery_s"] = ov["recovery_s"]
+        print(json.dumps({"metric": "serve_shed_recovery_s",
+                          "value": ov["recovery_s"], "unit": "s",
+                          "vs_baseline": None, "shed": ov["shed"],
+                          "ok": ov["ok"], "stuck": ov["stuck"]}), flush=True)
+        mux = _loadgen.measure_mux_swap(weight_mb=4.0, n_models=3)
+        results["serve_mux_swap_ms"] = mux["cold_swap_ms"]
+        print(json.dumps({"metric": "serve_mux_swap_ms",
+                          "value": round(mux["cold_swap_ms"], 2),
+                          "unit": "ms", "vs_baseline": None,
+                          "warm_ms": round(mux["warm_ms"], 2)}), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "serve_plane",
+                          "error": str(e)[-400:]}), flush=True)
+    finally:
+        try:
+            _serve.shutdown()
+        except Exception:
+            pass
+
     ray_tpu.shutdown()
 
     # device object plane: run on the virtual CPU mesh in a subprocess so
@@ -498,7 +540,7 @@ def main():
 
     # archive as a round artifact (reference archives its microbenchmark
     # results under release/release_logs/<version>/microbenchmark.json)
-    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r08.json")
+    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r09.json")
     payload = {
         "results": {
             k: round(v, 4) if isinstance(v, (int, float)) else v

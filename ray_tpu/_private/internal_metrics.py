@@ -112,6 +112,34 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "ray_tpu_serve_dag_node_latency_seconds": (
         "histogram", "per-node latency inside DAGDriver graphs",
         ("deployment", "method")),
+    "ray_tpu_serve_batch_steps_total": (
+        "counter",
+        "batch executions per batcher (mode=static|continuous; avg batch "
+        "size = items/steps)",
+        ("fn", "mode")),
+    "ray_tpu_serve_batch_items_total": (
+        "counter", "requests executed inside batches (mode=static|continuous)",
+        ("fn", "mode")),
+    "ray_tpu_serve_sheds_total": (
+        "counter",
+        "requests shed by admission control (where=handle|proxy)",
+        ("deployment", "where")),
+    "ray_tpu_serve_proxy_inflight": (
+        "gauge", "requests currently admitted into the ingress proxy", ()),
+    "ray_tpu_serve_mux_cache_events_total": (
+        "counter",
+        "multiplex model-cache events (event=hit|miss|evict)",
+        ("loader", "event")),
+    "ray_tpu_serve_mux_models_resident": (
+        "gauge", "models resident in a replica's multiplex LRU", ("loader",)),
+    "ray_tpu_serve_mux_load_seconds": (
+        "histogram",
+        "multiplex model load wall time (object-plane weight streaming)",
+        ("loader",)),
+    "ray_tpu_serve_replica_drains_total": (
+        "counter",
+        "replicas drained on scale-down (outcome=graceful|forced)",
+        ("outcome",)),
     # -- rpc ----------------------------------------------------------
     "ray_tpu_rpc_pump_failures": (
         "counter", "native poller pump-thread crashes (streams torn down)", ()),

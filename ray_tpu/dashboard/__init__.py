@@ -17,9 +17,11 @@ APIs:
   GET /api/perf          (cluster-wide RPC phase stats via summarize_rpcs)
   GET /api/perf_profile  (?duration=2&hz=100 — cluster flamegraph as
                           speedscope JSON; save and open at speedscope.app)
+  GET /api/serve         (serve-plane status snapshot from the controller)
   GET /metrics           (Prometheus exposition)
   GET /events            (event log view)
   GET /perf              (RPC phase latency view)
+  GET /serve             (serve deployments/models view)
   GET /logs              (cluster log browser)
   GET /logs/{node}/{file} (one log file, auto-refreshing tail)
   GET /                  (the UI)
@@ -155,6 +157,55 @@ async function refresh(){
     document.getElementById('log').innerHTML = h+'</table>';
     document.getElementById('updated').textContent =
       'updated '+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_SERVE_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu serve</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} .ok{color:#0a7d2c} .bad{color:#c0232c}
+ #updated{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>serve plane <a href="/" style="font-size:.8rem">dashboard</a>
+<span id="updated"></span></h1>
+<h2>Deployments</h2><div id="deployments"></div>
+<h2>Registered models (object-plane weights)</h2><div id="models"></div>
+<script>
+async function refresh(){
+  try{
+    const st = await (await fetch('/api/serve')).json();
+    const deps = Object.entries(st.deployments || {}).map(([name,d])=>({
+      name,
+      replicas: `${d.num_replicas}/${d.target}`+
+                (d.draining ? ` (${d.draining} draining)` : ''),
+      ongoing: d.ongoing, total: d.total,
+      capacity: d.max_concurrent_queries,
+      models: (d.models||[]).join(', ') || '-',
+    }));
+    let h = '<table><tr><th>deployment</th><th>replicas</th><th>ongoing</th>'+
+            '<th>total</th><th>slots/replica</th><th>resident models</th></tr>';
+    for(const d of deps)
+      h += `<tr><td>${d.name}</td><td>${d.replicas}</td><td>${d.ongoing}</td>`+
+           `<td>${d.total}</td><td>${d.capacity}</td><td>${d.models}</td></tr>`;
+    document.getElementById('deployments').innerHTML =
+      deps.length ? h+'</table>' : '<em>no deployments</em>';
+    document.getElementById('models').innerHTML =
+      (st.models && st.models.length)
+        ? '<table><tr><th>model id</th></tr>'+
+          st.models.map(m=>`<tr><td>${m}</td></tr>`).join('')+'</table>'
+        : '<em>none registered</em>';
+    document.getElementById('updated').textContent = st.ts
+      ? 'controller snapshot '+new Date(st.ts*1000).toLocaleTimeString()
+      : 'no serve controller running';
   }catch(e){
     document.getElementById('updated').textContent = 'refresh failed: '+e;
   }
@@ -543,6 +594,8 @@ class DashboardServer:
             return _EVENTS_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/perf":
             return _PERF_PAGE.encode(), "text/html; charset=utf-8"
+        if base0 == "/serve":
+            return _SERVE_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/logs":
             return _LOGS_PAGE.encode(), "text/html; charset=utf-8"
         if base0.startswith("/logs/"):
@@ -588,6 +641,15 @@ class DashboardServer:
                     "application/json",
                 )
             return json.dumps(self._list_logs()).encode(), "application/json"
+        if base == "/api/serve":
+            # the serve controller drops a status snapshot into GCS KV
+            # every reconcile tick; no controller -> empty object
+            try:
+                blob = s._gcs_call("kv_get", ("serve", "status"), address=a)
+                payload = json.loads(blob) if blob else {}
+            except Exception:
+                payload = {}
+            return json.dumps(payload).encode(), "application/json"
         if base == "/api/metrics_history":
             return (
                 json.dumps(list(self._history)).encode(),

@@ -12,16 +12,32 @@ import logging
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
-from ray_tpu.serve.batching import batch
-from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.batching import (
+    batch,
+    bucket_pad_size,
+    continuous_batch,
+    shutdown_batchers,
+)
+from ray_tpu.serve.multiplex import (
+    fetch_model,
+    get_multiplexed_model_id,
+    list_models,
+    multiplexed,
+    register_model,
+)
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    BackPressureError,
+    DeploymentHandle,
+    DeploymentResponse,
+)
 from ray_tpu.serve.proxy import HTTPProxy
 
 logger = logging.getLogger(__name__)
 
 __all__ = [
     "Application",
+    "BackPressureError",
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
@@ -30,16 +46,22 @@ __all__ = [
     "DAGDriver",
     "InputNode",
     "batch",
+    "bucket_pad_size",
     "build",
     "build_graph",
+    "continuous_batch",
     "delete",
     "deployment",
+    "fetch_model",
     "get_deployment_handle",
     "get_multiplexed_model_id",
+    "list_models",
     "multiplexed",
+    "register_model",
     "run",
     "run_graph",
     "shutdown",
+    "shutdown_batchers",
     "start_http_proxy",
     "status",
 ]
@@ -63,7 +85,10 @@ class Deployment:
             **{self._OPTION_KEYS.get(k, k): v for k, v in overrides.items()},
         }
         name = cfg.pop("name", self.name)
-        unknown = set(cfg) - {"num_replicas", "user_config", "autoscaling", "resources"}
+        unknown = set(cfg) - {
+            "num_replicas", "user_config", "autoscaling", "resources",
+            "max_concurrent_queries", "max_queued_requests", "drain_grace_s",
+        }
         if unknown:
             raise TypeError(f"unknown deployment options: {sorted(unknown)}")
         return Deployment(self.func_or_class, name, cfg)
@@ -106,8 +131,19 @@ def deployment(
     user_config: Any = None,
     autoscaling_config: Optional[Dict[str, Any]] = None,
     ray_actor_options: Optional[Dict[str, Any]] = None,
+    max_concurrent_queries: int = 8,
+    max_queued_requests: Optional[int] = None,
+    drain_grace_s: float = 30.0,
 ):
-    """``@serve.deployment`` decorator (reference: serve/api.py deployment)."""
+    """``@serve.deployment`` decorator (reference: serve/api.py deployment).
+
+    ``max_concurrent_queries`` is the per-replica executing-slot count
+    (the replica actor's concurrency); ``max_queued_requests`` bounds the
+    admission queue beyond those slots — excess requests shed with
+    :class:`BackPressureError` (503 + Retry-After at the proxy). ``None``
+    defaults the queue allowance to one full round of executing slots.
+    ``drain_grace_s`` is how long a scaled-down replica may finish
+    in-flight work before a forced kill."""
 
     def deco(target):
         return Deployment(
@@ -118,6 +154,9 @@ def deployment(
                 "user_config": user_config,
                 "autoscaling": autoscaling_config,
                 "resources": ray_actor_options,
+                "max_concurrent_queries": max_concurrent_queries,
+                "max_queued_requests": max_queued_requests,
+                "drain_grace_s": drain_grace_s,
             },
         )
 
@@ -232,9 +271,12 @@ def shutdown(timeout: float = 30.0):
             pass
 
 
-def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> HTTPProxy:
-    """Start an in-driver HTTP ingress (POST /<deployment> with JSON)."""
-    return HTTPProxy(host, port)
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0,
+                     max_total_inflight: int = 1024) -> HTTPProxy:
+    """Start an in-driver HTTP ingress (POST /<deployment> with JSON).
+    ``max_total_inflight`` bounds requests admitted across ALL routes;
+    beyond it the proxy sheds with 503 + Retry-After."""
+    return HTTPProxy(host, port, max_total_inflight=max_total_inflight)
 
 
 # -- declarative config (reference: serve/schema.py ServeDeploySchema +
@@ -285,6 +327,10 @@ def build(target, name: Optional[str] = None) -> Dict[str, Any]:
             "user_config": dep.config.get("user_config"),
             "autoscaling_config": dep.config.get("autoscaling"),
             "resources": dep.config.get("resources"),
+            "max_concurrent_queries": dep.config.get(
+                "max_concurrent_queries", 8),
+            "max_queued_requests": dep.config.get("max_queued_requests"),
+            "drain_grace_s": dep.config.get("drain_grace_s", 30.0),
         })
         return dep_name
 
@@ -330,6 +376,9 @@ def apply(config: Dict[str, Any], *, timeout: float = 60.0) -> DeploymentHandle:
             "user_config": d.get("user_config"),
             "autoscaling": d.get("autoscaling_config"),
             "resources": d.get("resources"),
+            "max_concurrent_queries": d.get("max_concurrent_queries", 8),
+            "max_queued_requests": d.get("max_queued_requests"),
+            "drain_grace_s": d.get("drain_grace_s", 30.0),
         }
         ray_tpu.get(controller.deploy.remote(name, spec), timeout=timeout)
         handles[name] = DeploymentHandle(name)

@@ -30,7 +30,7 @@ from typing import Any, Dict, Optional
 import ray_tpu
 from ray_tpu._private import internal_metrics
 from ray_tpu._private.ids import ObjectRefGenerator
-from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.handle import BackPressureError, DeploymentHandle
 
 
 def _core():
@@ -39,11 +39,33 @@ def _core():
     return get_global_worker().core
 
 
-class AsyncHTTPProxy:
-    """The event-loop ingress. Runs its own loop thread; ``stop()`` joins it."""
+def _find_backpressure(exc: BaseException) -> Optional[BackPressureError]:
+    """Unwrap TaskError.cause chains: a child deployment shedding inside a
+    DAG driver reaches the proxy wrapped once per replica hop."""
+    e: Optional[BaseException] = exc
+    for _ in range(8):
+        if e is None:
+            return None
+        if isinstance(e, BackPressureError):
+            return e
+        e = getattr(e, "cause", None) or e.__cause__
+    return None
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+
+class AsyncHTTPProxy:
+    """The event-loop ingress. Runs its own loop thread; ``stop()`` joins it.
+
+    Admission: the downstream handle sheds per-deployment (admission queue
+    full -> :class:`BackPressureError`); the proxy maps that — including
+    backpressure propagated up a DAG — to 503 + Retry-After, and applies
+    one more global bound, ``max_total_inflight``, so a burst across many
+    deployments cannot pile unbounded state into the ingress itself."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_total_inflight: int = 1024):
         self._handles: Dict[str, DeploymentHandle] = {}
+        self._max_total_inflight = max_total_inflight
+        self._inflight = 0  # touched only on the event-loop thread
         # handle.remote() can block briefly (routing-table refresh RPC every
         # ~2s per deployment); a 2-thread executor bounds that, everything
         # else is loop-native
@@ -136,15 +158,33 @@ class AsyncHTTPProxy:
         return method, path, headers, body
 
     def _reply(self, writer, status: int, body: bytes,
-               content_type: str = "application/json"):
-        writer.write(
-            (
-                f"HTTP/1.1 {status} {'OK' if status == 200 else 'ERR'}\r\n"
-                f"Content-Type: {content_type}\r\n"
-                f"Content-Length: {len(body)}\r\n\r\n"
-            ).encode()
+               content_type: str = "application/json",
+               extra_headers: Optional[Dict[str, str]] = None):
+        head = (
+            f"HTTP/1.1 {status} {'OK' if status == 200 else 'ERR'}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
         )
+        for k, v in (extra_headers or {}).items():
+            head += f"{k}: {v}\r\n"
+        writer.write((head + "\r\n").encode())
         writer.write(body)
+
+    def _shed(self, writer, route: str, t0: float,
+              retry_after_s: float = 1.0):
+        """503 + Retry-After: the overload answer that costs the cluster
+        nothing — no replica call was (or will be) submitted."""
+        internal_metrics.inc(
+            "ray_tpu_serve_sheds_total", 1,
+            {"deployment": route, "where": "proxy"})
+        body = json.dumps(
+            {"error": "overloaded", "retry_after_s": retry_after_s}
+        ).encode()
+        self._reply(
+            writer, 503, body,
+            extra_headers={"Retry-After": str(max(1, round(retry_after_s)))},
+        )
+        self._record_proxy(route, 503, t0)
 
     async def _route(self, method: str, path: str, body: bytes, writer,
                      reader=None):
@@ -176,48 +216,68 @@ class AsyncHTTPProxy:
         handle = self._handles.get(name)
         if handle is None:
             handle = self._handles[name] = DeploymentHandle(name)
+        if (self._max_total_inflight
+                and self._inflight >= self._max_total_inflight):
+            # ingress-global bound: shed before touching the cluster
+            self._shed(writer, name, route_t0)
+            return
         loop = asyncio.get_running_loop()
         submit = (
             (lambda: handle.stream(payload))
             if stream
             else (lambda: handle.remote(payload))
         )
+        self._inflight += 1
+        internal_metrics.set_gauge(
+            "ray_tpu_serve_proxy_inflight", float(self._inflight))
         try:
-            # replica-death retry, matching DeploymentResponse.result():
-            # replica churn (scale-down, redeploy, node loss) must not
-            # surface as client 500s
-            for attempt in range(4):
-                response = await loop.run_in_executor(self._submit_pool, submit)
-                try:
-                    value = await self._await_ref(
-                        response.ref, timeout=60.0, reader=reader
-                    )
-                    response._finish_once()
-                    break
-                except ConnectionResetError:
-                    response._finish_once()
-                    raise
-                except ray_tpu.ActorDiedError:
-                    response._finish_once()
-                    if attempt == 3:
+            try:
+                # replica-death retry, matching DeploymentResponse.result():
+                # replica churn (scale-down, redeploy, node loss) must not
+                # surface as client 500s
+                for attempt in range(4):
+                    response = await loop.run_in_executor(
+                        self._submit_pool, submit)
+                    try:
+                        value = await self._await_ref(
+                            response.ref, timeout=60.0, reader=reader
+                        )
+                        response._finish_once()
+                        break
+                    except ConnectionResetError:
+                        response._finish_once()
                         raise
-                    await loop.run_in_executor(
-                        self._submit_pool,
-                        lambda: handle._refresh(force=True),
-                    )
-        except ConnectionResetError:
-            # client went away mid-wait: the replica call was cancelled
-            # through the cancellation plane; nobody is left to reply to
-            # (499 is nginx's "client closed request")
-            self._record_proxy(name, 499, route_t0)
-            return
-        except Exception as e:  # noqa: BLE001
-            self._reply(
-                writer, 500,
-                json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
-            )
-            self._record_proxy(name, 500, route_t0)
-            return
+                    except ray_tpu.ActorDiedError:
+                        response._finish_once()
+                        if attempt == 3:
+                            raise
+                        await loop.run_in_executor(
+                            self._submit_pool,
+                            lambda: handle._refresh(force=True),
+                        )
+            except ConnectionResetError:
+                # client went away mid-wait: the replica call was cancelled
+                # through the cancellation plane; nobody is left to reply to
+                # (499 is nginx's "client closed request")
+                self._record_proxy(name, 499, route_t0)
+                return
+            except Exception as e:  # noqa: BLE001
+                bp = _find_backpressure(e)
+                if bp is not None:
+                    # shed by the handle's admission queue (directly, or
+                    # deep inside a DAG) — overload, not server error
+                    self._shed(writer, name, route_t0, bp.retry_after_s)
+                    return
+                self._reply(
+                    writer, 500,
+                    json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                )
+                self._record_proxy(name, 500, route_t0)
+                return
+        finally:
+            self._inflight -= 1
+            internal_metrics.set_gauge(
+                "ray_tpu_serve_proxy_inflight", float(self._inflight))
         if isinstance(value, ObjectRefGenerator) or (
             stream and isinstance(value, (list, tuple))
         ):

@@ -6,10 +6,20 @@ _private/autoscaling_policy.py:54 + calculate_desired_num_replicas:10.
 State: target deployments -> replica actor sets; a version counter lets
 handles cheaply refresh routing tables (the long-poll push channel of the
 reference's LongPollHost, pull-flavored).
+
+The control loop also runs the traffic plane's feedback cycle: it polls
+every replica's metrics once per tick and folds them into the routing
+table (per-replica queue depths for po2 routing, resident model ids for
+cache-aware multiplex placement), drives the autoscaler off the same
+samples, drains replicas gracefully on scale-down (out of the table
+first, killed only once idle or past the grace window), pins registered
+model weights in the object plane, and publishes a status snapshot to
+GCS KV for the dashboard's /serve view.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import threading
@@ -17,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import internal_metrics
 from ray_tpu.serve.replica import Replica
 
 logger = logging.getLogger(__name__)
@@ -31,8 +42,11 @@ class ServeController:
         # serializes reconciliation: deploy() and the background loop would
         # otherwise double-create replicas (and over-subscribe the cluster)
         self._reconcile_lock = threading.Lock()
-        # name -> {spec, replicas: [handle], version}
+        # name -> {spec, replicas: [handle], version, replica_metrics,
+        #          draining: [{replica, deadline}], autoscale_target}
         self._deployments: Dict[str, Dict[str, Any]] = {}
+        # model id -> pinned ObjectRef of registered weights
+        self._models: Dict[str, Any] = {}
         self._version = 0
         self._stop = threading.Event()
         self._loop = threading.Thread(
@@ -45,7 +59,8 @@ class ServeController:
     def deploy(self, name: str, spec: Dict[str, Any]) -> bool:
         """spec: {func_or_class, init_args, init_kwargs, num_replicas,
         user_config, autoscaling: {min_replicas, max_replicas,
-        target_ongoing_requests}, resources}"""
+        target_ongoing_requests}, resources, max_concurrent_queries,
+        max_queued_requests, drain_grace_s}"""
         reconfigure_refs = []
         with self._lock:
             existing = self._deployments.get(name)
@@ -81,7 +96,10 @@ class ServeController:
             self._version += 1
         if dep is None:
             return False
-        for r in dep["replicas"]:
+        doomed = list(dep["replicas"]) + [
+            e["replica"] for e in dep.get("draining", ())
+        ]
+        for r in doomed:
             try:
                 ray_tpu.kill(r)
             except Exception:
@@ -93,33 +111,91 @@ class ServeController:
             dep = self._deployments.get(name)
             if dep is None:
                 return None
-            return {"replicas": list(dep["replicas"]), "version": self._version}
+            spec = dep["spec"]
+            metrics = dep.get("replica_metrics") or {}
+            model_locations: Dict[str, List[Any]] = {}
+            for aid, m in metrics.items():
+                for mid in m.get("models") or ():
+                    model_locations.setdefault(mid, []).append(aid)
+            return {
+                "replicas": list(dep["replicas"]),
+                "version": self._version,
+                # controller-observed per-replica in-flight counts: the
+                # handle folds these into its po2 scores so load skew from
+                # *other* handles/proxies is visible to each router
+                "queue_depths": {
+                    aid: m.get("ongoing", 0) for aid, m in metrics.items()
+                },
+                "model_locations": model_locations,
+                "max_concurrent_queries": int(
+                    spec.get("max_concurrent_queries") or 8),
+                "max_queued_requests": spec.get("max_queued_requests"),
+            }
 
     def routing_version(self) -> int:
         return self._version
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
-            return {
-                name: {
+            out = {}
+            for name, dep in self._deployments.items():
+                metrics = dep.get("replica_metrics") or {}
+                out[name] = {
                     "num_replicas": len(dep["replicas"]),
                     "target": self._target_replicas(dep),
+                    "draining": len(dep.get("draining", ())),
+                    "ongoing": sum(
+                        m.get("ongoing", 0) for m in metrics.values()),
+                    "models": sorted(
+                        {mid for m in metrics.values()
+                         for mid in m.get("models") or ()}),
                 }
-                for name, dep in self._deployments.items()
-            }
+            return out
 
     def shutdown(self) -> bool:
         self._stop.set()
         with self._lock:
             deps = list(self._deployments.values())
             self._deployments.clear()
+            self._models.clear()
         for dep in deps:
-            for r in dep["replicas"]:
+            doomed = list(dep["replicas"]) + [
+                e["replica"] for e in dep.get("draining", ())
+            ]
+            for r in doomed:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
         return True
+
+    # -- model weight registry -------------------------------------------
+
+    def register_model(self, model_id: str, weights_ref) -> bool:
+        """Pin ``weights_ref`` under ``model_id``: the controller holds the
+        ref, so the weights stay resident in the object plane for any
+        replica's loader to stream in. The ref travels wrapped in a list —
+        a bare top-level ObjectRef arg would be resolved to the weights."""
+        if isinstance(weights_ref, (list, tuple)):
+            weights_ref = weights_ref[0]
+        with self._lock:
+            self._models[model_id] = weights_ref
+        return True
+
+    def get_model_ref(self, model_id: str):
+        """The pinned ref, list-wrapped so the caller receives the ref
+        itself (nested refs are never resolved in transit), or None."""
+        with self._lock:
+            ref = self._models.get(model_id)
+        return None if ref is None else [ref]
+
+    def unregister_model(self, model_id: str) -> bool:
+        with self._lock:
+            return self._models.pop(model_id, None) is not None
+
+    def list_models(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
 
     # -- reconciliation ---------------------------------------------------
 
@@ -165,6 +241,11 @@ class ServeController:
             created = []
             while len(alive) + len(created) < target:
                 opts = dict(spec.get("resources") or {"num_cpus": 1})
+                # the replica's actor concurrency IS the deployment's
+                # max_concurrent_queries: requests beyond it queue in the
+                # actor, and the admission layer bounds that queue
+                opts["max_concurrency"] = int(
+                    spec.get("max_concurrent_queries") or 8)
                 created.append(
                     Replica.options(**opts).remote(
                         name,
@@ -175,19 +256,31 @@ class ServeController:
                     )
                 )
                 changed = True
-            to_kill = []
+            # scale-down is graceful: surplus replicas leave the routing
+            # table immediately (version bump) but are only killed by
+            # _reap_draining once idle — in-flight requests finish
+            to_drain = []
             while len(alive) + len(created) > target and alive:
-                to_kill.append(alive.pop())
+                to_drain.append(alive.pop())
                 changed = True
+            to_kill = []
             with self._lock:
                 if self._deployments.get(name) is not dep:
                     # deleted (or replaced) while we reconciled: the actors
                     # we just created belong to nobody — reap them
                     to_kill.extend(created)
                     to_kill.extend(alive)
+                    to_kill.extend(to_drain)
                     changed = False
                 else:
                     dep["replicas"] = alive + created
+                    if to_drain:
+                        grace = float(spec.get("drain_grace_s") or 30.0)
+                        deadline = time.monotonic() + grace
+                        dep.setdefault("draining", []).extend(
+                            {"replica": r, "deadline": deadline}
+                            for r in to_drain
+                        )
                     if changed:
                         self._version += 1
             for r in to_kill:
@@ -197,56 +290,162 @@ class ServeController:
                     pass
             if changed:
                 logger.info(
-                    "deployment %s reconciled to %d replicas", name, len(alive) + len(created)
+                    "deployment %s reconciled to %d replicas (%d draining)",
+                    name, len(alive) + len(created),
+                    len(dep.get("draining", ())),
                 )
 
-    def _autoscale_once(self):
+    def _reap_draining(self):
+        with self._lock:
+            items = [
+                (name, dep, list(dep.get("draining") or ()))
+                for name, dep in self._deployments.items()
+            ]
+        for name, dep, drains in items:
+            if not drains:
+                continue
+            done = []
+            for entry in drains:
+                r = entry["replica"]
+                outcome = None
+                try:
+                    m = ray_tpu.get(r.get_metrics.remote(), timeout=5.0)
+                    if m.get("ongoing", 0) <= 0:
+                        outcome = "graceful"
+                except ray_tpu.GetTimeoutError:
+                    pass  # busy or slow: check again next tick
+                except Exception:
+                    outcome = "dead"  # died on its own; nothing to kill
+                if outcome is None and time.monotonic() > entry["deadline"]:
+                    outcome = "forced"
+                if outcome is None:
+                    continue
+                if outcome != "dead":
+                    if outcome == "graceful":
+                        try:  # flush replica-side batcher queues first
+                            ray_tpu.get(r.drain.remote(), timeout=5.0)
+                        except Exception:
+                            pass
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+                    internal_metrics.inc(
+                        "ray_tpu_serve_replica_drains_total", 1,
+                        {"outcome": outcome})
+                done.append(entry)
+            if not done:
+                continue
+            with self._lock:
+                if self._deployments.get(name) is dep:
+                    dep["draining"] = [
+                        e for e in dep.get("draining", ()) if e not in done
+                    ]
+
+    # -- metrics poll + autoscaling ---------------------------------------
+
+    def _poll_metrics_once(self):
+        """One metrics sweep over every replica: feeds the routing table's
+        queue-depth/model-location feedback and the autoscaler."""
         with self._lock:
             items = list(self._deployments.items())
         for name, dep in items:
-            auto = dep["spec"].get("autoscaling")
-            if not auto or not dep["replicas"]:
-                continue
-            refs = [r.get_metrics.remote() for r in dep["replicas"]]
-            ray_tpu.wait(refs, num_returns=len(refs), timeout=10.0)
-            ongoing = 0
-            for ref in refs:
-                try:
-                    ongoing += ray_tpu.get(ref, timeout=0.5)["ongoing"]
-                except Exception:
-                    pass
-            target_per = max(float(auto.get("target_ongoing_requests", 2.0)), 0.1)
-            desired = math.ceil(ongoing / target_per) if ongoing else auto.get(
-                "min_replicas", 1
-            )
-            desired = min(
-                max(desired, auto.get("min_replicas", 1)), auto.get("max_replicas", 8)
-            )
-            current = dep.get("autoscale_target", len(dep["replicas"]))
-            if desired < current:
-                # downscale cooldown: a single idle sample between bursts
-                # must not kill live replicas (reference applies a
-                # downscale_delay smoothing window)
-                delay = float(auto.get("downscale_delay_s", 10.0))
-                since = dep.get("downscale_since")
-                now = time.monotonic()
-                if since is None:
-                    dep["downscale_since"] = now
-                    continue
-                if now - since < delay:
-                    continue
-            dep.pop("downscale_since", None)
-            if desired != current:
-                logger.info(
-                    "autoscaling %s: ongoing=%d -> %d replicas", name, ongoing, desired
+            replicas = list(dep["replicas"])
+            metrics: Dict[Any, Dict[str, Any]] = {}
+            if replicas:
+                refs = [(r, r.get_metrics.remote()) for r in replicas]
+                ray_tpu.wait(
+                    [ref for _, ref in refs],
+                    num_returns=len(refs), timeout=10.0,
                 )
-            dep["autoscale_target"] = desired
+                for r, ref in refs:
+                    try:
+                        metrics[r._actor_id] = ray_tpu.get(ref, timeout=0.5)
+                    except Exception:
+                        pass
+            with self._lock:
+                if self._deployments.get(name) is dep:
+                    dep["replica_metrics"] = metrics
+            self._autoscale_dep(name, dep, metrics)
+
+    def _autoscale_dep(self, name, dep, metrics):
+        auto = dep["spec"].get("autoscaling")
+        if not auto or not dep["replicas"]:
+            return
+        ongoing = sum(m.get("ongoing", 0) for m in metrics.values())
+        target_per = max(float(auto.get("target_ongoing_requests", 2.0)), 0.1)
+        desired = math.ceil(ongoing / target_per) if ongoing else auto.get(
+            "min_replicas", 1
+        )
+        desired = min(
+            max(desired, auto.get("min_replicas", 1)), auto.get("max_replicas", 8)
+        )
+        current = dep.get("autoscale_target", len(dep["replicas"]))
+        if desired < current:
+            # downscale cooldown: a single idle sample between bursts
+            # must not kill live replicas (reference applies a
+            # downscale_delay smoothing window)
+            delay = float(auto.get("downscale_delay_s", 10.0))
+            since = dep.get("downscale_since")
+            now = time.monotonic()
+            if since is None:
+                dep["downscale_since"] = now
+                return
+            if now - since < delay:
+                return
+        dep.pop("downscale_since", None)
+        if desired != current:
+            logger.info(
+                "autoscaling %s: ongoing=%d -> %d replicas", name, ongoing, desired
+            )
+        dep["autoscale_target"] = desired
+
+    # -- dashboard feed ----------------------------------------------------
+
+    def _publish_status(self):
+        """Drop a JSON status snapshot into GCS KV ("serve"/"status"): the
+        dashboard's /serve view reads it without touching this actor."""
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            if global_worker is None:
+                return
+            with self._lock:
+                snapshot = {
+                    "ts": time.time(),
+                    "models": sorted(self._models),
+                    "deployments": {},
+                }
+                for name, dep in self._deployments.items():
+                    metrics = dep.get("replica_metrics") or {}
+                    spec = dep["spec"]
+                    snapshot["deployments"][name] = {
+                        "num_replicas": len(dep["replicas"]),
+                        "target": self._target_replicas(dep),
+                        "draining": len(dep.get("draining", ())),
+                        "ongoing": sum(
+                            m.get("ongoing", 0) for m in metrics.values()),
+                        "total": sum(
+                            m.get("total", 0) for m in metrics.values()),
+                        "max_concurrent_queries": int(
+                            spec.get("max_concurrent_queries") or 8),
+                        "models": sorted(
+                            {mid for m in metrics.values()
+                             for mid in m.get("models") or ()}),
+                    }
+            payload = json.dumps(snapshot).encode()
+            global_worker.core.gcs.call(
+                "kv_put", ("serve", "status", payload, True), timeout=5.0)
+        except Exception:
+            pass
 
     def _reconcile_loop(self):
         interval = 1.0
         while not self._stop.wait(interval):
             try:
-                self._autoscale_once()
+                self._poll_metrics_once()
                 self._reconcile_once()
+                self._reap_draining()
+                self._publish_status()
             except Exception:
                 logger.exception("serve reconcile iteration failed")

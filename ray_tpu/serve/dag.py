@@ -223,17 +223,31 @@ class _DAGDriverImpl:
                 tags={"deployment": n["deployment"], "method": n["method"]},
             )
 
-        launch_ready()
         out_id = self.plan["output_id"]
-        while out_id not in values:
-            # resolve the topologically-first in-flight node; its arrival
-            # can only unlock nodes later in the plan. One always exists:
-            # every unlaunched node waits (transitively) on a pending one.
-            nid = next(
-                n["id"] for n in self.plan["nodes"] if n["id"] in pending
-            )
-            resolve(nid)
+        try:
             launch_ready()
+            while out_id not in values:
+                # resolve the topologically-first in-flight node; its
+                # arrival can only unlock nodes later in the plan. One
+                # always exists: every unlaunched node waits
+                # (transitively) on a pending one.
+                nid = next(
+                    n["id"] for n in self.plan["nodes"] if n["id"] in pending
+                )
+                resolve(nid)
+                launch_ready()
+        except BaseException:
+            # a failed (or shed: BackPressureError) node poisons the whole
+            # request — cancel in-flight sibling branches so backpressure
+            # propagates instead of leaving work running for a reply
+            # nobody will assemble; each cancel releases its routing slot
+            # exactly once
+            for resp in pending.values():
+                try:
+                    resp.cancel()
+                except Exception:
+                    pass
+            raise
         return values[out_id]
 
 

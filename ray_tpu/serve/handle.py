@@ -3,8 +3,17 @@
 Reference: serve/_private/router.py:313 Router (assign_replica:281 —
 power-of-two-choices on queue length) + serve/handle.py. The handle caches
 the routing table and refreshes it when the controller's version moves or
-a replica dies; replica choice is po2 over locally tracked in-flight
-counts (the reference's same heuristic without an extra RPC)."""
+a replica dies; replica choice is po2 over in-flight counts — the local
+ones this handle tracks, *maxed* with the controller-reported per-replica
+queue depths so load from other handles/proxies is visible without double
+counting our own.
+
+The handle is also the admission-control point: each deployment exposes
+``max_concurrent_queries`` executing slots per replica plus a bounded
+``max_queued_requests`` allowance; a send beyond that raises
+:class:`BackPressureError` *before* any in-flight slot is taken (shed
+requests therefore never skew accounting). The proxy maps it to
+HTTP 503 + Retry-After."""
 
 from __future__ import annotations
 
@@ -15,8 +24,23 @@ from collections import deque
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu._private import internal_metrics
 
 from ray_tpu.serve.controller import CONTROLLER_NAME
+
+
+class BackPressureError(Exception):
+    """The deployment's admission queue is full: the request was shed
+    before submission. Retry after ``retry_after_s`` (the proxy turns
+    this into HTTP 503 with a Retry-After header)."""
+
+    def __init__(self, message: str = "", retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+    def __reduce__(self):
+        return (BackPressureError, (self.args[0] if self.args else "",
+                                    self.retry_after_s))
 
 
 class DeploymentResponse:
@@ -57,6 +81,15 @@ class DeploymentResponse:
             return retry.result(timeout=timeout)
         finally:
             self._finish_once()
+
+    def cancel(self):
+        """Cancel the in-flight request (cooperative + recursive) and
+        release its routing slot exactly once."""
+        try:
+            ray_tpu.cancel(self._ref, force=False, recursive=True)
+        except Exception:
+            pass
+        self._finish_once()
 
     def __del__(self):
         # a response consumed via .ref (or dropped) must still release its
@@ -99,6 +132,11 @@ class DeploymentHandle:
         # drained under the lock before every pick
         self._released: "deque" = deque()
         self._last_refresh = 0.0
+        # controller-side feedback, refreshed with the routing table
+        self._queue_depths: Dict[Any, int] = {}
+        self._model_locations: Dict[str, list] = {}
+        self._capacity = 8  # max_concurrent_queries per replica
+        self._max_queued: Optional[int] = None
 
     def options(self, *, multiplexed_model_id: Optional[str] = None
                 ) -> "DeploymentHandle":
@@ -139,6 +177,10 @@ class DeploymentHandle:
         with self._lock:
             self._replicas = table["replicas"]
             self._version = table["version"]
+            self._queue_depths = table.get("queue_depths") or {}
+            self._model_locations = table.get("model_locations") or {}
+            self._capacity = int(table.get("max_concurrent_queries") or 8)
+            self._max_queued = table.get("max_queued_requests")
             keys = {r._actor_id for r in self._replicas}
             # prune in place: options() variants share this dict by
             # reference, so rebinding would desync their routing counts
@@ -149,10 +191,24 @@ class DeploymentHandle:
                     del self._model_affinity[model]
             self._last_refresh = now
 
+    def _score_locked(self, key) -> int:
+        """A replica's load: the max of this handle's in-flight count and
+        the controller's last-observed queue depth — other routers' load
+        shows up without double counting our own."""
+        return max(self._inflight.get(key, 0), self._queue_depths.get(key, 0))
+
+    def _inflight_total(self) -> int:
+        """Admitted-but-unreleased requests across this handle (and its
+        options() variants — the counts dict is shared)."""
+        with self._lock:
+            self._drain_released_locked()
+            return sum(self._inflight.values())
+
     def _pick(self):
-        """Power-of-two choices on locally tracked in-flight counts; a
-        multiplexed model id routes stickily to the replica that last
-        served it (its weights are already resident)."""
+        """Power-of-two choices on in-flight scores; a multiplexed model
+        id routes stickily to the replica that last served it, falling
+        back to the controller's model-location map (some replica already
+        holds the weights) before paying a cold load."""
         with self._lock:
             self._drain_released_locked()
             n = len(self._replicas)
@@ -167,22 +223,65 @@ class DeploymentHandle:
                     for r in self._replicas:
                         if r._actor_id == key:
                             return r
+                # cold handle / evicted affinity: prefer a replica the
+                # controller says already holds this model's weights
+                holders = {
+                    k for k in self._model_locations.get(model_id, ())}
+                candidates = [
+                    r for r in self._replicas if r._actor_id in holders]
+                if candidates:
+                    choice = min(
+                        candidates,
+                        key=lambda r: self._score_locked(r._actor_id))
+                    self._model_affinity[model_id] = choice._actor_id
+                    return choice
             if n == 1:
                 choice = self._replicas[0]
             else:
                 a, b = random.sample(self._replicas, 2)
-                ka, kb = a._actor_id, b._actor_id
                 choice = (
-                    a if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0)
+                    a if self._score_locked(a._actor_id)
+                    <= self._score_locked(b._actor_id)
                     else b
                 )
             if model_id:
                 self._model_affinity[model_id] = choice._actor_id
             return choice
 
+    def _check_admission_locked(self):
+        """Shed when the deployment is saturated: every replica's
+        executing slots are spoken for AND the bounded queue allowance is
+        full. Raises before any in-flight slot is taken, so shed requests
+        never need compensating accounting."""
+        n = len(self._replicas)
+        if n == 0:
+            return  # _pick surfaces the no-replica error
+        max_queued = (
+            self._max_queued if self._max_queued is not None
+            else n * self._capacity
+        )
+        limit = n * self._capacity + max_queued
+        total = sum(self._inflight.values())
+        if total >= limit:
+            internal_metrics.inc(
+                "ray_tpu_serve_sheds_total", 1,
+                {"deployment": self.deployment_name, "where": "handle"})
+            raise BackPressureError(
+                f"deployment {self.deployment_name!r} is saturated: "
+                f"{total} in flight >= {n} replicas x {self._capacity} "
+                f"slots + {max_queued} queued",
+                retry_after_s=1.0,
+            )
+
     def _send(self, method, args, kwargs, attempt: int = 0,
               stream: bool = False) -> DeploymentResponse:
         self._refresh()
+        if attempt == 0:
+            # death retries were already admitted; re-shedding them would
+            # turn a transient replica loss into spurious 503s
+            with self._lock:
+                self._drain_released_locked()
+                self._check_admission_locked()
         replica = self._pick()
         key = replica._actor_id
         with self._lock:

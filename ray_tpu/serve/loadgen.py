@@ -1,0 +1,401 @@
+"""Sustained-load harness for the serve plane: seeded, open-loop.
+
+The three phases that prove the traffic plane (shared by
+``scripts/serve_smoke.py``, ``bench_core.py``'s serve section and
+``tests/test_serve_load.py``):
+
+  * :func:`measure_continuous_batching` — a decode-style model whose
+    "device" executes one forward pass at a time; iteration-level
+    batching amortizes the pass over up to ``bucket`` lanes, so batched
+    tokens/s must beat the per-request baseline by the lane count.
+  * :func:`measure_overload` — open-loop HTTP load at a multiple of a
+    capacity-limited deployment's throughput: the proxy must shed
+    (503 + Retry-After) instead of queueing unboundedly, keep successful
+    p99 bounded, and recover as soon as the burst passes.
+  * :func:`measure_mux_swap` — many-model multiplexing with weights
+    streamed from the object plane: a cache-miss variant swap (evict +
+    stream + load) must complete sub-second.
+
+Open-loop means schedule-driven: requests fire at their scheduled times
+regardless of how previous ones fared (closed-loop load generators
+coordinate with the system under test and hide latency collapse —
+the "coordinated omission" trap).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import serve
+
+BUCKETS = [1, 2, 4, 8, 16, 32]
+
+
+# ---------------------------------------------------------------------------
+# model stand-ins: the "device" is a lock — one forward pass at a time,
+# each pass costs step_ms whether it carries 1 lane or a full bucket
+# ---------------------------------------------------------------------------
+
+
+class DecodeBatched:
+    """Decode loop under continuous batching: requests join the in-flight
+    batch between steps; a step serves every active lane at once."""
+
+    def __init__(self, step_ms: float = 4.0):
+        self._step_s = step_ms / 1000.0
+        self._device = threading.Lock()
+        self.shapes: set = set()
+
+    @serve.continuous_batch(
+        max_batch_size=32, batch_wait_timeout_s=0.01, bucket_sizes=BUCKETS)
+    def _step(self, seqs):
+        pad = serve.bucket_pad_size(len(seqs), BUCKETS)
+        self.shapes.add(pad)
+        with self._device:
+            time.sleep(self._step_s)  # one fused forward for `pad` lanes
+        for s in seqs:
+            s.state = (s.state or 0) + 1
+            if s.state >= int(s.item.get("tokens", 1)):
+                s.finish(s.state)
+
+    def __call__(self, payload):
+        return self._step(payload)
+
+    def shapes_seen(self):
+        return sorted(self.shapes)
+
+
+class DecodeUnbatched:
+    """Per-request decode baseline: every request pays step_ms per token
+    on the same one-pass-at-a-time device."""
+
+    def __init__(self, step_ms: float = 4.0):
+        self._step_s = step_ms / 1000.0
+        self._device = threading.Lock()
+
+    def __call__(self, payload):
+        tokens = int(payload.get("tokens", 1))
+        for _ in range(tokens):
+            with self._device:
+                time.sleep(self._step_s)
+        return tokens
+
+
+class Sleeper:
+    """Capacity-limited deployment for the overload phase: throughput is
+    exactly max_concurrent_queries / sleep_s per replica."""
+
+    def __init__(self, sleep_ms: float = 25.0):
+        self._sleep_s = sleep_ms / 1000.0
+
+    def __call__(self, payload):
+        time.sleep(self._sleep_s)
+        return "ok"
+
+
+class MuxHost:
+    """Many-model host: at most ``max_num_models_per_replica`` variants
+    resident; misses stream registered weights from the object plane."""
+
+    @serve.multiplexed(max_num_models_per_replica=1)
+    def load_model(self, model_id: str):
+        return serve.fetch_model(model_id)
+
+    def __call__(self, payload):
+        weights = self.load_model(serve.get_multiplexed_model_id())
+        # touch the weights so a lazy/zero-copy read actually materializes
+        return float(weights[0]) + float(weights[-1])
+
+
+# ---------------------------------------------------------------------------
+# load generation
+# ---------------------------------------------------------------------------
+
+
+def open_loop(
+    submit: Callable[[int], Dict[str, Any]],
+    rate_rps: float,
+    duration_s: float,
+    *,
+    seed: int = 0,
+    pool_size: int = 64,
+    join_timeout_s: float = 30.0,
+) -> Dict[str, Any]:
+    """Fire ``submit(i)`` at ``rate_rps`` for ``duration_s`` on a worker
+    pool, schedule-driven with seeded jitter. Returns
+    ``{"results": [...], "stuck": n, "sent": n}`` — ``stuck`` counts
+    requests that had not completed ``join_timeout_s`` after the burst."""
+    rng = random.Random(seed)
+    n = max(1, int(rate_rps * duration_s))
+    offsets = sorted(
+        max(0.0, (i + rng.uniform(-0.3, 0.3)) / rate_rps) for i in range(n)
+    )
+    pool = ThreadPoolExecutor(pool_size, thread_name_prefix="loadgen")
+    futures = []
+    t0 = time.monotonic()
+    for i, off in enumerate(offsets):
+        delay = (t0 + off) - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        futures.append(pool.submit(submit, i))
+    done, not_done = wait(futures, timeout=join_timeout_s)
+    results = [f.result() for f in done if f.exception() is None]
+    results += [
+        {"status": "exception", "error": repr(f.exception())}
+        for f in done
+        if f.exception() is not None
+    ]
+    pool.shutdown(wait=False)
+    return {"results": results, "stuck": len(not_done), "sent": n}
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return float("nan")
+    s = sorted(values)
+    idx = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[idx]
+
+
+def _post(url: str, payload: Any, timeout: float = 30.0) -> Dict[str, Any]:
+    """POST JSON; never raises — shed (503) and errors come back as data."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = resp.read()
+            return {
+                "status": resp.status,
+                "latency_s": time.monotonic() - t0,
+                "body": body,
+            }
+    except urllib.error.HTTPError as e:
+        return {
+            "status": e.code,
+            "latency_s": time.monotonic() - t0,
+            "retry_after": e.headers.get("Retry-After"),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {
+            "status": "error",
+            "latency_s": time.monotonic() - t0,
+            "error": repr(e),
+        }
+
+
+# ---------------------------------------------------------------------------
+# phase 1: continuous batching vs per-request execution
+# ---------------------------------------------------------------------------
+
+
+def _fire_handle(handle, payload, count, timeout_s=120.0):
+    out: List[Any] = []
+    errs: List[BaseException] = []
+
+    def worker():
+        try:
+            out.append(handle.remote(payload).result(timeout=timeout_s))
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(count)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s)
+    return time.monotonic() - t0, out, errs
+
+
+def measure_continuous_batching(
+    *,
+    concurrency: int = 32,
+    tokens: int = 6,
+    step_ms: float = 4.0,
+    timeout: float = 90.0,
+) -> Dict[str, Any]:
+    """Tokens/s of the continuous-batching decode model vs the per-request
+    baseline on the same serialized device, at ``concurrency`` callers."""
+    result: Dict[str, Any] = {
+        "concurrency": concurrency, "tokens": tokens, "step_ms": step_ms,
+    }
+    payload = {"tokens": tokens}
+
+    batched = serve.deployment(
+        DecodeBatched,
+        name="loadgen_batched",
+        max_concurrent_queries=concurrency,
+        max_queued_requests=concurrency,
+    ).bind(step_ms)
+    h = serve.run(batched, timeout=timeout)
+    try:
+        _fire_handle(h, payload, min(4, concurrency))  # warm the scheduler
+        elapsed, out, errs = _fire_handle(h, payload, concurrency)
+        if errs:
+            raise errs[0]
+        result["batched_tokens_per_s"] = concurrency * tokens / elapsed
+        result["shapes"] = h.shapes_seen.remote().result(timeout=30)
+    finally:
+        serve.delete("loadgen_batched")
+
+    unbatched = serve.deployment(
+        DecodeUnbatched,
+        name="loadgen_unbatched",
+        max_concurrent_queries=concurrency,
+        max_queued_requests=concurrency,
+    ).bind(step_ms)
+    h = serve.run(unbatched, timeout=timeout)
+    try:
+        _fire_handle(h, payload, min(4, concurrency))
+        elapsed, out, errs = _fire_handle(h, payload, concurrency)
+        if errs:
+            raise errs[0]
+        result["unbatched_tokens_per_s"] = concurrency * tokens / elapsed
+    finally:
+        serve.delete("loadgen_unbatched")
+
+    result["speedup_x"] = (
+        result["batched_tokens_per_s"] / result["unbatched_tokens_per_s"]
+    )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# phase 2: overload -> shed -> recover (through the HTTP proxy)
+# ---------------------------------------------------------------------------
+
+
+def measure_overload(
+    *,
+    sleep_ms: float = 25.0,
+    max_concurrent: int = 2,
+    max_queued: int = 8,
+    rate_multiplier: float = 2.0,
+    burst_s: float = 2.5,
+    seed: int = 0,
+    timeout: float = 90.0,
+    proxy=None,
+) -> Dict[str, Any]:
+    """Open-loop burst at ``rate_multiplier``x a deployment's capacity.
+
+    Asserts nothing itself — returns counts and latencies for callers to
+    bound: ``ok``/``shed``/``errors``/``stuck``, successful ``p99_s``,
+    and ``recovery_s`` (time after the burst until a probe request
+    responds within 3x the service time)."""
+    dep = serve.deployment(
+        Sleeper,
+        name="loadgen_overload",
+        max_concurrent_queries=max_concurrent,
+        max_queued_requests=max_queued,
+    ).bind(sleep_ms)
+    serve.run(dep, timeout=timeout)
+    own_proxy = proxy is None
+    if own_proxy:
+        proxy = serve.start_http_proxy()
+    url = proxy.address + "/loadgen_overload"
+    capacity_rps = max_concurrent / (sleep_ms / 1000.0)
+    rate = capacity_rps * rate_multiplier
+    try:
+        _post(url, {}, timeout=30.0)  # warm the route
+
+        burst = open_loop(
+            lambda i: _post(url, {"i": i}, timeout=30.0),
+            rate, burst_s, seed=seed, join_timeout_s=timeout / 2,
+        )
+        burst_end = time.monotonic()
+
+        ok = [r for r in burst["results"] if r.get("status") == 200]
+        shed = [r for r in burst["results"] if r.get("status") == 503]
+        errors = [
+            r for r in burst["results"]
+            if r.get("status") not in (200, 503)
+        ]
+        # recovery probe: sequential requests until latency is back to
+        # ~service time (3x margin absorbs scheduler noise)
+        base_s = sleep_ms / 1000.0
+        recovery_s = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            probe = _post(url, {"probe": True}, timeout=30.0)
+            if (probe.get("status") == 200
+                    and probe["latency_s"] <= 3.0 * base_s + 0.25):
+                recovery_s = time.monotonic() - burst_end
+                break
+            time.sleep(0.1)
+        return {
+            "capacity_rps": capacity_rps,
+            "offered_rps": rate,
+            "sent": burst["sent"],
+            "ok": len(ok),
+            "shed": len(shed),
+            "errors": len(errors),
+            "stuck": burst["stuck"],
+            "p99_s": _percentile([r["latency_s"] for r in ok], 0.99),
+            "p50_s": _percentile([r["latency_s"] for r in ok], 0.50),
+            "recovery_s": recovery_s,
+            "retry_after_seen": any(r.get("retry_after") for r in shed),
+        }
+    finally:
+        if own_proxy:
+            proxy.stop()
+        serve.delete("loadgen_overload")
+
+
+# ---------------------------------------------------------------------------
+# phase 3: multiplex variant swap via object-plane weight streaming
+# ---------------------------------------------------------------------------
+
+
+def measure_mux_swap(
+    *,
+    weight_mb: float = 4.0,
+    n_models: int = 3,
+    timeout: float = 90.0,
+) -> Dict[str, Any]:
+    """Cold-swap latency of a multiplexed variant whose weights stream in
+    from the object plane. The host keeps ONE model resident, so every
+    alternation is a full evict + stream + load."""
+    import numpy as np
+
+    dep = serve.deployment(
+        MuxHost, name="loadgen_mux", max_concurrent_queries=4,
+    ).bind()
+    h = serve.run(dep, timeout=timeout)
+    model_ids = [f"variant-{i}" for i in range(n_models)]
+    floats = max(2, int(weight_mb * 1e6 / 8))
+    for i, mid in enumerate(model_ids):
+        serve.register_model(mid, np.full(floats, float(i), dtype=np.float64))
+    try:
+        def request(mid):
+            t0 = time.monotonic()
+            h.options(multiplexed_model_id=mid).remote({}).result(
+                timeout=timeout)
+            return (time.monotonic() - t0) * 1000.0
+
+        cold_first_ms = request(model_ids[0])   # includes actor cold start
+        warm_ms = request(model_ids[0])         # cache hit
+        swaps = []
+        for i in range(1, n_models):            # each one evicts the last
+            swaps.append(request(model_ids[i]))
+        swaps.append(request(model_ids[0]))     # and back: evicted earlier
+        return {
+            "weight_mb": weight_mb,
+            "cold_first_ms": cold_first_ms,
+            "warm_ms": warm_ms,
+            "cold_swap_ms": max(swaps),
+            "cold_swap_avg_ms": sum(swaps) / len(swaps),
+        }
+    finally:
+        serve.delete("loadgen_mux")

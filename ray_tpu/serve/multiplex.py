@@ -6,8 +6,15 @@ whose loader is decorated with ``@serve.multiplexed`` serves any number of
 model ids with at most ``max_num_models_per_replica`` resident per
 replica; requests carry a model id (``handle.options(multiplexed_model_id=
 ...)``) and the handle routes a given model id stickily to the replica
-that last served it, approximating the reference's cache-aware routing
-without a control-plane round trip.
+that last served it. At scale the controller additionally aggregates each
+replica's resident model ids into the routing table, so a *cold* handle
+(or a model evicted elsewhere) still lands on a replica that already
+holds the weights (cache-aware placement).
+
+Weights themselves move over the object plane: ``register_model`` puts a
+weight pytree into the object store once, and replicas ``fetch_model`` it
+inside their loader — a zero-copy plasma read (345 Gbps on the bench),
+which is what makes a cache-miss variant swap sub-second.
 """
 
 from __future__ import annotations
@@ -15,8 +22,11 @@ from __future__ import annotations
 import contextvars
 import inspect
 import threading
+import time
 from collections import OrderedDict
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private import internal_metrics
 
 _current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
     "serve_multiplexed_model_id", default=""
@@ -35,23 +45,32 @@ class _MultiplexWrapper:
         self._loader = loader
         self._owner = owner
         self._max = max_models
+        self._name = getattr(loader, "__name__", "loader")
         self._models: "OrderedDict[str, Any]" = OrderedDict()
         self._lock = threading.Lock()
         # model id -> Event while a load is in flight: concurrent first
         # requests must not each load the same weights (transient 2x HBM)
         self._loading: dict = {}
 
+    def _event(self, event: str, n: int = 1) -> None:
+        internal_metrics.inc(
+            "ray_tpu_serve_mux_cache_events_total", n,
+            {"loader": self._name, "event": event})
+
     def load(self, model_id: str):
         while True:
             with self._lock:
                 if model_id in self._models:
                     self._models.move_to_end(model_id)
+                    self._event("hit")
                     return self._models[model_id]
                 pending = self._loading.get(model_id)
                 if pending is None:
                     self._loading[model_id] = threading.Event()
                     break
             pending.wait(timeout=300)  # another request is loading it
+        self._event("miss")
+        t0 = time.monotonic()
         try:
             # load outside the lock: loading can be slow and concurrent
             # requests for resident models must not queue behind it
@@ -70,6 +89,14 @@ class _MultiplexWrapper:
                 while len(self._models) > self._max:
                     evicted_id, evicted = self._models.popitem(last=False)
                     del evicted  # drop the only ref; __del__ may free HBM
+                    self._event("evict")
+                resident = len(self._models)
+            internal_metrics.observe(
+                "ray_tpu_serve_mux_load_seconds", time.monotonic() - t0,
+                {"loader": self._name})
+            internal_metrics.set_gauge(
+                "ray_tpu_serve_mux_models_resident", resident,
+                {"loader": self._name})
             return model
         finally:
             with self._lock:
@@ -78,6 +105,18 @@ class _MultiplexWrapper:
     def loaded_ids(self):
         with self._lock:
             return list(self._models)
+
+
+def loaded_model_ids(instance: Any) -> list:
+    """All model ids resident in ``instance``'s multiplex caches — what a
+    replica reports to the controller for cache-aware placement."""
+    ids: list = []
+    # bound loaders live at _serve_mux_<name> on the instance; unbound
+    # (function) loaders carry the wrapper in the function's own __dict__
+    for value in list(getattr(instance, "__dict__", {}).values()):
+        if isinstance(value, _MultiplexWrapper):
+            ids.extend(value.loaded_ids())
+    return sorted(set(ids))
 
 
 def multiplexed(func: Optional[Callable] = None, *,
@@ -119,3 +158,65 @@ def multiplexed(func: Optional[Callable] = None, *,
         return unbound
 
     return deco if func is None else deco(func)
+
+
+# ---------------------------------------------------------------------------
+# model weight registry: weights live in the object plane, ids in the
+# controller — a loader calls fetch_model() and streams the pytree in
+# ---------------------------------------------------------------------------
+
+# per-process ref cache: one controller round trip per model id, ever
+_model_ref_cache: Dict[str, Any] = {}
+
+
+def _controller():
+    import ray_tpu
+    from .controller import CONTROLLER_NAME
+
+    return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+def register_model(model_id: str, weights: Any, *, timeout: float = 60.0):
+    """Publish ``weights`` (any serializable pytree) under ``model_id``.
+
+    The weights are put into the object store once; the controller pins the
+    ref so any replica can :func:`fetch_model` it. Returns the ObjectRef.
+    """
+    import ray_tpu
+
+    ref = ray_tpu.put(weights)
+    # wrapped in a list: a bare top-level ObjectRef arg is resolved at the
+    # callee, and the registry must pin the ref, not a copy of the weights
+    ray_tpu.get(
+        _controller().register_model.remote(model_id, [ref]), timeout=timeout)
+    # pin locally too: reference counting is owner-local, so if the caller
+    # drops the returned ref the owner would free weights the controller
+    # still advertises
+    _model_ref_cache[model_id] = ref
+    return ref
+
+
+def fetch_model(model_id: str, *, timeout: float = 60.0) -> Any:
+    """Inside a loader: stream ``model_id``'s registered weights from the
+    object plane (zero-copy plasma read on the local node when resident)."""
+    import ray_tpu
+
+    ref = _model_ref_cache.get(model_id)
+    if ref is None:
+        wrapped = ray_tpu.get(
+            _controller().get_model_ref.remote(model_id), timeout=timeout)
+        if not wrapped:
+            raise KeyError(f"model {model_id!r} is not registered")
+        ref = wrapped[0]
+        _model_ref_cache[model_id] = ref
+    return ray_tpu.get(ref, timeout=timeout)
+
+
+def list_models(*, timeout: float = 30.0) -> list:
+    """Model ids currently registered with the controller."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get(_controller().list_models.remote(), timeout=timeout)
+    except Exception:
+        return []

@@ -20,7 +20,7 @@ class Replica:
     # health/metrics bypass the user-request concurrency cap (the
     # reference's control concurrency group): a saturated replica must
     # still answer the controller's probes, or the autoscaler samples 0
-    __ray_control_methods__ = ("get_metrics", "health")
+    __ray_control_methods__ = ("get_metrics", "health", "drain")
 
     def __init__(self, deployment_name: str, func_or_class, init_args, init_kwargs,
                  user_config=None):
@@ -119,8 +119,24 @@ class Replica:
         )
 
     def get_metrics(self) -> Dict[str, Any]:
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
         with self._lock:
-            return {"ongoing": self._ongoing, "total": self._total, "ts": time.time()}
+            ongoing, total = self._ongoing, self._total
+        return {
+            "ongoing": ongoing,
+            "total": total,
+            "models": loaded_model_ids(self._callable),
+            "ts": time.time(),
+        }
+
+    def drain(self) -> bool:
+        """Controller calls this before a graceful scale-down kill: flush
+        replica-side batcher queues so no admitted request is dropped."""
+        from ray_tpu.serve.batching import shutdown_batchers
+
+        shutdown_batchers(drain=True)
+        return True
 
     def health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

@@ -27,6 +27,8 @@ _DEPLOYMENT_FIELDS: Dict[str, Tuple[Any, bool, Any]] = {
     "autoscaling_config": ((dict, type(None)), False, None),
     "resources": ((dict, type(None)), False, None),
     "max_concurrent_queries": (int, False, 8),
+    "max_queued_requests": ((int, type(None)), False, None),
+    "drain_grace_s": ((int, float), False, 30.0),
     "route_prefix": ((str, type(None)), False, None),
 }
 

@@ -42,10 +42,18 @@ CHECKS = (
     "put_gbps",
     "allreduce_gbps",
     "reducescatter_gbps",
+    "serve_batched_tokens_per_s",
 )
 # lower-is-better rows: warn when the measured value exceeds the archived
 # value divided by FLOOR_FRACTION (the mirror image of the floor checks)
 CEILING_CHECKS = ("sharded_update_step_ms",)
+# lower-is-better rows whose bound is an absolute acceptance bar, not an
+# archive fraction: swap latency must stay sub-second and overload
+# recovery within seconds regardless of what a quiet box once recorded
+ABS_CEILINGS = {
+    "serve_mux_swap_ms": 1000.0,
+    "serve_shed_recovery_s": 5.0,
+}
 
 # hard gate: fraction of the archived r05 value (BENCH_CORE_r05.json) the
 # claimed rows must clear on ANY box state — see module docstring for why
@@ -208,6 +216,31 @@ def main() -> int:
     for r in ranks:
         ray_tpu.kill(r)
 
+    # serve plane (warn rows): same parameters as bench_core's serve
+    # section so the tokens/s floor compares against the archived round
+    from ray_tpu import serve as _serve
+    from ray_tpu.serve import loadgen as _loadgen
+
+    try:
+        cb = _loadgen.measure_continuous_batching(
+            concurrency=32, tokens=6, step_ms=4.0)
+        results["serve_batched_tokens_per_s"] = cb["batched_tokens_per_s"]
+        ov = _loadgen.measure_overload(
+            sleep_ms=25.0, max_concurrent=2, max_queued=8,
+            rate_multiplier=2.0, burst_s=2.5, seed=20260807)
+        if ov["recovery_s"] is not None and not ov["stuck"]:
+            results["serve_shed_recovery_s"] = ov["recovery_s"]
+        mux = _loadgen.measure_mux_swap(weight_mb=4.0, n_models=3)
+        results["serve_mux_swap_ms"] = mux["cold_swap_ms"]
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "serve_plane", "error": str(e)[-300:]}),
+              flush=True)
+    finally:
+        try:
+            _serve.shutdown()
+        except Exception:
+            pass
+
     ray_tpu.shutdown()
 
     failed = False
@@ -239,6 +272,8 @@ def main() -> int:
         if key in R05_VALUES:
             continue  # already hard-gated above
         value = results.get(key)
+        if value is None:
+            continue  # leg errored; the error line already printed
         base = baseline.get(key)
         floor = base * FLOOR_FRACTION if base else None
         line = {
@@ -257,6 +292,8 @@ def main() -> int:
             )
     for key in CEILING_CHECKS:
         value = results.get(key)
+        if value is None:
+            continue
         base = baseline.get(key)
         ceiling = base / FLOOR_FRACTION if base else None
         line = {
@@ -272,6 +309,20 @@ def main() -> int:
                 f"(archived {base:.2f} / {FLOOR_FRACTION:.0%}) — possible "
                 "collective-plane regression (or shared-box noise; re-run "
                 "to confirm)",
+                flush=True,
+            )
+    for key, ceiling in ABS_CEILINGS.items():
+        value = results.get(key)
+        if value is None:
+            continue
+        print(json.dumps({"metric": key, "value": round(value, 3),
+                          "ceiling": ceiling}), flush=True)
+        if value > ceiling:
+            warned = True
+            print(
+                f"WARN: {key} = {value:.2f} above absolute ceiling "
+                f"{ceiling:.2f} — serve-plane regression (or shared-box "
+                "noise; re-run to confirm)",
                 flush=True,
             )
     if failed:

@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Per-PR serve-plane smoke (<60 s): continuous batching, admission
+control / load shedding, many-model multiplexing — the loadgen harness's
+three phases with hard bounds.
+
+Hard-fails (nonzero exit) when any leg breaks:
+  1. Continuous batching: iteration-level scheduling on a one-pass-at-a-
+     time device beats the per-request baseline >= 2x at concurrency 32,
+     and every executed batch shape is a declared bucket size.
+  2. Overload: an open-loop burst at 2x a deployment's capacity sheds
+     (503 + Retry-After) instead of queueing unboundedly, keeps
+     successful p99 bounded, leaves zero stuck requests, and latency
+     recovers within seconds of the burst ending.
+  3. Multiplex swap: a cache-miss variant swap (evict + object-plane
+     weight streaming + load) completes sub-second.
+
+Usage: env JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 20260807
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL serve_smoke: {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    t_start = time.time()
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import loadgen
+
+    ray_tpu.init(num_cpus=8, log_level="ERROR")
+    try:
+        # --- leg 1: continuous batching >= 2x per-request baseline
+        cb = loadgen.measure_continuous_batching(
+            concurrency=32, tokens=6, step_ms=4.0)
+        if cb["speedup_x"] < 2.0:
+            fail(f"continuous batching speedup {cb['speedup_x']:.2f}x < 2x "
+                 f"({cb['batched_tokens_per_s']:.0f} vs "
+                 f"{cb['unbatched_tokens_per_s']:.0f} tok/s)")
+        bad_shapes = set(cb["shapes"]) - set(loadgen.BUCKETS)
+        if bad_shapes:
+            fail(f"non-bucket batch shapes executed: {sorted(bad_shapes)}")
+        print(f"OK   continuous batching: "
+              f"{cb['batched_tokens_per_s']:.0f} tok/s batched vs "
+              f"{cb['unbatched_tokens_per_s']:.0f} unbatched "
+              f"({cb['speedup_x']:.1f}x), shapes={cb['shapes']}")
+
+        # --- leg 2: overload -> shed -> recover
+        ov = loadgen.measure_overload(
+            sleep_ms=25.0, max_concurrent=2, max_queued=8,
+            rate_multiplier=2.0, burst_s=2.5, seed=SEED)
+        if ov["stuck"]:
+            fail(f"{ov['stuck']} requests stuck after the burst")
+        if not ov["shed"]:
+            fail(f"no sheds at {ov['offered_rps']:.0f} rps offered vs "
+                 f"{ov['capacity_rps']:.0f} rps capacity")
+        if not ov["retry_after_seen"]:
+            fail("shed responses carried no Retry-After header")
+        if ov["errors"]:
+            fail(f"{ov['errors']} non-200/503 responses under overload")
+        if ov["p99_s"] > 2.0:
+            fail(f"successful p99 {ov['p99_s']:.2f}s > 2s under overload")
+        if ov["recovery_s"] is None or ov["recovery_s"] > 5.0:
+            fail(f"latency did not recover within 5s (got {ov['recovery_s']})")
+        shed_rate = ov["shed"] / ov["sent"]
+        print(f"OK   overload: {ov['sent']} sent @2x capacity -> "
+              f"{ov['ok']} ok / {ov['shed']} shed ({shed_rate:.0%}), "
+              f"p99={ov['p99_s']*1e3:.0f}ms, "
+              f"recovered in {ov['recovery_s']:.2f}s")
+
+        # --- leg 3: sub-second multiplex swap
+        mux = loadgen.measure_mux_swap(weight_mb=4.0, n_models=3)
+        if mux["cold_swap_ms"] >= 1000.0:
+            fail(f"multiplex cold swap {mux['cold_swap_ms']:.0f}ms >= 1s "
+                 f"({mux['weight_mb']}MB weights)")
+        print(f"OK   multiplex: cold swap {mux['cold_swap_ms']:.0f}ms "
+              f"(warm {mux['warm_ms']:.1f}ms, {mux['weight_mb']}MB weights)")
+
+        print(json.dumps({
+            "batched_tokens_per_s": round(cb["batched_tokens_per_s"], 1),
+            "speedup_x": round(cb["speedup_x"], 2),
+            "shed_rate": round(shed_rate, 3),
+            "overload_p99_ms": round(ov["p99_s"] * 1e3, 1),
+            "shed_recovery_s": round(ov["recovery_s"], 3),
+            "mux_swap_ms": round(mux["cold_swap_ms"], 1),
+        }))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    print(f"PASS serve_smoke in {time.time() - t_start:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,9 @@ env JAX_PLATFORMS=cpu python scripts/bench_smoke.py
 bash scripts/chaos_smoke.sh
 # perf plane end to end: phase tracing, cluster flamegraph, overhead budgets
 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py
+# serve plane under load: continuous batching >=2x, shed -> recover at 2x
+# capacity, sub-second multiplex swap
+env JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
     tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py \
     tests/test_perf_plane.py "$@"

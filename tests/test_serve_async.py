@@ -34,7 +34,9 @@ def _post(url: str, payload, timeout=90):
 
 
 def test_async_proxy_100_concurrent_no_thread_growth(serve_cluster):
-    @serve.deployment(num_replicas=2)
+    # sized for the burst: admission control (2 x 32 slots + queue) must
+    # not shed — this test measures thread growth, not overload behavior
+    @serve.deployment(num_replicas=2, max_concurrent_queries=32)
     def double(x):
         return x * 2
 

@@ -1,0 +1,413 @@
+"""Serve plane under production traffic: continuous batching, admission
+control / shedding with exactly-once in-flight accounting, many-model
+multiplexing, and chaos interactions (replica kill mid-burst, partition
+under a fault schedule — slow-marked)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import loadgen
+from ray_tpu.serve.batching import bucket_pad_size, shutdown_batchers
+from ray_tpu.serve.controller import CONTROLLER_NAME
+
+
+@pytest.fixture
+def serve_session(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def _await(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: iteration-level scheduling (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_pad_size():
+    assert bucket_pad_size(1, [1, 2, 4]) == 1
+    assert bucket_pad_size(3, [1, 2, 4]) == 4
+    assert bucket_pad_size(4, [4, 2, 1]) == 4  # order-insensitive
+    assert bucket_pad_size(9, [1, 2, 4]) == 4  # above the largest: clamp
+
+
+def test_continuous_batch_admits_between_steps():
+    """A request arriving while a batch is mid-flight joins the in-flight
+    batch at the next step boundary — it does NOT wait for the whole
+    previous batch to finish (the static-batcher behavior)."""
+
+    class Decode:
+        def __init__(self):
+            self.step_items = []
+
+        @serve.continuous_batch(
+            max_batch_size=4, batch_wait_timeout_s=0.01, bucket_sizes=[1, 2, 4]
+        )
+        def step(self, seqs):
+            self.step_items.append(sorted(s.item for s in seqs))
+            time.sleep(0.05)
+            for s in seqs:
+                s.state = (s.state or 0) + 1
+                if s.state >= s.item:
+                    s.finish(s.state)
+
+    d = Decode()
+    results = {}
+
+    def call(tokens):
+        results[tokens] = d.step(tokens)
+
+    # two 6-step sequences start the loop; a 1-step request lands while
+    # they are still decoding
+    t_a = threading.Thread(target=call, args=(6,))
+    t_b = threading.Thread(target=call, args=(5,))
+    t_a.start(), t_b.start()
+    time.sleep(0.15)
+    t_c = threading.Thread(target=call, args=(1,))
+    t_c.start()
+    for t in (t_a, t_b, t_c):
+        t.join(timeout=10)
+    assert results == {6: 6, 5: 5, 1: 1}
+    # the late request shared at least one step with an in-flight sequence
+    assert any(
+        1 in items and len(items) > 1 for items in d.step_items
+    ), d.step_items
+    shutdown_batchers(d)
+
+
+def test_continuous_batch_step_failure_poisons_batch_not_loop():
+    class Boomer:
+        @serve.continuous_batch(max_batch_size=2, batch_wait_timeout_s=0.005)
+        def step(self, seqs):
+            for s in seqs:
+                if s.item == "boom":
+                    raise ValueError("boom")
+                s.finish(s.item)
+
+    b = Boomer()
+    with pytest.raises(ValueError):
+        b.step("boom")
+    # the scheduler loop survives a poisoned batch
+    assert b.step("ok") == "ok"
+    shutdown_batchers(b)
+
+
+def test_batcher_per_instance_lifecycle():
+    """Each instance gets its own batcher; collecting the instance reaps
+    the flusher thread (no id-reuse aliasing, no leaked threads)."""
+    import gc
+
+    class M:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.005)
+        def f(self, items):
+            return [i * 2 for i in items]
+
+    def names():
+        return sorted(
+            t.name for t in threading.enumerate()
+            if t.name.startswith("serve-batch:")
+        )
+
+    a, b = M(), M()
+    assert a.f(1) == 2 and b.f(2) == 4
+    assert len(names()) == 2  # one flusher per instance, not per class
+    del a
+    gc.collect()
+    _await(lambda: len(names()) == 1, 5, "dead instance's flusher reaped")
+    assert shutdown_batchers(b) == 1
+    _await(lambda: len(names()) == 0, 5, "shutdown drains the flusher")
+    assert b.f(3) == 6  # re-materializes on next call
+    shutdown_batchers(b)
+
+
+# ---------------------------------------------------------------------------
+# admission control: shed + exactly-once in-flight accounting
+# ---------------------------------------------------------------------------
+
+
+def test_handle_sheds_at_limit_and_accounting_is_exact(serve_session):
+    @serve.deployment(max_concurrent_queries=1, max_queued_requests=1)
+    class Slow:
+        def __call__(self, p):
+            time.sleep(float(p.get("sleep", 0.5)))
+            return "done"
+
+    h = serve.run(Slow.bind())
+    r1 = h.remote({"sleep": 0.5})
+    r2 = h.remote({"sleep": 0.5})
+    assert h._inflight_total() == 2
+    # limit = 1 replica x 1 slot + 1 queued: the third send sheds
+    # synchronously, BEFORE taking an in-flight slot
+    with pytest.raises(serve.BackPressureError) as exc:
+        h.remote({"sleep": 0.5})
+    assert exc.value.retry_after_s > 0
+    assert h._inflight_total() == 2  # shed request took no slot
+    assert r1.result(timeout=30) == "done"
+    assert r2.result(timeout=30) == "done"
+    assert h._inflight_total() == 0  # both slots released exactly once
+    # capacity freed: sends are admitted again
+    assert h.remote({"sleep": 0.0}).result(timeout=30) == "done"
+    assert h._inflight_total() == 0
+
+
+def test_cancel_releases_slot_exactly_once(serve_session):
+    """Satellite regression: a cancelled request decrements in-flight
+    accounting exactly once — repeated cancels (or cancel + __del__) must
+    not double-release and mask real load from the admission check."""
+
+    @serve.deployment(max_concurrent_queries=4)
+    class Sleepy:
+        def __call__(self, p):
+            time.sleep(5.0)
+            return "late"
+
+    h = serve.run(Sleepy.bind())
+    r1 = h.remote({})
+    r2 = h.remote({})
+    assert h._inflight_total() == 2
+    r1.cancel()
+    assert h._inflight_total() == 1
+    r1.cancel()  # idempotent: second cancel must not release r2's slot
+    r1._finish_once()
+    assert h._inflight_total() == 1
+    r2.cancel()
+    assert h._inflight_total() == 0
+
+
+def test_http_overload_sheds_and_recovers(serve_session):
+    """Open-loop HTTP burst at 2x capacity: 503 + Retry-After sheds, zero
+    stuck requests, bounded p99 for the admitted ones, fast recovery."""
+    ov = loadgen.measure_overload(
+        sleep_ms=20.0, max_concurrent=2, max_queued=6,
+        rate_multiplier=2.0, burst_s=1.2, seed=11)
+    assert ov["stuck"] == 0
+    assert ov["shed"] > 0, ov
+    assert ov["errors"] == 0, ov
+    assert ov["retry_after_seen"]
+    assert ov["p99_s"] < 2.0, ov
+    assert ov["recovery_s"] is not None and ov["recovery_s"] < 5.0, ov
+
+
+# ---------------------------------------------------------------------------
+# many-model multiplexing at scale
+# ---------------------------------------------------------------------------
+
+
+def test_multiplex_streams_weights_and_routes_by_model(serve_session):
+    import numpy as np
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4)
+    class Host:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def load(self, model_id):
+            return serve.fetch_model(model_id)
+
+        def __call__(self, p):
+            w = self.load(serve.get_multiplexed_model_id())
+            return float(w[0])
+
+    h = serve.run(Host.bind())
+    for i in range(3):
+        serve.register_model(f"m{i}", np.full(64, float(i)))
+    assert set(serve.list_models()) >= {"m0", "m1", "m2"}
+
+    for i in range(3):
+        hm = h.options(multiplexed_model_id=f"m{i}")
+        assert hm.remote({}).result(timeout=30) == float(i)
+    # repeated calls stay sticky to the replica that holds the weights
+    assert set(h._model_affinity) >= {"m0", "m1", "m2"}
+    sticky = h._model_affinity["m0"]
+    for _ in range(3):
+        assert h.options(
+            multiplexed_model_id="m0").remote({}).result(timeout=30) == 0.0
+    assert h._model_affinity["m0"] == sticky
+
+    # the controller's metric poll learns which replica holds which model,
+    # so even a cold handle routes fetches to resident weights
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def locations():
+        table = ray_tpu.get(
+            controller.get_routing_table.remote("Host"), timeout=10)
+        return table.get("model_locations") or {}
+
+    _await(lambda: "m0" in locations(), 15, "model locations published")
+    assert all(v for v in locations().values())
+
+    with pytest.raises(KeyError):
+        serve.fetch_model("never-registered")
+
+
+def test_multiplex_swap_is_subsecond(serve_session):
+    mux = loadgen.measure_mux_swap(weight_mb=2.0, n_models=2)
+    assert mux["cold_swap_ms"] < 1000.0, mux
+    assert mux["warm_ms"] <= mux["cold_first_ms"]
+
+
+# ---------------------------------------------------------------------------
+# chaos interactions (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_replica_kill_mid_burst_no_stuck_requests(serve_session):
+    """Kill a replica in the middle of an open-loop burst: in-flight
+    requests retry onto surviving replicas, nothing gets stuck, no
+    in-flight slot leaks, and the controller heals back to target."""
+
+    @serve.deployment(num_replicas=2, max_concurrent_queries=4,
+                      max_queued_requests=64)
+    class S:
+        def __call__(self, p):
+            time.sleep(0.02)
+            return "ok"
+
+    h = serve.run(S.bind())
+    h._refresh(force=True)
+    victim = h._replicas[0]
+
+    def submit(i):
+        try:
+            return {"status": h.remote({}).result(timeout=30)}
+        except serve.BackPressureError:
+            return {"status": "shed"}
+
+    killer = threading.Timer(0.6, lambda: ray_tpu.kill(victim))
+    killer.start()
+    out = loadgen.open_loop(submit, 80, 2.0, seed=3, join_timeout_s=60)
+    killer.join()
+    assert out["stuck"] == 0
+    statuses = [r.get("status") for r in out["results"]]
+    assert statuses.count("ok") > 0
+    # every request resolved to ok or shed — none leaked an exception
+    assert set(statuses) <= {"ok", "shed"}, set(statuses)
+    assert h._inflight_total() == 0
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    _await(
+        lambda: len(ray_tpu.get(
+            controller.get_routing_table.remote("S"), timeout=10
+        )["replicas"]) == 2,
+        30, "controller heals back to 2 replicas",
+    )
+    # the healed deployment serves
+    assert h.remote({}).result(timeout=30) == "ok"
+
+
+@pytest.mark.slow
+def test_partition_under_fault_schedule_recovers():
+    """Partition the node hosting a replica away from the proxy's node
+    under a seeded FaultSchedule: requests during the partition resolve
+    (rerouted, shed, or failed — never stuck), and after healing the
+    route serves cleanly again."""
+    import json
+    import urllib.request
+
+    from ray_tpu import chaos
+    from ray_tpu._private.config import GlobalConfig
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = {
+        "health_check_period_s": 0.4,
+        "health_check_failure_threshold": 4,
+        "chaos_probe_period_s": 0.25,
+        "probe_timeout_s": 0.3,
+        "probe_failure_threshold": 2,
+        "degraded_window_s": 60.0,
+        "resource_broadcast_period_s": 0.2,
+    }
+    saved = dict(GlobalConfig._values)
+    GlobalConfig.initialize(cfg)
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "resources": {"head": 1.0}},
+    )
+    proxy = None
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes()
+        ray_tpu.init(address=cluster.address, log_level="ERROR")
+
+        @serve.deployment(num_replicas=2, max_concurrent_queries=4,
+                          max_queued_requests=64)
+        class S:
+            def __call__(self, p):
+                time.sleep(0.01)
+                return "ok"
+
+        serve.run(S.bind(), timeout=60)
+        proxy = serve.start_http_proxy()
+        url = proxy.address + "/S"
+
+        def post(timeout=8.0):
+            req = urllib.request.Request(
+                url, data=b"{}",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+            except Exception:
+                return "error"
+
+        assert post() == 200
+
+        chaos.apply(
+            {"seed": 13,
+             "rules": [{"action": "partition",
+                        "nodes": ["head", "node1"]}]},
+            address=cluster.address,
+        )
+        # a short burst rides through the partition: every request must
+        # resolve one way or another within the join window
+        out = loadgen.open_loop(
+            lambda i: {"status": post()}, 15, 1.5, seed=13,
+            join_timeout_s=90)
+        assert out["stuck"] == 0
+        assert len(out["results"]) == out["sent"]
+
+        # read the injection log BEFORE clearing (clear resets schedules);
+        # the partition may need another probe period to register drops
+        _await(
+            lambda: chaos.report(
+                address=cluster.address)["total_injected"] > 0,
+            20, "injected faults recorded",
+        )
+        chaos.clear(address=cluster.address)
+
+        # healed: 10 consecutive probes succeed with sane latency (single
+        # probes can still catch the tail of RPC reconnection)
+        def ten_clean_probes():
+            for _ in range(10):
+                t0 = time.monotonic()
+                if post(timeout=8.0) != 200 or time.monotonic() - t0 >= 2.0:
+                    return False
+            return True
+
+        _await(ten_clean_probes, 90, "route heals after the partition clears")
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        cluster.shutdown()
+        with GlobalConfig._lock:
+            GlobalConfig._values = saved
