@@ -140,6 +140,12 @@ class _Config:
         # opt-in distributed tracing: span context propagates through
         # nested task submits (reference: util/tracing/tracing_helper.py)
         "tracing_enabled": False,
+        # head-based trace sampling rate in [0, 1] for the distributed
+        # tracing plane (_private/trace.py): 0 disables the plane entirely
+        # (hot-path hooks cost one attribute read); > 0 mints a TraceContext
+        # at driver submit / serve ingress and samples that fraction of
+        # traces. Task errors force-record their span regardless.
+        "trace_sample": 0.0,
         "task_events_buffer_size": 100_000,
         "metrics_report_period_s": 5.0,
         "log_dir": "",

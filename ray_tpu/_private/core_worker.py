@@ -39,6 +39,7 @@ import cloudpickle
 from ray_tpu._private import fault_injection
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
+from ray_tpu._private import trace as _trace
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private import object_store as object_store_mod
@@ -157,6 +158,7 @@ class CoreWorker:
         self._counter_lock = threading.Lock()
         self._current_task_id = TaskID.for_driver_task(job_id)
         self._task_ctx = threading.local()
+        _trace.init_from_config()
 
         # chaos attribution: this worker belongs to its raylet's node, so
         # partition rules naming that node also cover its workers/driver
@@ -423,11 +425,14 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def put(self, value: Any) -> ObjectID:
+        span = _trace.start_span("object.put", kind="object") if _trace._active else None
         object_id = self._next_put_id()
         sobj = serialization.serialize(value)
         self.plasma.put_serialized(object_id, sobj)
         self._register_ref(object_id)
         self.register_locations({object_id.binary(): self.raylet.address})
+        if span is not None:
+            _trace.end_span(span, attrs={"object_id": object_id.hex()[:16]})
         return object_id
 
     # -- object directory ------------------------------------------------
@@ -665,6 +670,20 @@ class CoreWorker:
                 pass
 
     def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        if _trace._active:
+            span = _trace.start_span("object.get", kind="object")
+            if span is not None:
+                try:
+                    result = self._get_inner(object_ids, timeout)
+                except Exception:
+                    _trace.end_span(span, status="error",
+                                    attrs={"n": len(object_ids)})
+                    raise
+                _trace.end_span(span, attrs={"n": len(object_ids)})
+                return result
+        return self._get_inner(object_ids, timeout)
+
+    def _get_inner(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         results: Dict[ObjectID, Any] = {}
         plasma_ids: List[ObjectID] = []
@@ -2136,6 +2155,8 @@ class CoreWorker:
         }
         addr = tuple(spec.get("_worker_addr") or ()) if spec else ()
         name = spec.get("name", "") if spec else ""
+        trace_id = ((spec.get("trace") or {}).get("trace_id")
+                    if spec else None)
 
         def _deliver():
             if addr:
@@ -2143,7 +2164,7 @@ class CoreWorker:
                     self._get_worker_client(addr).call(
                         "cancel_task", payload, timeout=3.0
                     )
-                    self._report_cancel_event(task_id, name)
+                    self._report_cancel_event(task_id, name, trace_id)
                     return
                 except Exception:
                     pass  # push target gone/stale: fall back to GCS lookup
@@ -2153,7 +2174,7 @@ class CoreWorker:
                 )
                 if not loc or not loc.get("node_id"):
                     if spec is not None:
-                        self._report_cancel_event(task_id, name)
+                        self._report_cancel_event(task_id, name, trace_id)
                     return
                 node_addr = self._node_address(NodeID.from_hex(loc["node_id"]))
                 if node_addr is None:
@@ -2163,24 +2184,24 @@ class CoreWorker:
                     {**payload, "worker_id": bytes.fromhex(loc["worker_id"])},
                     timeout=3.0,
                 )
-                self._report_cancel_event(task_id, name)
+                self._report_cancel_event(task_id, name, trace_id)
             except Exception:
                 pass  # best-effort: the owner-side resolution already stands
 
         threading.Thread(target=_deliver, name="cancel-rpc", daemon=True).start()
 
-    def _report_cancel_event(self, task_id: TaskID, name: str):
+    def _report_cancel_event(self, task_id: TaskID, name: str,
+                             trace_id: Optional[str] = None):
         try:
-            self.gcs.call(
-                "report_cluster_event",
-                {
-                    "type": "TASK_CANCELLED",
-                    "severity": "INFO",
-                    "message": f"task {name or task_id.hex()[:12]} cancelled",
-                    "task_id": task_id.hex(),
-                },
-                timeout=5.0,
-            )
+            ev = {
+                "type": "TASK_CANCELLED",
+                "severity": "INFO",
+                "message": f"task {name or task_id.hex()[:12]} cancelled",
+                "task_id": task_id.hex(),
+            }
+            if trace_id:
+                ev["trace_id"] = trace_id
+            self.gcs.call("report_cluster_event", ev, timeout=5.0)
         except Exception:
             pass
 
@@ -2191,11 +2212,33 @@ class CoreWorker:
     def _trace_ctx(self, task_id: TaskID) -> Optional[Dict[str, Any]]:
         """Span context for a task submitted from the current frame
         (reference: util/tracing/tracing_helper.py — span context rides
-        inside task metadata so nested submits form one trace). Span id ==
-        task id; the trace root is the first traced task in the chain."""
+        inside task metadata so nested submits form one trace).
+
+        Two generations coexist. The distributed tracing plane
+        (_private/trace.py, RAYTPU_TRACE_SAMPLE) pre-allocates the task's
+        span id at submit so the executor closes exactly that span and the
+        assembled tree links parent spans across processes. The legacy
+        task-event form (tracing_enabled) keeps trace_id/parent_id with
+        span id == task id for util/tracing.py consumers; both ride in the
+        same spec dict."""
+        parent = getattr(self._task_ctx, "task_id", None) or self._current_task_id
+        if _trace._active:
+            ctx = _trace.current()
+            if ctx is None:
+                # trace root: a submit with no inherited context starts a
+                # new trace (sampling drawn here, once per trace). Multi-
+                # submit workloads share one trace by opening a root span
+                # via ray_tpu.trace.start(), which installs the context.
+                ctx = _trace.mint()
+            return {
+                "trace_id": ctx.trace_id,
+                "parent_id": parent.hex() if parent is not None else None,
+                "span_id": _trace.new_span_id(),
+                "parent_span_id": ctx.span_id,
+                "sampled": ctx.sampled,
+            }
         if not GlobalConfig.tracing_enabled:
             return None
-        parent = getattr(self._task_ctx, "task_id", None) or self._current_task_id
         trace_id = getattr(self._task_ctx, "trace_id", None) or task_id.hex()
         return {
             "trace_id": trace_id,

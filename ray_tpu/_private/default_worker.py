@@ -73,6 +73,11 @@ def main():
         GlobalConfig.apply_cluster(core.gcs.call("get_config", timeout=10.0))
     except Exception:
         logging.getLogger(__name__).warning("could not fetch cluster config")
+    # the trace sample rate may have arrived with the cluster config (it
+    # was read once already, inside CoreWorker.__init__, before the fetch)
+    from ray_tpu._private import trace as _trace_mod
+
+    _trace_mod.init_from_config()
     _mark("cluster_config")
     server = RpcServer(f"worker-{worker_id.hex()[:8]}")
     TaskExecutor(core, server)

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
+from ray_tpu._private import trace as _trace
 
 logger = logging.getLogger(__name__)
 
@@ -134,6 +135,7 @@ class GcsServer:
             )
 
         self.server = RpcServer("gcs", host, port)
+        _trace.init_from_config()
         self._lock = threading.Condition(threading.RLock())
         # bounded executors for actor/pg scheduling (a thread per schedule
         # would mean 10k threads at the reference's 10k-actor envelope);
@@ -1496,6 +1498,14 @@ class GcsServer:
             "ts": time.time(),
             **fields,
         }
+        # distributed tracing: the RPC dispatch installed the reporting
+        # caller's context on this thread, so any event recorded while
+        # handling a traced request joins that trace (NODE_DRAINING from a
+        # traced drain call, etc.) unless the reporter stamped one already
+        if _trace._active and "trace_id" not in event:
+            ctx = _trace.current()
+            if ctx is not None and ctx.sampled:
+                event["trace_id"] = ctx.trace_id
         with self._lock:
             self._cluster_events.append(event)
             if len(self._cluster_events) > 10_000:
@@ -1504,6 +1514,25 @@ class GcsServer:
 
     def rpc_report_cluster_event(self, conn, payload):
         event = dict(payload)
+        # OOM kills: the raylet only knows the victim's worker_id — resolve
+        # the trace the victim was executing from its latest RUNNING task
+        # event so the kill shows up inside the affected trace
+        if (
+            event.get("type") == "WORKER_OOM_KILLED"
+            and "trace_id" not in event
+            and event.get("worker_id")
+        ):
+            wid = event["worker_id"]
+            with self._lock:
+                running = [
+                    e
+                    for e in self._task_events
+                    if e["state"] == "RUNNING"
+                    and e.get("worker_id") == wid
+                    and e.get("trace_id")
+                ]
+            if running:
+                event["trace_id"] = max(running, key=lambda e: e["ts"])["trace_id"]
         self._record_cluster_event(
             event.pop("type", "UNKNOWN"),
             event.pop("message", ""),
@@ -1645,6 +1674,11 @@ class GcsServer:
                     else:  # gauge: last write wins
                         out["series"][key] = value
         return list(merged.values())
+
+    def rpc_trace_spans(self, conn, payload=None):
+        """Trace-harvest GCS leg: this process's own span ring (the GCS
+        records rpc-server spans for traced control calls)."""
+        return _trace.snapshot()
 
     def rpc_perf_profile(self, conn, payload=None):
         """Cluster sampling profiler, GCS leg: sample THIS process (the

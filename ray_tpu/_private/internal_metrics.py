@@ -159,6 +159,21 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "total; server: deserialize/queue/handler/reply) — exported by the "
         "perf plane's ring/bucket accumulators, not Metric.observe",
         ("method", "phase", "side")),
+    # -- tracing plane ------------------------------------------------
+    "ray_tpu_trace_spans_total": (
+        "counter",
+        "spans recorded into this process's trace ring "
+        "(kind=task|rpc|object|collective|server|driver|internal)",
+        ("kind",)),
+    "ray_tpu_trace_traces_started_total": (
+        "counter",
+        "traces minted by this process's head-based sampler "
+        "(driver submit roots + serve ingress requests)",
+        ()),
+    "ray_tpu_trace_spans_dropped": (
+        "gauge",
+        "spans overwritten in this process's trace ring before harvest",
+        ()),
     # -- perf plane ---------------------------------------------------
     "ray_tpu_perf_profile_runs_total": (
         "counter", "sampling-profiler runs executed in this process", ()),

@@ -480,6 +480,15 @@ def measure_overhead(
             if _enabled:
                 pass
 
+    from ray_tpu._private import trace as _trace_mod
+
+    def loop_trace_gate(n):
+        # the cost a disabled tracing plane adds to every hook site: one
+        # module-attribute read (the _private/trace.py gated-no-op contract)
+        for _ in range(n):
+            if _trace_mod._active:
+                pass
+
     try:
         base = _ns_per_op(loop_baseline, iters, repeats)
         raw = {
@@ -491,6 +500,7 @@ def measure_overhead(
                 loop_phase_record, max(iters // 4, 1), repeats
             ),
             "rpc_phase_gate": _ns_per_op(loop_phase_gate, iters, repeats),
+            "trace_hook_disabled": _ns_per_op(loop_trace_gate, iters, repeats),
         }
     finally:
         with user_metrics._registry_lock:
@@ -514,4 +524,5 @@ OVERHEAD_BUDGET_NS = {
     "chaos_hook_unarmed": 400.0,
     "metrics_inc_bound": 4000.0,
     "rpc_phase_gate": 400.0,
+    "trace_hook_disabled": 400.0,
 }

@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private import fault_injection
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import object_store
+from ray_tpu._private import trace as _trace
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
@@ -304,6 +305,7 @@ class Raylet:
         self.node_id = NodeID.from_random()
         self.session_dir = session_dir
         self.gcs_address = gcs_address
+        _trace.init_from_config()
         self.server = RpcServer(f"raylet-{node_name}")
         # chaos attribution: this node's identity rides on every client,
         # server, and store hook so partition/kill/slow-read rules resolve
@@ -2056,6 +2058,39 @@ class Raylet:
         for t in threads:
             t.join(duration + 15.0)
         return {"node_id": self.node_id.hex(), "workers": workers}
+
+    def rpc_trace_spans(self, conn, payload=None):
+        """Trace-harvest node leg: this raylet's own span ring plus every
+        registered worker's (same per-worker fan-out as rpc_dump_stacks).
+        Returns ``{"node_id", "processes": {key: snapshot|{"error"}}}``."""
+        nid = self.node_id.hex()
+        with self._res_cv:
+            targets = [
+                (h.worker_id, tuple(h.address))
+                for h in self._workers.values()
+                if h.registered.is_set() and h.address and h.address[1]
+            ]
+        processes: Dict[str, Any] = {
+            f"raylet:{nid[:8]}": _trace.snapshot()
+        }
+
+        def _one(wid: WorkerID, addr: Tuple[str, int]):
+            key = f"worker:{wid.hex()[:8]}@{nid[:8]}"
+            try:
+                processes[key] = self._peer_client(addr).call(
+                    "trace_spans", {}, timeout=10.0
+                )
+            except Exception as e:
+                processes[key] = {"error": repr(e)}
+
+        threads = [
+            threading.Thread(target=_one, args=t, daemon=True) for t in targets
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15.0)
+        return {"node_id": nid, "processes": processes}
 
     def rpc_perf_profile(self, conn, payload=None):
         """Cluster sampling profiler, node leg: sample this raylet process

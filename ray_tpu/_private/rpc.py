@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import fault_injection as _fi
 from ray_tpu._private import perf as _perf
+from ray_tpu._private import trace as _tr
 from ray_tpu._private.config import GlobalConfig
 
 # Versioned wire header: magic + version + frame kind + payload length.
@@ -278,7 +279,11 @@ def _loads_control(data, buffers=()) -> Any:
 
 
 def _decode_body(body) -> Any:
-    """Parse a v3 body (meta + out-of-band buffers) and unpickle."""
+    """Parse a v3 body (meta + out-of-band buffers) and unpickle. Returns
+    ``(msg_id, method, payload, trace)``: the meta tuple is 3 elements on
+    the wire unless the sender attached a trace-context triple as an
+    optional 4th — both decode here, so tracing-aware and trace-free peers
+    interoperate on the same wire version."""
     view = memoryview(body)
     (meta_len,) = _U32.unpack_from(view, 0)
     offset = _U32.size + meta_len
@@ -289,7 +294,11 @@ def _decode_body(body) -> Any:
         offset += _U32.size
         buffers.append(view[offset : offset + blen])
         offset += blen
-    return _loads_control(meta, buffers=buffers)
+    decoded = _loads_control(meta, buffers=buffers)
+    if len(decoded) == 4:
+        return decoded
+    msg_id, method, payload = decoded
+    return msg_id, method, payload, None
 
 
 class RpcError(Exception):
@@ -326,7 +335,7 @@ IDEMPOTENT_METHODS = frozenset({
     # raylet reads
     "get_node_info", "ping", "store_get", "store_contains", "store_stats",
     "store_list", "store_fetch", "store_pull", "list_logs", "read_log",
-    "dump_stacks",
+    "dump_stacks", "trace_spans",
     # retry-safe store mutations: store_put is duplicate-tolerant (re-put
     # of a sealed object no-ops), seal/delete/abort converge on re-apply.
     # store_create and store_release are NOT here: create reserves a fresh
@@ -646,9 +655,15 @@ def _encode_frame_parts(obj) -> list:
             return False  # ship raw, out-of-band
         return True  # small/strided: in-band
 
-    meta = pickle.dumps(
-        (msg_id, method, payload_obj), protocol=5, buffer_callback=_cb
-    )
+    tup = (msg_id, method, payload_obj)
+    if _tr._active and kind == REQUEST:
+        # sampled trace context rides as an optional 4th meta element:
+        # header/version/kinds unchanged, and the coalescer + same-node
+        # fast path forward already-encoded parts, so both carry it for free
+        wire_ctx = _tr.propagate()
+        if wire_ctx is not None:
+            tup = tup + (wire_ctx,)
+    meta = pickle.dumps(tup, protocol=5, buffer_callback=_cb)
     total = _U32.size + len(meta) + sum(_U32.size + b.nbytes for b in bufs)
     parts = [
         _HEADER.pack(_MAGIC, _WIRE_VERSION, kind, total),
@@ -1190,7 +1205,7 @@ class ServerConn:
             return
         if _perf._enabled:
             td0 = time.monotonic_ns()
-            msg_id, method, payload = _decode_body(body)
+            msg_id, method, payload, trace = _decode_body(body)
             enq_ns = time.monotonic_ns()
             try:
                 _perf.record_server(method, deser_ns=enq_ns - td0)
@@ -1198,7 +1213,7 @@ class ServerConn:
                 pass
         else:
             enq_ns = 0
-            msg_id, method, payload = _decode_body(body)
+            msg_id, method, payload, trace = _decode_body(body)
         srv = self._server
         if _fi._armed is not None:
             decision = _fi.decide("recv", method, _fi.addr_key(self.addr),
@@ -1214,21 +1229,24 @@ class ServerConn:
                     threading.Timer(
                         decision["delay_ms"] / 1000.0,
                         srv._pool.submit,
-                        args=(srv._dispatch, self, msg_id, method, payload),
+                        args=(srv._dispatch, self, msg_id, method, payload,
+                              0, trace),
                     ).start()
                     return
                 if action == "duplicate":
                     # dispatch an extra copy; both replies carry the same
                     # msg_id, the caller keeps the first and drops the rest
-                    srv._pool.submit(srv._dispatch, self, msg_id, method, payload)
+                    srv._pool.submit(
+                        srv._dispatch, self, msg_id, method, payload, 0, trace
+                    )
         if method in srv._inline:
             # order-sensitive handlers run right here on the poller thread
             # (non-blocking by contract; a Deferred reply is sent by its
             # resolving thread) — arrival order is execution order
-            srv._dispatch_inline(self, msg_id, method, payload)
+            srv._dispatch_inline(self, msg_id, method, payload, trace)
         else:
             srv._pool.submit(
-                srv._dispatch, self, msg_id, method, payload, enq_ns
+                srv._dispatch, self, msg_id, method, payload, enq_ns, trace
             )
 
     def on_closed(self, exc: Exception):
@@ -1600,11 +1618,22 @@ class RpcServer:
         except Exception:
             pass
 
-    def _dispatch_inline(self, conn: ServerConn, msg_id: int, method: str, payload: Any):
+    def _dispatch_inline(self, conn: ServerConn, msg_id: int, method: str,
+                         payload: Any, trace=None):
         handler = self._handlers[method]
         t_start = time.monotonic_ns() if _perf._enabled else 0
         try:
-            reply = handler(conn, payload)
+            if trace is not None:
+                # install the caller's trace context around the handler so
+                # handler-side work (nested submits, event records) joins
+                # the caller's trace
+                _token = _tr.set_current(_tr.adopt_wire(trace))
+                try:
+                    reply = handler(conn, payload)
+                finally:
+                    _tr.set_current(_token)
+            else:
+                reply = handler(conn, payload)
         except Exception as e:  # noqa: BLE001
             try:
                 conn.sender.send_frame((ERROR, msg_id, method, _wire_safe_exc(e)))
@@ -1645,13 +1674,20 @@ class RpcServer:
         return _send
 
     def _dispatch(self, conn: ServerConn, msg_id: int, method: str,
-                  payload: Any, enq_ns: int = 0):
+                  payload: Any, enq_ns: int = 0, trace=None):
         handler = self._handlers.get(method)
         t_start = time.monotonic_ns() if _perf._enabled else 0
         try:
             if handler is None:
                 raise RpcError(f"no handler for {method!r} on {self.name}")
-            reply = handler(conn, payload)
+            if trace is not None:
+                _token = _tr.set_current(_tr.adopt_wire(trace))
+                try:
+                    reply = handler(conn, payload)
+                finally:
+                    _tr.set_current(_token)
+            else:
+                reply = handler(conn, payload)
             if isinstance(reply, Deferred):
                 # queue time is real; handler/reply complete on the
                 # resolving thread, outside this frame — don't guess them
@@ -1813,11 +1849,11 @@ class RpcClient:
     def _on_frame(self, kind: int, body: bytes):
         if _perf._enabled:
             td0 = time.monotonic_ns()
-            msg_id, method, payload = _decode_body(body)
+            msg_id, method, payload, _ = _decode_body(body)
             td1 = time.monotonic_ns()
         else:
             td0 = td1 = 0
-            msg_id, method, payload = _decode_body(body)
+            msg_id, method, payload, _ = _decode_body(body)
         if kind == ERROR and msg_id == 0:
             # connection-level refusal (e.g. "authentication required"):
             # there is no per-call slot to route it to — fail everything
@@ -1900,6 +1936,23 @@ class RpcClient:
         schedule is armed, across timeouts) with capped exponential
         backoff + full jitter; non-idempotent methods fail fast with
         NonIdempotentRpcError on connection loss."""
+        if _tr._active:
+            # the client span wraps the LOGICAL call: a dropped-then-retried
+            # idempotent request is one span, not one per attempt
+            span = _tr.start_span(f"rpc.{method}", kind="rpc")
+            if span is not None:
+                try:
+                    result = self._call_with_retries(method, payload, timeout)
+                except Exception:
+                    _tr.end_span(span, status="error")
+                    raise
+                _tr.end_span(span)
+                return result
+        return self._call_with_retries(method, payload, timeout)
+
+    def _call_with_retries(
+        self, method: str, payload: Any, timeout: Optional[float]
+    ) -> Any:
         idempotent = method in IDEMPOTENT_METHODS
         attempts = max(1, int(GlobalConfig.rpc_retry_max_attempts))
         base = GlobalConfig.rpc_retry_backoff_base_s

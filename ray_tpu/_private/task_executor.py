@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import internal_metrics
 from ray_tpu._private import serialization
+from ray_tpu._private import trace as _trace
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.core_worker import (
     CoreWorker,
@@ -142,6 +143,7 @@ class TaskExecutor:
         server.register("kill_self", self.rpc_kill_self)
         server.register("health", lambda conn, p: "ok")
         server.register("profile", self.rpc_profile)
+        server.register("trace_spans", lambda conn, p: _trace.snapshot())
 
     # ------------------------------------------------------------------
 
@@ -284,6 +286,19 @@ class TaskExecutor:
         self.core._task_ctx.task_id = task_id
         self.core._task_ctx.task_name = name
         self.core._task_ctx.trace_id = (trace or {}).get("trace_id")
+        # distributed tracing plane: the submit site pre-allocated this
+        # task's span id — install the context (so nested submits / RPCs /
+        # object ops become children) and close exactly that span on exit
+        t_ctx = t_token = None
+        t_status = "ok"
+        if _trace._active and trace and trace.get("span_id"):
+            t_ctx = _trace.TraceContext(
+                trace["trace_id"], trace["span_id"],
+                bool(trace.get("sampled", True)),
+            )
+            t_token = _trace.set_current(t_ctx)
+        t_start = time.time()
+        t_perf = time.perf_counter()
         # structured boundary markers in the worker log: get_log(task_id=...)
         # slices the lines between this pair; the raylet log monitor strips
         # them from the driver's stdout mirror (name goes last — it may
@@ -300,6 +315,7 @@ class TaskExecutor:
                 }
         try:
             if precancelled:
+                t_status = "cancelled"
                 return TaskCancelledError(name), True
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
@@ -315,8 +331,10 @@ class TaskExecutor:
             # raised by the task itself or injected by a force-cancel: reply
             # with the typed error unwrapped so the owner resolves the ref
             # to TaskCancelledError (not a generic TaskError)
+            t_status = "cancelled"
             return TaskCancelledError(name), True
         except Exception as e:  # noqa: BLE001
+            t_status = "error"
             return TaskError(e, name, traceback.format_exc()), True
         finally:
             with self._cancel_lock:
@@ -325,6 +343,22 @@ class TaskExecutor:
             self.core._task_ctx.task_id = token_tid
             self.core._task_ctx.task_name = token_name
             self.core._task_ctx.trace_id = token_trace
+            if t_ctx is not None:
+                _trace.record_span(
+                    t_ctx.trace_id, t_ctx.span_id,
+                    trace.get("parent_span_id"),
+                    f"task:{name}", "task", t_start,
+                    time.perf_counter() - t_perf, status=t_status,
+                    attrs={
+                        "task_id": task_id.hex(),
+                        "node_id": self.core.node_id.hex()
+                        if self.core.node_id is not None else "",
+                        "worker_id": self.core.worker_id.hex(),
+                        "attempt": attempt,
+                    },
+                    sampled=t_ctx.sampled,
+                )
+                _trace.set_current(t_token)
 
     # ------------------------------------------------------------------
 

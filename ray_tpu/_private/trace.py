@@ -1,0 +1,312 @@
+"""Distributed tracing plane: per-process span recording + context plumbing.
+
+One causal trace per request/workload: a :class:`TraceContext` (trace_id,
+span_id, sampled bit) is minted at the driver submit path or the serve
+ingress, rides inside task specs and RPC frames, and every hop records
+spans into a per-process lock-free ring buffer. The rings are harvested
+cluster-wide through the same raylet fan-out the stack dumper uses
+(``trace_spans`` RPC); assembly/analysis lives in ``ray_tpu.trace``.
+
+Hot-path contract (the perf.py gated-no-op pattern): when tracing is off,
+every hook is ONE module-attribute read (``if _trace._active:``), enforced
+under ``perf.OVERHEAD_BUDGET_NS["trace_hook_disabled"]``. Span recording
+is an index bump plus a tuple store — append-only ring, no lock; the GIL
+makes the slot write atomic and a racing writer can at worst overwrite one
+slot, never corrupt the ring.
+
+Sampling is head-based: the mint site draws once against
+``RAYTPU_TRACE_SAMPLE`` and the decision propagates with the context, so a
+trace is either recorded everywhere or nowhere — except task failures,
+which force-record their span regardless of the sampled bit
+(always-sample-on-error) so every error has at least its own span on file.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: THE gate — module attribute, read once per hook. False = tracing plane
+#: completely off: no context minting, no span recording, no thread-local
+#: reads anywhere on the hot path.
+_active = False
+
+#: head-based sampling rate in [0, 1]; applied only where traces start
+#: (driver submit with no inherited context, serve ingress)
+_sample_rate = 0.0
+
+_tls = threading.local()
+
+# -- span ring (per process, lock-free) --------------------------------
+
+_RING_SIZE = 8192
+_ring: List[Any] = [None] * _RING_SIZE
+_ring_idx = 0  # monotonic; slot = idx % _RING_SIZE
+
+# process-unique span-id prefix: pid alone recycles, two random bytes
+# disambiguate a recycled pid within one cluster session
+_PROC = f"{os.getpid():x}{os.urandom(2).hex()}"
+_ids = itertools.count(1)
+
+# sampling decisions draw from a private RNG so armed chaos schedules
+# (which seed their own Random) and user code seeding the global RNG stay
+# deterministic with tracing on
+_rng = random.Random(os.urandom(8))
+
+_lock = threading.Lock()
+
+
+class TraceContext:
+    """The propagated triple. ``span_id`` is the *current* span — children
+    minted under this context use it as their parent."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: Optional[str], sampled: bool):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def __repr__(self) -> str:  # debug aid only
+        return (
+            f"TraceContext({self.trace_id!r}, {self.span_id!r}, "
+            f"sampled={self.sampled})"
+        )
+
+
+# -- lifecycle ---------------------------------------------------------
+
+
+def init_from_config() -> None:
+    """Adopt ``RAYTPU_TRACE_SAMPLE`` / ``_system_config['trace_sample']``.
+    Called at process bring-up (core worker, raylet, GCS) and again after a
+    worker adopts the cluster config, so a driver-side sample rate reaches
+    every process."""
+    global _active, _sample_rate
+    try:
+        from ray_tpu._private.config import GlobalConfig
+
+        rate = float(GlobalConfig.trace_sample)
+    except Exception:
+        return
+    if rate > 0.0:
+        _sample_rate = min(rate, 1.0)
+        _active = True
+    elif _sample_rate > 0.0 and rate <= 0.0:
+        # config turned it off (and enable() didn't): drop the gate
+        _sample_rate = 0.0
+        _active = False
+
+
+def enable(sample_rate: float = 1.0) -> None:
+    """Programmatic opt-in for this process (tests, notebooks)."""
+    global _active, _sample_rate
+    _sample_rate = min(max(float(sample_rate), 0.0), 1.0)
+    _active = _sample_rate > 0.0
+
+
+def disable() -> None:
+    global _active, _sample_rate
+    _active = False
+    _sample_rate = 0.0
+
+
+# -- context plumbing --------------------------------------------------
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+def set_current(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install ``ctx``; returns the previous context (restore token)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+def run_with(ctx: Optional[TraceContext], fn, *args, **kwargs):
+    """Run ``fn`` with ``ctx`` installed (cross-thread hand-off: serve
+    ingress executors, deferred resolvers)."""
+    prev = set_current(ctx)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        set_current(prev)
+
+
+def new_span_id() -> str:
+    return f"{_PROC}-{next(_ids):x}"
+
+
+def mint(sampled: Optional[bool] = None) -> TraceContext:
+    """Start a new trace (no parent span yet). ``sampled=None`` draws
+    against the head sample rate; pass True/False to force."""
+    if sampled is None:
+        sampled = _rng.random() < _sample_rate
+    if sampled:
+        _traces_started().inc()
+    return TraceContext(os.urandom(8).hex(), None, bool(sampled))
+
+
+def child(ctx: TraceContext, span_id: Optional[str] = None) -> TraceContext:
+    """A context whose current span is ``span_id`` (same trace/sampling)."""
+    return TraceContext(ctx.trace_id, span_id or new_span_id(), ctx.sampled)
+
+
+# -- wire form (rides as a plain tuple inside the pickled RPC meta) ----
+
+
+def propagate() -> Optional[tuple]:
+    """The wire triple for the calling thread's context, or None. Only
+    sampled contexts ride the wire: an unsampled trace records nothing
+    remotely, so shipping its ids would be pure overhead."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or not ctx.sampled:
+        return None
+    return (ctx.trace_id, ctx.span_id, True)
+
+
+def adopt_wire(wire) -> Optional[TraceContext]:
+    """Rebuild a context from the wire triple (tolerant: malformed trace
+    metadata must never fail a frame)."""
+    try:
+        trace_id, span_id, sampled = wire
+        return TraceContext(str(trace_id), span_id, bool(sampled))
+    except Exception:
+        return None
+
+
+# -- span recording ----------------------------------------------------
+
+
+def _record(span: tuple) -> None:
+    global _ring_idx
+    i = _ring_idx
+    _ring_idx = i + 1
+    _ring[i % _RING_SIZE] = span
+
+
+def record_span(
+    trace_id: str,
+    span_id: str,
+    parent_span_id: Optional[str],
+    name: str,
+    kind: str,
+    start_ts: float,
+    dur_s: float,
+    status: str = "ok",
+    attrs: Optional[Dict[str, Any]] = None,
+    sampled: bool = True,
+) -> None:
+    """Record one completed span. Unsampled spans are dropped unless the
+    status is terminal-bad (always-sample-on-error)."""
+    if not sampled and status == "ok":
+        return
+    _record(
+        (trace_id, span_id, parent_span_id, name, kind, start_ts, dur_s,
+         status, attrs)
+    )
+    _spans_recorded(kind).inc()
+
+
+def start_span(
+    name: str, kind: str = "internal", ctx: Optional[TraceContext] = None
+):
+    """Open a span under ``ctx`` (default: calling thread's context).
+    Returns an opaque handle for :func:`end_span`, or None when there is
+    nothing to trace. The span is recorded at end time only."""
+    if ctx is None:
+        ctx = getattr(_tls, "ctx", None)
+        if ctx is None:
+            return None
+    return [ctx, new_span_id(), name, kind, time.time(), time.perf_counter()]
+
+
+def end_span(handle, status: str = "ok",
+             attrs: Optional[Dict[str, Any]] = None) -> None:
+    if handle is None:
+        return
+    ctx, span_id, name, kind, start_ts, t0 = handle
+    record_span(
+        ctx.trace_id, span_id, ctx.span_id, name, kind, start_ts,
+        time.perf_counter() - t0, status=status, attrs=attrs,
+        sampled=ctx.sampled,
+    )
+
+
+# -- harvest -----------------------------------------------------------
+
+
+def snapshot(clear: bool = False) -> Dict[str, Any]:
+    """This process's recorded spans (newest ``_RING_SIZE``), as dicts.
+    ``dropped`` counts ring overwrites since process start (or the last
+    ``clear``)."""
+    global _ring_idx
+    with _lock:
+        idx = _ring_idx
+        live = [s for s in _ring[: min(idx, _RING_SIZE)] if s is not None]
+        if clear:
+            for i in range(_RING_SIZE):
+                _ring[i] = None
+            _ring_idx = 0
+    spans = [
+        {
+            "trace_id": s[0],
+            "span_id": s[1],
+            "parent_span_id": s[2],
+            "name": s[3],
+            "kind": s[4],
+            "start_ts": s[5],
+            "dur_s": s[6],
+            "status": s[7],
+            "attrs": s[8],
+        }
+        for s in live
+    ]
+    dropped = max(0, idx - _RING_SIZE)
+    if dropped:
+        try:
+            from ray_tpu._private import internal_metrics
+
+            internal_metrics.set_gauge(
+                "ray_tpu_trace_spans_dropped", float(dropped)
+            )
+        except Exception:
+            pass
+    return {"pid": os.getpid(), "spans": spans, "dropped": dropped}
+
+
+def clear() -> None:
+    snapshot(clear=True)
+
+
+# -- metrics (resolved lazily; never on the disabled hot path) ---------
+
+_metric_cache: Dict[str, Any] = {}
+
+
+def _spans_recorded(kind: str):
+    m = _metric_cache.get(kind)
+    if m is None:
+        from ray_tpu._private import internal_metrics
+
+        m = internal_metrics.bound_counter(
+            "ray_tpu_trace_spans_total", tags={"kind": kind}
+        )
+        _metric_cache[kind] = m
+    return m
+
+
+def _traces_started():
+    m = _metric_cache.get("__started__")
+    if m is None:
+        from ray_tpu._private import internal_metrics
+
+        m = internal_metrics.bound_counter("ray_tpu_trace_traces_started_total")
+        _metric_cache["__started__"] = m
+    return m

@@ -53,7 +53,8 @@ _PAGE = """<!doctype html>
 <h2>Task summary</h2><div id="summary"></div>
 <h2>Placement groups</h2><div id="pgs"></div>
 <h2>Events <a href="/events" style="font-size:.75rem">(full log)</a>
-<a href="/perf" style="font-size:.75rem">(rpc perf)</a></h2>
+<a href="/perf" style="font-size:.75rem">(rpc perf)</a>
+<a href="/traces" style="font-size:.75rem">(traces)</a></h2>
 <div id="events"></div>
 <script>
 function table(rows, cols){
@@ -211,6 +212,80 @@ async function refresh(){
   }
 }
 refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_TRACES_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu traces</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} .err{color:#c0232c} .mono{font-family:monospace}
+ #updated{color:#888;font-size:.8rem}
+ .tree{font-family:monospace;font-size:.85rem;background:#fff;
+       border:1px solid #ddd;padding:.6rem;white-space:pre}
+ tr.cp{background:#fff7e0}
+</style></head><body>
+<h1>distributed traces <a href="/" style="font-size:.8rem">dashboard</a>
+<span id="updated"></span></h1>
+<div id="list"></div>
+<div id="detail"></div>
+<script>
+function fmt(s){
+  if(s >= 0.1) return s.toFixed(2)+'s';
+  if(s >= 1e-3) return (s*1e3).toFixed(1)+'ms';
+  return (s*1e6).toFixed(0)+'us';
+}
+async function show(id){
+  const t = await (await fetch('/api/traces?trace_id='+id)).json();
+  let h = `<h2>trace <span class="mono">${t.trace_id}</span></h2>`;
+  h += '<div class="tree">';
+  const cp = new Set((t.critical_path||[]).map(x=>x.span_id));
+  function walk(n, d){
+    const mark = cp.has(n.span_id) ? ' *' : '';
+    const bad = n.status === 'ok' ? '' : ` !${n.status}`;
+    h += '  '.repeat(d)+`${n.name} [${n.kind}] ${fmt(n.dur_s||0)}`+
+         ` (${n.process||'?'})${bad}${mark}\n`;
+    for(const c of n.children) walk(c, d+1);
+  }
+  for(const r of t.roots) walk(r, 0);
+  h += '</div><p style="font-size:.8rem;color:#888">* = critical path</p>';
+  if((t.stragglers||[]).length){
+    h += '<h2>stragglers</h2><table><tr><th>span</th><th>duration</th>'+
+         '<th>sibling p95</th><th>node</th><th>worker</th></tr>';
+    for(const s of t.stragglers)
+      h += `<tr><td>${s.name}</td><td class="err">${fmt(s.dur_s)}</td>`+
+           `<td>${fmt(s.p95_siblings_s)}</td>`+
+           `<td class="mono">${(s.node_id||'?').slice(0,12)}</td>`+
+           `<td class="mono">${(s.worker_id||'?').slice(0,12)}</td></tr>`;
+    h += '</table>';
+  }
+  document.getElementById('detail').innerHTML = h;
+}
+async function refresh(){
+  try{
+    const rows = await (await fetch('/api/traces')).json();
+    let h = '<table><tr><th>trace id</th><th>root</th><th>spans</th>'+
+            '<th>errors</th><th>duration</th><th>start</th></tr>';
+    for(const g of rows.slice(0, 50))
+      h += `<tr><td class="mono"><a href="#" onclick="show('${g.trace_id}');`+
+           `return false">${g.trace_id}</a></td>`+
+           `<td>${g.name||'?'}</td><td>${g.spans}</td>`+
+           `<td class="${g.errors?'err':''}">${g.errors}</td>`+
+           `<td>${fmt(g.dur_s)}</td>`+
+           `<td>${new Date(g.start_ts*1000).toLocaleTimeString()}</td></tr>`;
+    document.getElementById('list').innerHTML =
+      rows.length ? h+'</table>'
+                  : '<em>no traces recorded — set RAYTPU_TRACE_SAMPLE</em>';
+    document.getElementById('updated').textContent =
+      'updated '+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 5000);
 </script></body></html>"""
 
 
@@ -594,6 +669,8 @@ class DashboardServer:
             return _EVENTS_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/perf":
             return _PERF_PAGE.encode(), "text/html; charset=utf-8"
+        if base0 == "/traces":
+            return _TRACES_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/serve":
             return _SERVE_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/logs":
@@ -650,6 +727,25 @@ class DashboardServer:
             except Exception:
                 payload = {}
             return json.dumps(payload).encode(), "application/json"
+        if base == "/api/traces":
+            from urllib.parse import parse_qs
+
+            from ray_tpu import trace as trace_mod
+
+            q = parse_qs(query)
+            tid = (q.get("trace_id") or [""])[0]
+            if tid:
+                t = trace_mod.get(tid, address=a)
+                t["critical_path"] = trace_mod.critical_path(t)
+                t["stragglers"] = trace_mod.stragglers(t)
+                return (
+                    json.dumps(_to_jsonable(t)).encode(),
+                    "application/json",
+                )
+            return (
+                json.dumps(_to_jsonable(trace_mod.list(address=a))).encode(),
+                "application/json",
+            )
         if base == "/api/metrics_history":
             return (
                 json.dumps(list(self._history)).encode(),

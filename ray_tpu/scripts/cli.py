@@ -266,6 +266,86 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """``raytpu trace list|show|critical-path`` — the distributed
+    tracing plane's read side."""
+    from ray_tpu import trace as trace_mod
+
+    address = _head_address(args.address)
+    if args.trace_cmd == "list":
+        rows = trace_mod.list(address=address)
+        if args.json:
+            print(json.dumps(rows, indent=2))
+            return 0
+        if not rows:
+            print("no traces recorded (set RAYTPU_TRACE_SAMPLE or "
+                  "_system_config={'trace_sample': ...} and re-run)")
+            return 0
+        hdr = f"{'trace_id':<18} {'root':<28} {'spans':>6} {'errors':>7} {'duration':>10}"
+        print(hdr)
+        print("-" * len(hdr))
+        for g in rows[: args.limit]:
+            print(
+                f"{g['trace_id']:<18} {(g['name'] or '?')[:28]:<28} "
+                f"{g['spans']:>6} {g['errors']:>7} {_fmt_us(g['dur_s']):>10}"
+            )
+        return 0
+    if args.trace_cmd == "show":
+        t = trace_mod.get(args.trace_id, address=address)
+        if args.json:
+            print(json.dumps(t, indent=2))
+            return 0
+        if args.output:
+            trace_mod.export_chrome(
+                t, args.output, address=address, merge_timeline=True
+            )
+            print(f"wrote chrome trace to {args.output}")
+            return 0
+
+        def _show(node, depth):
+            status = "" if node["status"] == "ok" else f"  !{node['status']}"
+            print(
+                f"{'  ' * depth}{node['name']} [{node['kind']}] "
+                f"{_fmt_us(node['dur_s'] or 0.0)}  "
+                f"({node.get('process') or '?'}){status}"
+            )
+            for c in node["children"]:
+                _show(c, depth + 1)
+
+        print(f"trace {t['trace_id']} — {len(t['spans'])} spans")
+        for root in t["roots"]:
+            _show(root, 0)
+        return 0
+    # critical-path: the latency decomposition + straggler report
+    t = trace_mod.get(args.trace_id, address=address)
+    path = trace_mod.critical_path(t)
+    if args.json:
+        print(json.dumps(
+            {"critical_path": path, "stragglers": trace_mod.stragglers(t)},
+            indent=2,
+        ))
+        return 0
+    total = sum(h["self_s"] for h in path)
+    hdr = f"{'hop':<40} {'self':>10} {'% of e2e':>9}"
+    print(hdr)
+    print("-" * len(hdr))
+    for h in path:
+        pct = 100.0 * h["self_s"] / total if total else 0.0
+        print(f"{h['name'][:40]:<40} {_fmt_us(h['self_s']):>10} {pct:>8.1f}%")
+    print(f"{'total':<40} {_fmt_us(total):>10}")
+    stragglers = trace_mod.stragglers(t)
+    if stragglers:
+        print("\nstragglers (beyond sibling p95):")
+        for s in stragglers:
+            print(
+                f"  {s['name']}: {_fmt_us(s['dur_s'])} vs p95 "
+                f"{_fmt_us(s['p95_siblings_s'])} on node "
+                f"{(s['node_id'] or '?')[:12]} worker "
+                f"{(s['worker_id'] or '?')[:12]}"
+            )
+    return 0
+
+
 def cmd_logs(args) -> int:
     from ray_tpu.util import state as state_api
 
@@ -542,6 +622,36 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--output", default="timeline.json")
     s.add_argument("--address")
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser(
+        "trace",
+        help="distributed traces: list, causal tree, critical path",
+        description="Read side of the distributed tracing plane "
+        "(RAYTPU_TRACE_SAMPLE). `trace list` shows harvested traces; "
+        "`trace show ID` prints the causal span tree (or exports chrome "
+        "JSON with -o); `trace critical-path ID` decomposes end-to-end "
+        "latency hop by hop and flags fan-out stragglers.",
+    )
+    trace_sub = s.add_subparsers(dest="trace_cmd", required=True)
+    d = trace_sub.add_parser("list", help="one row per harvested trace")
+    d.add_argument("--address")
+    d.add_argument("--limit", type=int, default=20)
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.set_defaults(fn=cmd_trace)
+    d = trace_sub.add_parser("show", help="causal span tree of one trace")
+    d.add_argument("trace_id", help="trace id (unique prefix ok)")
+    d.add_argument("--address")
+    d.add_argument("-o", "--output",
+                   help="write chrome-trace JSON (merged with timeline)")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.set_defaults(fn=cmd_trace)
+    d = trace_sub.add_parser(
+        "critical-path", help="latency decomposition + straggler report"
+    )
+    d.add_argument("trace_id", help="trace id (unique prefix ok)")
+    d.add_argument("--address")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser("serve", help="deploy/inspect serve applications")
     serve_sub = s.add_subparsers(dest="serve_command", required=True)

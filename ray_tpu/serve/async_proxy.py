@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -29,8 +31,13 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu._private import internal_metrics
+from ray_tpu._private import trace as _trace
 from ray_tpu._private.ids import ObjectRefGenerator
 from ray_tpu.serve.handle import BackPressureError, DeploymentHandle
+
+#: one line per proxied request (route, status, latency, request id,
+#: trace id) -- the "access log" half of the observability satellite
+_access_log = logging.getLogger("ray_tpu.serve.access")
 
 
 def _core():
@@ -171,20 +178,24 @@ class AsyncHTTPProxy:
         writer.write(body)
 
     def _shed(self, writer, route: str, t0: float,
-              retry_after_s: float = 1.0):
+              retry_after_s: float = 1.0, req_id: str = "",
+              ctx=None):
         """503 + Retry-After: the overload answer that costs the cluster
-        nothing — no replica call was (or will be) submitted."""
+        nothing — no replica call was (or will be) submitted. The reply
+        carries ``X-Request-Id`` so a shed client can be joined with the
+        proxy access log / trace later."""
         internal_metrics.inc(
             "ray_tpu_serve_sheds_total", 1,
             {"deployment": route, "where": "proxy"})
         body = json.dumps(
-            {"error": "overloaded", "retry_after_s": retry_after_s}
+            {"error": "overloaded", "retry_after_s": retry_after_s,
+             "request_id": req_id}
         ).encode()
-        self._reply(
-            writer, 503, body,
-            extra_headers={"Retry-After": str(max(1, round(retry_after_s)))},
-        )
-        self._record_proxy(route, 503, t0)
+        headers = {"Retry-After": str(max(1, round(retry_after_s)))}
+        if req_id:
+            headers["X-Request-Id"] = req_id
+        self._reply(writer, 503, body, extra_headers=headers)
+        self._record_proxy(route, 503, t0, req_id=req_id, ctx=ctx)
 
     async def _route(self, method: str, path: str, body: bytes, writer,
                      reader=None):
@@ -207,11 +218,18 @@ class AsyncHTTPProxy:
         name = segments[0]
         route_t0 = time.perf_counter()
         stream = len(segments) > 1 and segments[-1] == "stream"
+        # serve ingress is a trace root: mint the context here (sampling
+        # drawn once per request) and use the trace id as the request id
+        # so X-Request-Id joins client logs with the assembled trace
+        ctx = _trace.child(_trace.mint()) if _trace._active else None
+        req_id = ctx.trace_id if ctx is not None else os.urandom(8).hex()
+        rid_headers = {"X-Request-Id": req_id}
         try:
             payload = json.loads(body or b"null")
         except ValueError:
-            self._reply(writer, 400, b'{"error": "invalid JSON body"}')
-            self._record_proxy(name, 400, route_t0)
+            self._reply(writer, 400, b'{"error": "invalid JSON body"}',
+                        extra_headers=rid_headers)
+            self._record_proxy(name, 400, route_t0, req_id=req_id, ctx=ctx)
             return
         handle = self._handles.get(name)
         if handle is None:
@@ -219,13 +237,17 @@ class AsyncHTTPProxy:
         if (self._max_total_inflight
                 and self._inflight >= self._max_total_inflight):
             # ingress-global bound: shed before touching the cluster
-            self._shed(writer, name, route_t0)
+            self._shed(writer, name, route_t0, req_id=req_id, ctx=ctx)
             return
         loop = asyncio.get_running_loop()
+        _call = handle.stream if stream else handle.remote
+        # run_with hands the ingress context across the executor-thread
+        # boundary so the replica submit (and everything under it) traces
+        # as a child of this request
         submit = (
-            (lambda: handle.stream(payload))
-            if stream
-            else (lambda: handle.remote(payload))
+            (lambda: _trace.run_with(ctx, _call, payload))
+            if ctx is not None
+            else (lambda: _call(payload))
         )
         self._inflight += 1
         internal_metrics.set_gauge(
@@ -259,20 +281,22 @@ class AsyncHTTPProxy:
                 # client went away mid-wait: the replica call was cancelled
                 # through the cancellation plane; nobody is left to reply to
                 # (499 is nginx's "client closed request")
-                self._record_proxy(name, 499, route_t0)
+                self._record_proxy(name, 499, route_t0, req_id=req_id, ctx=ctx)
                 return
             except Exception as e:  # noqa: BLE001
                 bp = _find_backpressure(e)
                 if bp is not None:
                     # shed by the handle's admission queue (directly, or
                     # deep inside a DAG) — overload, not server error
-                    self._shed(writer, name, route_t0, bp.retry_after_s)
+                    self._shed(writer, name, route_t0, bp.retry_after_s,
+                               req_id=req_id, ctx=ctx)
                     return
                 self._reply(
                     writer, 500,
                     json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                    extra_headers=rid_headers,
                 )
-                self._record_proxy(name, 500, route_t0)
+                self._record_proxy(name, 500, route_t0, req_id=req_id, ctx=ctx)
                 return
         finally:
             self._inflight -= 1
@@ -282,22 +306,37 @@ class AsyncHTTPProxy:
             stream and isinstance(value, (list, tuple))
         ):
             await self._stream_items(writer, value)
-            self._record_proxy(name, 200, route_t0)
+            self._record_proxy(name, 200, route_t0, req_id=req_id, ctx=ctx)
             return
-        self._reply(writer, 200, json.dumps({"result": value}).encode())
-        self._record_proxy(name, 200, route_t0)
+        self._reply(writer, 200, json.dumps({"result": value}).encode(),
+                    extra_headers=rid_headers)
+        self._record_proxy(name, 200, route_t0, req_id=req_id, ctx=ctx)
 
-    @staticmethod
-    def _record_proxy(route: str, status: int, t0: float) -> None:
+    def _record_proxy(self, route: str, status: int, t0: float,
+                      req_id: str = "", ctx=None) -> None:
+        dur = time.perf_counter() - t0
         internal_metrics.inc(
             "ray_tpu_serve_proxy_requests_total",
             tags={"route": route, "status": str(status)},
         )
         internal_metrics.observe(
-            "ray_tpu_serve_proxy_latency_seconds",
-            time.perf_counter() - t0,
-            tags={"route": route},
+            "ray_tpu_serve_proxy_latency_seconds", dur, tags={"route": route},
         )
+        _access_log.info(
+            "%s %d %.1fms req_id=%s trace_id=%s",
+            route, status, dur * 1e3, req_id or "-",
+            ctx.trace_id if ctx is not None else "-",
+        )
+        if ctx is not None:
+            # ingress root span: every reply path funnels through here,
+            # so the span closes exactly once per request
+            _trace.record_span(
+                ctx.trace_id, ctx.span_id, None, f"http:{route}", "server",
+                time.time() - dur, dur,
+                status="ok" if status < 500 else "error",
+                attrs={"status": status, "request_id": req_id},
+                sampled=ctx.sampled,
+            )
 
     async def _stream_items(self, writer, items):
         """Chunked NDJSON: one line per yielded item, flushed as each

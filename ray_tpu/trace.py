@@ -1,0 +1,333 @@
+"""Distributed tracing: assembly and analysis over harvested spans.
+
+The recording half lives in ``ray_tpu._private.trace`` (per-process ring
+buffers, context propagation through task specs / RPC frames / serve
+ingress). This module is the read side: harvest every process's ring via
+the state API fan-out, rebuild the causal tree for one trace, and answer
+the questions raw spans can't — what was the critical path, and which
+fan-out children straggled.
+
+Typical use::
+
+    ray_tpu.init(_system_config={"trace_sample": 1.0})
+    with ray_tpu.trace.start("step") as root:
+        ray_tpu.get([f.remote(i) for i in range(32)])
+    t = ray_tpu.trace.get(root.trace_id)
+    for hop in ray_tpu.trace.critical_path(t):
+        print(hop["self_s"], hop["name"])
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu._private import trace as _tr
+
+__all__ = [
+    "enable",
+    "disable",
+    "start",
+    "list",
+    "get",
+    "critical_path",
+    "stragglers",
+    "export_chrome",
+]
+
+#: span fields copied into analysis rows (children stay in the tree)
+_ROW_KEYS = (
+    "trace_id", "span_id", "parent_span_id", "name", "kind",
+    "start_ts", "dur_s", "status", "attrs", "node_id", "process",
+)
+
+
+def enable(sample_rate: float = 1.0) -> None:
+    """Turn the tracing plane on for THIS process (tests, notebooks).
+    Cluster-wide tracing is configured at init:
+    ``_system_config={"trace_sample": ...}`` or ``RAYTPU_TRACE_SAMPLE``."""
+    _tr.enable(sample_rate)
+
+
+def disable() -> None:
+    _tr.disable()
+
+
+class _RootSpan:
+    """Context manager returned by :func:`start`: installs a force-sampled
+    root context on the calling thread and records the root span on exit,
+    so everything submitted inside the block joins one trace."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.trace_id: Optional[str] = None
+        self._ctx = None
+        self._token = None
+
+    def __enter__(self) -> "_RootSpan":
+        self._ctx = _tr.child(_tr.mint(sampled=True))
+        self.trace_id = self._ctx.trace_id
+        self._token = _tr.set_current(self._ctx)
+        self._start = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        _tr.record_span(
+            self._ctx.trace_id, self._ctx.span_id, None,
+            f"trace:{self.name}", "root", self._start,
+            time.perf_counter() - self._t0,
+            status="ok" if exc_type is None else "error",
+            sampled=True,
+        )
+        _tr.set_current(self._token)
+        return False
+
+
+def start(name: str) -> _RootSpan:
+    """Open a root span: ``with ray_tpu.trace.start("step") as root:``.
+    The trace is force-sampled (this is an explicit request to trace) —
+    but remote hops only record if the plane is active cluster-wide
+    (``trace_sample`` > 0)."""
+    return _RootSpan(name)
+
+
+# -- harvest + assembly ------------------------------------------------
+
+
+def _harvest(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    from ray_tpu.util.state import list_trace_spans
+
+    return list_trace_spans(address=address)
+
+
+def _assemble(spans) -> List[Dict[str, Any]]:
+    """Parent-link spans into a forest (roots sorted by start time).
+    A span whose parent is missing — unsampled hop, ring overwrite, dead
+    process — becomes a root: a partial tree beats a dropped one."""
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        row = {k: s.get(k) for k in _ROW_KEYS}
+        row["children"] = []
+        by_id.setdefault(row["span_id"], row)
+    roots = []
+    for row in by_id.values():
+        parent = row.get("parent_span_id")
+        if parent and parent in by_id and parent != row["span_id"]:
+            by_id[parent]["children"].append(row)
+        else:
+            roots.append(row)
+    for row in by_id.values():
+        row["children"].sort(key=lambda c: c["start_ts"] or 0.0)
+    roots.sort(key=lambda r: r["start_ts"] or 0.0)
+    return roots
+
+
+def list(*, address: Optional[str] = None) -> List[Dict[str, Any]]:  # noqa: A001
+    """One summary row per harvested trace, newest first: trace_id, root
+    span name (if its root was captured), span count, start, end-to-end
+    duration, and whether any span errored."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    for s in _harvest(address):
+        g = groups.setdefault(
+            s["trace_id"],
+            {
+                "trace_id": s["trace_id"],
+                "name": None,
+                "spans": 0,
+                "start_ts": s["start_ts"],
+                "end_ts": 0.0,
+                "errors": 0,
+            },
+        )
+        g["spans"] += 1
+        g["start_ts"] = min(g["start_ts"], s["start_ts"])
+        g["end_ts"] = max(g["end_ts"], s["start_ts"] + (s["dur_s"] or 0.0))
+        if s.get("status") not in (None, "ok"):
+            g["errors"] += 1
+        if not s.get("parent_span_id"):
+            g["name"] = s["name"]
+    out = sorted(groups.values(), key=lambda g: -g["start_ts"])
+    for g in out:
+        g["dur_s"] = max(0.0, g["end_ts"] - g["start_ts"])
+    return out
+
+
+def get(trace_id: str, *, address: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble one trace (full id or unique prefix) into its causal
+    forest: ``{"trace_id", "spans": [...], "roots": [tree...]}``."""
+    spans = [
+        s for s in _harvest(address)
+        if s["trace_id"] == trace_id or s["trace_id"].startswith(trace_id)
+    ]
+    full_ids = {s["trace_id"] for s in spans}
+    if len(full_ids) > 1:
+        raise ValueError(
+            f"trace id prefix {trace_id!r} is ambiguous: {sorted(full_ids)}"
+        )
+    return {
+        "trace_id": next(iter(full_ids), trace_id),
+        "spans": spans,
+        "roots": _assemble(spans),
+    }
+
+
+# -- analysis ----------------------------------------------------------
+
+
+def critical_path(
+    trace: Union[str, Dict[str, Any]], *, address: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """The chain that determined end-to-end latency: from the root, follow
+    the child whose END time is latest (the hop the parent was still
+    waiting on), down to a leaf. Each element's ``self_s`` is its duration
+    minus the next element's — the time attributable to that hop alone —
+    so the column sums (telescoping) to the root's duration exactly."""
+    if isinstance(trace, str):
+        trace = get(trace, address=address)
+    roots = trace["roots"]
+    if not roots:
+        return []
+    node = max(roots, key=lambda r: r["dur_s"] or 0.0)
+    path: List[Dict[str, Any]] = []
+    while True:
+        nxt = max(
+            node["children"],
+            key=lambda c: (c["start_ts"] or 0.0) + (c["dur_s"] or 0.0),
+            default=None,
+        )
+        row = {k: node.get(k) for k in _ROW_KEYS}
+        row["self_s"] = max(
+            0.0,
+            (node["dur_s"] or 0.0)
+            - ((nxt["dur_s"] or 0.0) if nxt is not None else 0.0),
+        )
+        path.append(row)
+        if nxt is None:
+            return path
+        node = nxt
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(
+        len(sorted_vals) - 1,
+        max(0, int(round(q * (len(sorted_vals) - 1)))),
+    )
+    return sorted_vals[idx]
+
+
+#: a fan-out needs at least this many same-name siblings before straggler
+#: statistics mean anything
+_MIN_SIBLINGS = 4
+
+#: and the flagged child must also be meaningfully slower than typical —
+#: p95-of-3-siblings alone would flag healthy jitter
+_MEDIAN_FACTOR = 1.2
+
+
+def stragglers(
+    trace: Union[str, Dict[str, Any]], *, address: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Fan-out straggler report: within every group of same-name siblings
+    (≥ ``_MIN_SIBLINGS``), flag children slower than the p95 of the OTHER
+    siblings AND ``_MEDIAN_FACTOR``× the group median. Each row carries
+    node/worker attribution from the span attrs so the answer is "this
+    worker on this node", not just "something was slow"."""
+    if isinstance(trace, str):
+        trace = get(trace, address=address)
+    flagged: List[Dict[str, Any]] = []
+
+    def _walk(node):
+        groups: Dict[str, List[Dict[str, Any]]] = {}
+        for c in node["children"]:
+            groups.setdefault(c["name"], []).append(c)
+        for name, sibs in groups.items():
+            if len(sibs) >= _MIN_SIBLINGS:
+                durs = sorted((s["dur_s"] or 0.0) for s in sibs)
+                median = _percentile(durs, 0.50)
+                for s in sibs:
+                    others = sorted(
+                        (o["dur_s"] or 0.0) for o in sibs if o is not s
+                    )
+                    p95 = _percentile(others, 0.95)
+                    d = s["dur_s"] or 0.0
+                    if d > p95 and d > _MEDIAN_FACTOR * median:
+                        attrs = s.get("attrs") or {}
+                        flagged.append(
+                            {
+                                "span_id": s["span_id"],
+                                "name": name,
+                                "dur_s": d,
+                                "p95_siblings_s": p95,
+                                "median_s": median,
+                                "node_id": attrs.get("node_id")
+                                or s.get("node_id"),
+                                "worker_id": attrs.get("worker_id"),
+                                "parent_span_id": s["parent_span_id"],
+                            }
+                        )
+        for c in node["children"]:
+            _walk(c)
+
+    for root in trace["roots"]:
+        _walk(root)
+    flagged.sort(key=lambda r: -r["dur_s"])
+    return flagged
+
+
+# -- export ------------------------------------------------------------
+
+
+def export_chrome(
+    trace: Union[str, Dict[str, Any]],
+    filename: Optional[str] = None,
+    *,
+    address: Optional[str] = None,
+    merge_timeline: bool = False,
+) -> List[Dict[str, Any]]:
+    """Chrome-tracing events for one trace (view in ui.perfetto.dev):
+    "X" slices on the same ``node:<id>`` pid lanes ``timeline()`` uses,
+    one tid row per recording process, so ``merge_timeline=True`` overlays
+    the trace on the always-on task timeline."""
+    if isinstance(trace, str):
+        trace = get(trace, address=address)
+    events: List[Dict[str, Any]] = []
+    lanes: Dict[tuple, None] = {}
+    for s in trace["spans"]:
+        nid = s.get("node_id") or ""
+        pid = f"node:{nid[:12]}" if nid else "trace (no node)"
+        tid = s.get("process") or "?"
+        lanes.setdefault((pid, tid))
+        events.append(
+            {
+                "name": s["name"],
+                "cat": f"trace:{s['kind']}",
+                "ph": "X",
+                "ts": (s["start_ts"] or 0.0) * 1e6,
+                "dur": max(0.0, (s["dur_s"] or 0.0) * 1e6),
+                "pid": pid,
+                "tid": tid,
+                "args": {
+                    "trace_id": s["trace_id"],
+                    "span_id": s["span_id"],
+                    "status": s.get("status"),
+                    **(s.get("attrs") or {}),
+                },
+            }
+        )
+    for pid, tid in lanes:
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": tid}}
+        )
+    if merge_timeline:
+        from ray_tpu.util.state import timeline
+
+        events.extend(timeline(address=address))
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

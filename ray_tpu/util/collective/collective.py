@@ -323,6 +323,24 @@ def _record(
         internal_metrics.set_gauge(
             "ray_tpu_collective_duty_cycle", min(1.0, dt / gap)
         )
+    # distributed tracing: collectives run inside a traced task (the
+    # executor installed the context), so record the op retroactively —
+    # _record is called once per completed op with its duration in hand
+    from ray_tpu._private import trace as _trace
+
+    if _trace._active:
+        ctx = _trace.current()
+        if ctx is not None and ctx.sampled:
+            _trace.record_span(
+                ctx.trace_id, _trace.new_span_id(), ctx.span_id,
+                f"collective.{op}", "collective", time.time() - dt, dt,
+                attrs={
+                    "group": group.name, "rank": group.rank,
+                    "world_size": group.world_size, "backend": backend,
+                    "bytes": int(logical_bytes),
+                },
+                sampled=ctx.sampled,
+            )
 
 
 def _use_ring(group: _Group, value: np.ndarray) -> bool:

@@ -35,6 +35,7 @@ __all__ = [
     "list_placement_groups",
     "list_tasks",
     "read_log_chunk",
+    "list_trace_spans",
     "summarize_rpcs",
     "summarize_tasks",
     "timeline",
@@ -173,7 +174,13 @@ def _latest_task_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     different processes (RUNNING from the executor, FINISHED from the owner)
     so GCS arrival order is not lifecycle order: the furthest lifecycle
     stage wins, timestamp breaks ties."""
-    rank = {"PENDING_ARGS_AVAIL": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
+    rank = {
+        "PENDING_ARGS_AVAIL": 0,
+        "RUNNING": 1,
+        "FAILED": 2,
+        "CANCELLED": 2,
+        "FINISHED": 2,
+    }
     latest: Dict[str, Dict[str, Any]] = {}
     first_ts: Dict[str, float] = {}
     for ev in events:
@@ -265,33 +272,51 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _duration_stats(durs: List[float]) -> Dict[str, float]:
+    return {
+        "count": len(durs),
+        "mean_s": sum(durs) / len(durs),
+        "p50_s": _percentile(durs, 0.50),
+        "p95_s": _percentile(durs, 0.95),
+    }
+
+
 def summarize_tasks(*, address: Optional[str] = None) -> Dict[str, Any]:
     """Counts by (name, state) — the `ray summary tasks` equivalent — plus
-    per-name execution duration stats (count / mean / p50 / p95 seconds)
-    computed from RUNNING→FINISHED event pairs."""
+    per-name execution duration stats (count / mean / p50 / p95 seconds).
+    RUNNING→FINISHED pairs land in ``duration``; RUNNING→FAILED/CANCELLED
+    pairs get their own ``failed_duration`` column — folding them into one
+    distribution would poison the success percentiles, dropping them (the
+    old behavior) under-reported churn entirely."""
     events = _gcs_call("get_task_events", address=address)
     by_name: Dict[str, Counter] = defaultdict(Counter)
     for row in _latest_task_rows(events):
         by_name[row["name"]][row["state"]] += 1
     starts: Dict[str, Dict[str, Any]] = {}
     durations: Dict[str, List[float]] = defaultdict(list)
+    failed_durations: Dict[str, List[float]] = defaultdict(list)
     for ev in sorted(events, key=lambda e: e["ts"]):
         if ev["state"] == "RUNNING":
             starts[ev["task_id"]] = ev
-        elif ev["state"] == "FINISHED" and ev["task_id"] in starts:
+        elif (
+            ev["state"] in ("FINISHED", "FAILED", "CANCELLED")
+            and ev["task_id"] in starts
+        ):
             start = starts.pop(ev["task_id"])
-            durations[start["name"]].append(max(0.0, ev["ts"] - start["ts"]))
+            dur = max(0.0, ev["ts"] - start["ts"])
+            if ev["state"] == "FINISHED":
+                durations[start["name"]].append(dur)
+            else:
+                failed_durations[start["name"]].append(dur)
     out: Dict[str, Any] = {}
     for name, states in sorted(by_name.items()):
         entry: Dict[str, Any] = dict(states)
         durs = sorted(durations.get(name, ()))
         if durs:
-            entry["duration"] = {
-                "count": len(durs),
-                "mean_s": sum(durs) / len(durs),
-                "p50_s": _percentile(durs, 0.50),
-                "p95_s": _percentile(durs, 0.95),
-            }
+            entry["duration"] = _duration_stats(durs)
+        failed = sorted(failed_durations.get(name, ()))
+        if failed:
+            entry["failed_duration"] = _duration_stats(failed)
         out[name] = entry
     return out
 
@@ -731,6 +756,56 @@ def dump_stacks(
     result = _Report(report)
     result.errors = errors
     return result
+
+
+def list_trace_spans(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Harvest every process's span ring: the connected driver's own, the
+    GCS's, and — through each alive raylet — every registered worker's
+    (the dump_stacks fan-out, pointed at ``trace_spans``). Returns a flat
+    list of span dicts annotated with ``node_id``/``process``, plus an
+    ``errors`` attribute for unreachable nodes — partial results beat no
+    results when a node died mid-trace."""
+    rows = StateListResult()
+
+    def _extend(snapshot: Dict[str, Any], node_id: str, process: str):
+        for span in (snapshot or {}).get("spans", ()):
+            span = dict(span)
+            span["node_id"] = node_id
+            span["process"] = process
+            rows.append(span)
+
+    if address is None:
+        # the driver's own ring first: root spans live here and the driver
+        # serves no RPC endpoint the fan-out could reach
+        import ray_tpu._private.worker as worker_mod
+
+        from ray_tpu._private import trace as _trace
+
+        w = worker_mod.global_worker
+        drv_node = ""
+        if w is not None and w.core.node_id is not None:
+            drv_node = w.core.node_id.hex()
+        _extend(_trace.snapshot(), drv_node, "driver")
+    try:
+        _extend(_gcs_call("trace_spans", address=address), "", "gcs")
+    except Exception as e:  # noqa: BLE001
+        _record_node_error(rows.errors, "list_trace_spans", "gcs", e)
+    for node in list_nodes(address=address):
+        if not node.get("alive"):
+            continue
+        nid = node["node_id"].hex()
+        raylet_addr = "{}:{}".format(*node["address"])
+        try:
+            res = _cached_client(raylet_addr).call(
+                "trace_spans", {}, timeout=30.0
+            )
+            for key, snap in (res.get("processes") or {}).items():
+                if "error" in (snap or {}):
+                    continue  # worker died mid-harvest: keep the rest
+                _extend(snap, nid, key)
+        except Exception as e:  # noqa: BLE001
+            _record_node_error(rows.errors, "list_trace_spans", nid, e)
+    return rows
 
 
 def format_stack_report(report: Dict[str, Any]) -> str:

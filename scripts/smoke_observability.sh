@@ -17,6 +17,9 @@ env JAX_PLATFORMS=cpu python scripts/perf_smoke.py
 # serve plane under load: continuous batching >=2x, shed -> recover at 2x
 # capacity, sub-second multiplex swap
 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+# tracing plane end to end: cross-node assembly, critical path within 10%
+# of e2e, planted straggler flagged, unsampled hook under budget
+env JAX_PLATFORMS=cpu python scripts/trace_smoke.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
     tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py \
-    tests/test_perf_plane.py "$@"
+    tests/test_perf_plane.py tests/test_trace.py "$@"

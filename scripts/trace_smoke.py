@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Per-PR tracing-plane smoke (<60 s): end-to-end distributed tracing on a
+real 2-node in-process cluster.
+
+Hard-fails (nonzero exit) when any leg breaks:
+  1. Assembly: a cross-node fan-out under ``trace.start()`` harvests into
+     ONE trace whose causal tree matches the submission structure (root ->
+     mid task -> leaf tasks on the second node).
+  2. Critical path: the telescoping self-time column sums to within 10%
+     of the measured end-to-end latency.
+  3. Stragglers: the one deliberately slow leaf is flagged, with node and
+     worker attribution.
+  4. Overhead: the unsampled trace hook stays under its fixed ns/op
+     ceiling (quick pass; bench_core.py --attribute runs the full bench).
+
+Usage: env JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL trace_smoke: {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    t_start = time.time()
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=4, resources={"B": 4.0})
+    ray_tpu.init(
+        address=cluster.address,
+        log_level="ERROR",
+        _system_config={"trace_sample": 1.0},
+    )
+
+    @ray_tpu.remote(resources={"B": 0.001})
+    def leaf(i):
+        time.sleep(0.3 if i == 0 else 0.05)  # i=0 is the planted straggler
+        return i
+
+    @ray_tpu.remote
+    def mid(n):
+        return sum(ray_tpu.get([leaf.remote(i) for i in range(n)]))
+
+    # warm the worker pool so trace timing measures the workload, not spawns
+    ray_tpu.get([leaf.remote(9), mid.remote(0)])
+
+    t0 = time.perf_counter()
+    with ray_tpu.trace.start("smoke") as root:
+        if ray_tpu.get(mid.remote(6)) != 15:
+            fail("workload returned wrong result")
+    e2e_s = time.perf_counter() - t0
+
+    # -- leg 1: one assembled trace matching the causal structure --------
+    trace = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        trace = ray_tpu.trace.get(root.trace_id)
+        names = [s["name"] for s in trace["spans"]]
+        if names.count("task:leaf") >= 6 and "task:mid" in names:
+            break
+        time.sleep(0.3)
+    else:
+        fail(f"trace never fully harvested: {sorted(set(names))}")
+    roots = trace["roots"]
+    if len(roots) != 1 or roots[0]["name"] != "trace:smoke":
+        fail(f"expected single trace:smoke root, got {[r['name'] for r in roots]}")
+
+    def _find(node, name):
+        if node["name"] == name:
+            return node
+        for c in node["children"]:
+            hit = _find(c, name)
+            if hit is not None:
+                return hit
+        return None
+
+    mid_span = _find(roots[0], "task:mid")
+    if mid_span is None:
+        fail("task:mid not linked under the root span")
+    leaves = [c for c in mid_span["children"] if c["name"] == "task:leaf"]
+    if len(leaves) != 6:
+        fail(f"expected 6 task:leaf children under task:mid, got {len(leaves)}")
+    mid_nid = mid_span["attrs"]["node_id"]
+    leaf_nids = {c["attrs"]["node_id"] for c in leaves}
+    if not leaf_nids or mid_nid in leaf_nids:
+        fail("leaves did not execute on a different node than mid")
+    print(
+        f"ok assembly: 1 trace, {len(trace['spans'])} spans, "
+        f"mid on {mid_nid[:8]}, leaves on {sorted(n[:8] for n in leaf_nids)}"
+    )
+
+    # -- leg 2: critical path within 10% of end-to-end -------------------
+    path = ray_tpu.trace.critical_path(trace)
+    cp_s = sum(h["self_s"] for h in path)
+    if abs(cp_s - e2e_s) > 0.10 * e2e_s:
+        fail(f"critical path {cp_s:.3f}s vs e2e {e2e_s:.3f}s (>10% off)")
+    print(
+        f"ok critical path: {cp_s * 1e3:.1f}ms over {len(path)} hops "
+        f"vs e2e {e2e_s * 1e3:.1f}ms"
+    )
+
+    # -- leg 3: planted straggler flagged with attribution ----------------
+    stragglers = ray_tpu.trace.stragglers(trace)
+    slow = [r for r in stragglers if r["name"] == "task:leaf"]
+    if not slow:
+        fail(f"planted 300ms leaf not flagged (report: {stragglers})")
+    row = slow[0]
+    if not row.get("node_id") or not row.get("worker_id"):
+        fail(f"straggler row missing attribution: {row}")
+    print(
+        f"ok stragglers: task:leaf {row['dur_s'] * 1e3:.0f}ms vs sibling "
+        f"p95 {row['p95_siblings_s'] * 1e3:.0f}ms on worker "
+        f"{row['worker_id'][:8]}@{row['node_id'][:8]}"
+    )
+
+    ray_tpu.shutdown()
+    cluster.shutdown()
+
+    # -- leg 4: unsampled hook under budget (quick pass) ------------------
+    from ray_tpu._private import perf as perf_core
+
+    ns = perf_core.measure_overhead(iters=20_000, repeats=3)[
+        "trace_hook_disabled"
+    ]
+    budget = perf_core.OVERHEAD_BUDGET_NS["trace_hook_disabled"]
+    if ns > budget:
+        fail(f"unsampled trace hook {ns:.0f}ns/op over budget {budget:.0f}ns")
+    print(f"ok overhead: trace_hook_disabled {ns:.0f}ns/op <= {budget:.0f}ns")
+
+    print(f"trace_smoke PASS in {time.time() - t_start:.1f}s")
+
+
+if __name__ == "__main__":
+    main()
