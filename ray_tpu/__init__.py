@@ -50,6 +50,7 @@ from ray_tpu import chaos
 # perf plane (ray_tpu.perf.profile/record/summarize_rpcs); also a plain
 # import — perf.py lazy-imports the RPC layer on first call
 from ray_tpu import perf
+from ray_tpu import slo
 from ray_tpu import trace
 
 
@@ -70,6 +71,8 @@ __all__ = [
     "timeline",
     "chaos",
     "perf",
+    "slo",
+    "trace",
     "remote",
     "get",
     "put",

@@ -148,6 +148,28 @@ class _Config:
         "trace_sample": 0.0,
         "task_events_buffer_size": 100_000,
         "metrics_report_period_s": 5.0,
+        # --- metrics time-series retention + SLO plane (gcs + metrics_ts) ---
+        # fine ring: one cluster-aggregated sample per report period
+        "metrics_ts_fine_samples": 360,
+        # coarse ring keeps every Nth fold for the long horizon
+        "metrics_ts_coarse_every": 12,
+        "metrics_ts_coarse_samples": 720,
+        # hard cap on distinct (metric, series) rings; overflow is counted
+        # in ray_tpu_metrics_ts_dropped_series_total, not retained
+        "metrics_ts_max_series": 2000,
+        # a reporter idle longer than this makes its series STALE for SLO
+        # evaluation (alerts hold state instead of flapping); 0 = auto
+        # (3 x metrics_report_period_s). Reporters idle > 12 periods are
+        # pruned entirely, with counters folded into the tombstone
+        # accumulator so cluster totals stay monotonic.
+        "metrics_stale_after_s": 0.0,
+        # serve: define default per-deployment latency/availability SLO
+        # rules at deploy time (targets generous enough to stay silent on
+        # a healthy deployment; override per deployment via slo_p99_s /
+        # slo_availability in the @serve.deployment config)
+        "serve_default_slos": True,
+        "serve_slo_default_p99_s": 60.0,
+        "serve_slo_default_availability": 0.9,
         "log_dir": "",
         # --- TPU topology ---
         "tpu_slice_gang_scheduling": True,

@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.config import GlobalConfig
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer, ServerConn
+from ray_tpu._private import metrics_ts
 from ray_tpu._private import trace as _trace
 
 logger = logging.getLogger(__name__)
@@ -173,6 +174,16 @@ class GcsServer:
         # the dashboard's event_agent. Ring-buffered, queryable via
         # rpc_list_cluster_events, live via the "cluster_events" channel.
         self._cluster_events: List[Dict[str, Any]] = []
+        # metrics plane: latest cumulative snapshot per reporter, plus the
+        # time-series retention + SLO layer fed once per report period by
+        # _maybe_fold_metrics. Tombstones keep pruned (exited) reporters'
+        # final counter/histogram values so cluster totals stay monotonic.
+        self._metrics: Dict[str, Tuple[float, List[Dict[str, Any]]]] = {}
+        self._metrics_tombstones: Dict[str, Dict[str, Any]] = {}
+        self._ts_store = metrics_ts.TimeSeriesStore()
+        self._slo_engine = metrics_ts.SloEngine(self._ts_store)
+        self._slo_lock = threading.Lock()  # serializes engine + fold
+        self._ts_last_fold = 0.0
         # monotonically increasing chaos schedule version: every apply or
         # clear bumps it so late subscribers can order arm/clear events
         self._chaos_version = 0
@@ -1617,63 +1628,158 @@ class GcsServer:
     def rpc_report_metrics(self, conn, payload):
         reporter, records = payload  # cluster-unique "worker_id:pid" key
         with self._lock:
-            if not hasattr(self, "_metrics"):
-                self._metrics = {}
             self._metrics[reporter] = (time.time(), records)
+        self._maybe_fold_metrics()
         return True
 
-    def _live_metric_records(self):
+    def _live_metric_records(self, now: Optional[float] = None):
         """Snapshot of per-process metric reports, evicting reporters that
-        stopped refreshing (dead workers — like a Prometheus target dropping
-        out of a scrape, their series disappear rather than accumulate)."""
+        stopped refreshing (dead workers — like a Prometheus target
+        dropping out of a scrape). A pruned reporter's final counter and
+        histogram values fold into the tombstone accumulator first, so
+        cluster totals stay monotonic and ``rate()`` never sees a phantom
+        negative spike when a worker exits; its gauges (point-in-time
+        readings from a dead process) do disappear. Returns
+        ``(tombstone_records, [per-live-reporter record lists])``."""
         stale_after = 12 * GlobalConfig.metrics_report_period_s
-        now = time.time()
+        if now is None:
+            now = time.time()
         with self._lock:
-            metrics = getattr(self, "_metrics", {})
             for reporter in [
-                r for r, (ts, _) in metrics.items() if now - ts > stale_after
+                r for r, (ts, _) in self._metrics.items()
+                if now - ts > stale_after
             ]:
-                del metrics[reporter]
-            return [records for _, records in metrics.values()]
+                _, records = self._metrics.pop(reporter)
+                metrics_ts.merge_records(
+                    self._metrics_tombstones,
+                    [rec for rec in records if rec["type"] != "gauge"],
+                )
+            return (
+                list(self._metrics_tombstones.values()),
+                [records for _, records in self._metrics.values()],
+            )
+
+    def _aggregate_metrics(
+        self, name_filter: Optional[str] = None, now: Optional[float] = None
+    ) -> List[Dict[str, Any]]:
+        """Cluster-wide aggregate: sum counters + histogram buckets (over
+        live reporters AND tombstoned exited ones), last-write gauges."""
+        tombstones, per_proc = self._live_metric_records(now)
+        merged: Dict[str, Dict[str, Any]] = {}
+        metrics_ts.merge_records(merged, tombstones, name_filter)
+        for records in per_proc:
+            metrics_ts.merge_records(merged, records, name_filter)
+        return list(merged.values())
 
     def rpc_get_metrics(self, conn, payload=None):
-        """Aggregate across reporting processes: sum counters + histogram
-        buckets, last-write-wins gauges."""
-        name_filter = payload
-        per_proc = self._live_metric_records()
-        merged: Dict[str, Dict[str, Any]] = {}
-        for records in per_proc:
-            for rec in records:
-                if name_filter is not None and rec["name"] != name_filter:
-                    continue
-                out = merged.setdefault(
-                    rec["name"],
-                    {
-                        "name": rec["name"],
-                        "type": rec["type"],
-                        "description": rec["description"],
-                        "series": {},
-                    },
+        return self._aggregate_metrics(payload)
+
+    # -- time-series retention + SLO evaluation ------------------------
+
+    def _maybe_fold_metrics(self):
+        """At most once per report period: fold the current cluster
+        aggregate into the retained rings and run the SLO engine. Driven
+        by incoming report_metrics traffic (reporters push every period,
+        loaded or not, so evaluation cadence is sustained)."""
+        if not self._slo_lock.acquire(blocking=False):
+            return  # another report is already folding
+        transitions = []
+        firing = series = dropped = None
+        try:
+            now = time.time()
+            if now - self._ts_last_fold < GlobalConfig.metrics_report_period_s:
+                return
+            self._ts_last_fold = now
+            self._ts_store.append_records(now, self._aggregate_metrics(now=now))
+            transitions = self._slo_engine.evaluate(
+                now, self._stale_metric_names(now)
+            )
+            firing = self._slo_engine.firing_count()
+            series = self._ts_store.series_count()
+            dropped = self._ts_store.dropped_series
+        finally:
+            self._slo_lock.release()
+        if firing is None:
+            return
+        from ray_tpu._private import internal_metrics
+
+        internal_metrics.set_gauge("ray_tpu_alerts_firing", float(firing))
+        internal_metrics.set_gauge("ray_tpu_metrics_ts_series", float(series))
+        last_dropped = getattr(self, "_ts_dropped_reported", 0)
+        if dropped > last_dropped:
+            internal_metrics.inc(
+                "ray_tpu_metrics_ts_dropped_series_total",
+                dropped - last_dropped,
+            )
+            self._ts_dropped_reported = dropped
+        for t in transitions:
+            alert = t["alert"]
+            win = (alert.get("windows") or [{}])[0]
+            if t["to"] == "firing":
+                exemplars = [e["trace_id"] for e in alert.get("exemplars", [])]
+                self._record_cluster_event(
+                    "ALERT_FIRING",
+                    f"SLO {t['name']} firing: value={alert.get('value')} "
+                    f"threshold={win.get('threshold')}",
+                    severity="WARNING",
+                    rule=t["name"],
+                    value=alert.get("value"),
+                    exemplars=exemplars,
                 )
-                for key, value in rec["series"].items():
-                    cur = out["series"].get(key)
-                    if cur is None:
-                        out["series"][key] = value
-                    elif rec["type"] == "counter":
-                        out["series"][key] = cur + value
-                    elif rec["type"] == "histogram":
-                        out["series"][key] = {
-                            "buckets": [
-                                a + b
-                                for a, b in zip(cur["buckets"], value["buckets"])
-                            ],
-                            "sum": cur["sum"] + value["sum"],
-                            "count": cur["count"] + value["count"],
-                            "boundaries": value["boundaries"],
-                        }
-                    else:  # gauge: last write wins
-                        out["series"][key] = value
-        return list(merged.values())
+            elif t["from"] == "firing":
+                self._record_cluster_event(
+                    "ALERT_RESOLVED",
+                    f"SLO {t['name']} resolved: value={alert.get('value')}",
+                    severity="INFO",
+                    rule=t["name"],
+                    value=alert.get("value"),
+                )
+
+    def _stale_metric_names(self, now: float):
+        """Metric names whose reporters stopped refreshing recently enough
+        that we can't tell outage from partition — SLO rules over them
+        hold their alert state instead of flapping."""
+        stale_after = (
+            GlobalConfig.metrics_stale_after_s
+            or 3 * GlobalConfig.metrics_report_period_s
+        )
+        names = set()
+        with self._lock:
+            for ts, records in self._metrics.values():
+                if now - ts > stale_after:
+                    names.update(rec["name"] for rec in records)
+        return frozenset(names)
+
+    def rpc_query_metrics(self, conn, payload=None):
+        """Retained history: ``{"list": True}`` for known names, else
+        ``{"name", "tags"?, "window_s"?}`` -> samples (see
+        TimeSeriesStore.query)."""
+        p = payload or {}
+        if p.get("list"):
+            return {"names": self._ts_store.names()}
+        return self._ts_store.query(
+            p.get("name", ""), p.get("tags"), p.get("window_s")
+        )
+
+    def rpc_slo_define(self, conn, payload):
+        """Define (or replace) SLO rules; payload is one rule dict or a
+        list of them. Validation errors raise back to the caller."""
+        rules = payload if isinstance(payload, list) else [payload]
+        with self._slo_lock:
+            out = [self._slo_engine.define(r) for r in rules]
+        return out if isinstance(payload, list) else out[0]
+
+    def rpc_slo_remove(self, conn, payload):
+        with self._slo_lock:
+            return self._slo_engine.remove(str(payload))
+
+    def rpc_slo_list(self, conn, payload=None):
+        with self._slo_lock:
+            return self._slo_engine.rules()
+
+    def rpc_alerts(self, conn, payload=None):
+        with self._slo_lock:
+            return self._slo_engine.alerts()
 
     def rpc_trace_spans(self, conn, payload=None):
         """Trace-harvest GCS leg: this process's own span ring (the GCS

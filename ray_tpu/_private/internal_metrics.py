@@ -103,6 +103,9 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "counter", "requests handled by replicas", ("deployment",)),
     "ray_tpu_serve_request_latency_seconds": (
         "histogram", "replica request handling wall time", ("deployment",)),
+    "ray_tpu_serve_request_errors_total": (
+        "counter", "requests that raised inside the replica handler",
+        ("deployment",)),
     "ray_tpu_serve_queue_depth": (
         "gauge", "in-flight requests on the replica", ("deployment",)),
     "ray_tpu_serve_proxy_requests_total": (
@@ -197,6 +200,19 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
     "ray_tpu_node_degraded": (
         "gauge",
         "nodes currently in the DEGRADED gray-failure state (GCS view)",
+        ()),
+    # -- metrics time-series + SLO plane ------------------------------
+    "ray_tpu_alerts_firing": (
+        "gauge", "SLO alert rules currently in the FIRING state", ()),
+    "ray_tpu_metrics_ts_series": (
+        "gauge",
+        "distinct (metric, series) rings retained by the GCS time-series "
+        "store",
+        ()),
+    "ray_tpu_metrics_ts_dropped_series_total": (
+        "counter",
+        "new series rejected by the metrics_ts_max_series cap (history "
+        "not retained)",
         ()),
     # -- cancellation / graceful drain --------------------------------
     "ray_tpu_tasks_cancelled_total": (

@@ -1,0 +1,716 @@
+"""Metrics time-series retention + SLO evaluation (GCS-side substrate).
+
+The snapshot metrics plane (``util/metrics.py`` reporters ->
+``rpc_report_metrics`` -> ``rpc_get_metrics``) only ever holds the latest
+cumulative value per process, so "what was the serve p99 over the last
+30 s" was unanswerable. This module adds the missing substrate, all of it
+plain data structures so the GCS can drive it and tests can drive it
+without a cluster:
+
+- :class:`SeriesRing`: per-(metric, series) history of timestamped
+  *cluster-aggregated* cumulative samples — a fine ring at report-period
+  resolution plus a downsampled coarse ring for a longer horizon, both
+  deques with hard ``maxlen`` caps so memory is bounded.
+- :class:`TimeSeriesStore`: the keyed collection of rings with a hard
+  series cap, fed once per fold by ``GcsServer._fold_metrics`` and read
+  by the query RPCs.
+- merge helpers (:func:`merge_records` / :func:`merge_value`): the one
+  aggregation routine shared by ``rpc_get_metrics``, the fold, and the
+  stale-reporter tombstone accumulator — counters/histogram buckets sum,
+  gauges last-write, histogram exemplars keep the newest per bucket.
+- window math: :func:`counter_increase` / :func:`window_rate` with
+  Prometheus-style counter-reset detection, :func:`histogram_increase`
+  bucket deltas, and :func:`quantile_from_buckets` interpolation.
+- :func:`parse_expr` + :class:`SloEngine`: a tiny PromQL-shaped rule
+  language (``histogram_quantile(0.99, name{tag="v"})``,
+  ``rate(a{...}) / rate(b{...})``, ``rate(...)``, ``gauge(...)``)
+  evaluated each fold with multi-window burn-rate logic and an
+  ok -> pending -> firing -> resolved state machine. Rules whose series
+  went stale (reporting node partitioned/unreachable) HOLD their state —
+  a blip in reporting must not flap an alert.
+
+Reference shape: Prometheus recording/alerting rules + the Google SRE
+multiwindow multi-burn-rate pattern, scaled down to the GCS's in-process
+world.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import deque
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.config import GlobalConfig
+
+SeriesKey = Tuple[Tuple[str, str], ...]
+Sample = Tuple[float, Any]  # (unix ts, cumulative value)
+
+#: trace exemplars attached to a firing alert (newest / slowest first)
+MAX_ALERT_EXEMPLARS = 4
+
+
+# ---------------------------------------------------------------------------
+# aggregation (shared by rpc_get_metrics, the fold, and tombstones)
+# ---------------------------------------------------------------------------
+
+
+def copy_value(mtype: str, value: Any) -> Any:
+    """An owned copy of one series value (histogram dicts are mutable and
+    must never be aliased between reporter state, tombstones, and rings)."""
+    if mtype != "histogram":
+        return value
+    out = {
+        "buckets": list(value["buckets"]),
+        "sum": value["sum"],
+        "count": value["count"],
+        "boundaries": value.get("boundaries"),
+    }
+    ex = value.get("exemplars")
+    if ex:
+        out["exemplars"] = dict(ex)
+    return out
+
+
+def merge_value(mtype: str, cur: Any, value: Any) -> Any:
+    """Fold one reporter's series value into the running aggregate:
+    counters/histograms sum, gauges last-write-wins. Always returns a
+    fresh object (never mutates ``cur`` or aliases ``value``)."""
+    if cur is None:
+        return copy_value(mtype, value)
+    if mtype == "counter":
+        return cur + value
+    if mtype != "histogram":
+        return value  # gauge: last write wins
+    if len(cur["buckets"]) != len(value["buckets"]):
+        # boundary mismatch (metric redefined): last write wins
+        return copy_value(mtype, value)
+    out = {
+        "buckets": [a + b for a, b in zip(cur["buckets"], value["buckets"])],
+        "sum": cur["sum"] + value["sum"],
+        "count": cur["count"] + value["count"],
+        "boundaries": value.get("boundaries") or cur.get("boundaries"),
+    }
+    exemplars: Dict[int, Tuple] = {}
+    for src in (cur.get("exemplars"), value.get("exemplars")):
+        if not src:
+            continue
+        for idx, ex in src.items():
+            old = exemplars.get(idx)
+            # exemplar tuples are (trace_id, value, ts): newest wins
+            if old is None or _exemplar_ts(ex) >= _exemplar_ts(old):
+                exemplars[idx] = ex
+    if exemplars:
+        out["exemplars"] = exemplars
+    return out
+
+
+def _exemplar_ts(ex) -> float:
+    try:
+        return float(ex[2])
+    except (IndexError, TypeError, ValueError):
+        return 0.0
+
+
+def merge_records(
+    merged: Dict[str, Dict[str, Any]],
+    records: Sequence[Dict[str, Any]],
+    name_filter: Optional[str] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Merge one reporter's (or the tombstone accumulator's) record list
+    into ``merged`` in place; returns ``merged`` for chaining."""
+    for rec in records:
+        if name_filter is not None and rec["name"] != name_filter:
+            continue
+        out = merged.setdefault(
+            rec["name"],
+            {
+                "name": rec["name"],
+                "type": rec["type"],
+                "description": rec["description"],
+                "series": {},
+            },
+        )
+        for key, value in rec["series"].items():
+            out["series"][key] = merge_value(
+                rec["type"], out["series"].get(key), value
+            )
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# retained history
+# ---------------------------------------------------------------------------
+
+
+class SeriesRing:
+    """Bounded history for one (metric, series): a fine ring at fold
+    resolution plus a coarse ring keeping every Nth cumulative sample for
+    a longer horizon. Values are cumulative, so downsampling loses
+    resolution, not mass — rates/deltas over the coarse ring stay exact
+    between the samples it kept."""
+
+    __slots__ = ("fine", "coarse", "_folds")
+
+    def __init__(self, fine_cap: int, coarse_cap: int):
+        self.fine: deque = deque(maxlen=max(2, int(fine_cap)))
+        self.coarse: deque = deque(maxlen=max(2, int(coarse_cap)))
+        self._folds = 0
+
+    def append(self, ts: float, value: Any, coarse_every: int):
+        self.fine.append((ts, value))
+        self._folds += 1
+        if self._folds % max(1, int(coarse_every)) == 0:
+            self.coarse.append((ts, value))
+
+    def samples(
+        self, window_s: Optional[float] = None, now: Optional[float] = None
+    ) -> List[Sample]:
+        """Coarse history spliced before the fine ring (no overlap),
+        optionally clipped to the trailing ``window_s``."""
+        fine = list(self.fine)
+        oldest_fine = fine[0][0] if fine else float("inf")
+        out = [s for s in self.coarse if s[0] < oldest_fine] + fine
+        if window_s is not None:
+            if now is None:
+                now = out[-1][0] if out else 0.0
+            cutoff = now - window_s
+            out = [s for s in out if s[0] >= cutoff]
+        return out
+
+
+class TimeSeriesStore:
+    """All retained rings, keyed by (metric name, series key). Hard caps:
+    ring lengths bound per-series memory, ``max_series`` bounds the key
+    space (overflow series are counted in ``dropped_series``, not kept)."""
+
+    def __init__(
+        self,
+        *,
+        fine_cap: Optional[int] = None,
+        coarse_cap: Optional[int] = None,
+        coarse_every: Optional[int] = None,
+        max_series: Optional[int] = None,
+    ):
+        self._fine_cap = fine_cap
+        self._coarse_cap = coarse_cap
+        self._coarse_every = coarse_every
+        self._max_series = max_series
+        self._rings: Dict[Tuple[str, SeriesKey], SeriesRing] = {}
+        self._meta: Dict[str, Dict[str, str]] = {}
+        self._lock = threading.Lock()
+        self.dropped_series = 0
+
+    # config knobs re-read per fold so _system_config applies live
+    def _cfg(self, explicit, key):
+        return explicit if explicit is not None else GlobalConfig.get(key)
+
+    def append_records(self, ts: float, records: Sequence[Dict[str, Any]]):
+        """Fold one cluster-aggregated snapshot (the output of
+        :func:`merge_records`) into the rings."""
+        fine_cap = self._cfg(self._fine_cap, "metrics_ts_fine_samples")
+        coarse_cap = self._cfg(self._coarse_cap, "metrics_ts_coarse_samples")
+        coarse_every = self._cfg(self._coarse_every, "metrics_ts_coarse_every")
+        max_series = self._cfg(self._max_series, "metrics_ts_max_series")
+        with self._lock:
+            for rec in records:
+                self._meta[rec["name"]] = {
+                    "type": rec["type"],
+                    "description": rec["description"],
+                }
+                for key, value in rec["series"].items():
+                    rk = (rec["name"], key)
+                    ring = self._rings.get(rk)
+                    if ring is None:
+                        if len(self._rings) >= max_series:
+                            self.dropped_series += 1
+                            continue
+                        ring = self._rings[rk] = SeriesRing(fine_cap, coarse_cap)
+                    ring.append(ts, value, coarse_every)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._rings)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._meta)
+
+    def query(
+        self,
+        name: str,
+        tags: Optional[Dict[str, str]] = None,
+        window_s: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Retained samples for every series of ``name`` whose tags are a
+        superset of ``tags``: ``{"name", "type", "description",
+        "series": {key: [(ts, value), ...]}}`` or None if unknown."""
+        with self._lock:
+            meta = self._meta.get(name)
+            if meta is None:
+                return None
+            matches = [
+                (key, ring)
+                for (n, key), ring in self._rings.items()
+                if n == name and _tags_match(key, tags)
+            ]
+            series = {
+                key: ring.samples(window_s, now) for key, ring in matches
+            }
+        return {"name": name, **meta, "series": series}
+
+
+def _tags_match(key: SeriesKey, tags: Optional[Dict[str, str]]) -> bool:
+    if not tags:
+        return True
+    have = dict(key)
+    return all(have.get(k) == str(v) for k, v in tags.items())
+
+
+# ---------------------------------------------------------------------------
+# window math (Prometheus increase/rate/histogram_quantile semantics)
+# ---------------------------------------------------------------------------
+
+
+def counter_increase(samples: Sequence[Sample]) -> float:
+    """Sum of pairwise deltas with reset detection: a decrease means the
+    reporter restarted and the new cumulative value IS the increase since
+    the reset (Prometheus ``increase()``)."""
+    inc = 0.0
+    prev = None
+    for _, v in samples:
+        if prev is not None:
+            d = v - prev
+            inc += d if d >= 0 else v
+        prev = v
+    return inc
+
+
+def window_rate(samples: Sequence[Sample]) -> Optional[float]:
+    """Per-second rate over the sampled span; None with < 2 samples (no
+    delta information yet)."""
+    if len(samples) < 2:
+        return None
+    span = samples[-1][0] - samples[0][0]
+    if span <= 0:
+        return None
+    return counter_increase(samples) / span
+
+
+def histogram_increase(samples: Sequence[Sample]) -> Optional[Dict[str, Any]]:
+    """Windowed histogram delta, walked pairwise so a mid-window counter
+    reset contributes the restarted snapshot instead of a negative spike.
+    Returns ``{"boundaries", "buckets", "count", "sum"}`` or None with
+    < 2 samples."""
+    if len(samples) < 2:
+        return None
+    boundaries = None
+    delta: Optional[List[float]] = None
+    dcount = 0.0
+    dsum = 0.0
+    prev = None
+    for _, v in samples:
+        b = v.get("boundaries")
+        if b is not None:
+            boundaries = b
+        if delta is None or (prev is not None
+                             and len(prev["buckets"]) != len(v["buckets"])):
+            # first sample, or boundary change: restart the accumulator
+            delta = [0.0] * len(v["buckets"])
+            if prev is not None and len(prev["buckets"]) != len(v["buckets"]):
+                prev = None
+        if prev is not None:
+            if v["count"] >= prev["count"]:
+                for i in range(len(delta)):
+                    delta[i] += max(0.0, v["buckets"][i] - prev["buckets"][i])
+                dcount += v["count"] - prev["count"]
+                dsum += v["sum"] - prev["sum"]
+            else:  # reset: the new snapshot is the increase
+                for i in range(len(delta)):
+                    delta[i] += v["buckets"][i]
+                dcount += v["count"]
+                dsum += v["sum"]
+        prev = v
+    return {
+        "boundaries": boundaries,
+        "buckets": delta or [],
+        "count": dcount,
+        "sum": dsum,
+    }
+
+
+def quantile_from_buckets(
+    boundaries: Sequence[float], buckets: Sequence[float], q: float
+) -> Optional[float]:
+    """Prometheus ``histogram_quantile``: linear interpolation inside the
+    bucket holding rank q; the +Inf bucket clamps to the highest finite
+    boundary; None when the distribution is empty."""
+    total = sum(buckets)
+    if total <= 0 or not boundaries:
+        return None
+    rank = q * total
+    acc = 0.0
+    for i, c in enumerate(buckets):
+        acc += c
+        if acc >= rank and c > 0:
+            if i >= len(boundaries):  # +Inf bucket
+                return float(boundaries[-1])
+            lo = boundaries[i - 1] if i > 0 else 0.0
+            hi = boundaries[i]
+            frac = (rank - (acc - c)) / c
+            return lo + (hi - lo) * frac
+    return float(boundaries[-1])
+
+
+# ---------------------------------------------------------------------------
+# SLO expression language
+# ---------------------------------------------------------------------------
+
+_SELECTOR_RE = re.compile(r"^\s*([A-Za-z_:][A-Za-z0-9_:]*)\s*(?:\{(.*)\})?\s*$")
+_RATIO_RE = re.compile(r"^\s*rate\((.+?)\)\s*/\s*rate\((.+?)\)\s*$")
+_QUANTILE_RE = re.compile(r"^\s*histogram_quantile\(\s*([0-9.eE+-]+)\s*,(.+)\)\s*$")
+_RATE_RE = re.compile(r"^\s*rate\((.+)\)\s*$")
+_GAUGE_RE = re.compile(r"^\s*gauge\((.+)\)\s*$")
+
+
+def parse_selector(text: str) -> Tuple[str, Dict[str, str]]:
+    m = _SELECTOR_RE.match(text)
+    if not m:
+        raise ValueError(f"bad series selector: {text!r}")
+    name, raw = m.group(1), m.group(2)
+    tags: Dict[str, str] = {}
+    if raw and raw.strip():
+        for part in raw.split(","):
+            if "=" not in part:
+                raise ValueError(f"bad tag matcher {part!r} in {text!r}")
+            k, v = part.split("=", 1)
+            tags[k.strip()] = v.strip().strip("\"'")
+    return name, tags
+
+
+def parse_expr(expr: str) -> Dict[str, Any]:
+    """Parse one SLO expression into an eval plan. Supported forms::
+
+        rate(errs{...}) / rate(total{...})   -> kind "ratio"  (bad fraction)
+        histogram_quantile(0.99, lat{...})   -> kind "quantile"
+        rate(name{...})                      -> kind "rate"
+        gauge(name{...}) | name{...}         -> kind "gauge"
+    """
+    m = _RATIO_RE.match(expr)
+    if m:
+        num = parse_selector(m.group(1))
+        den = parse_selector(m.group(2))
+        return {"kind": "ratio", "num": num, "den": den}
+    m = _QUANTILE_RE.match(expr)
+    if m:
+        q = float(m.group(1))
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile must be in (0, 1]: {expr!r}")
+        name, tags = parse_selector(m.group(2))
+        return {"kind": "quantile", "q": q, "name": name, "tags": tags}
+    m = _RATE_RE.match(expr)
+    if m:
+        name, tags = parse_selector(m.group(1))
+        return {"kind": "rate", "name": name, "tags": tags}
+    m = _GAUGE_RE.match(expr)
+    if m:
+        name, tags = parse_selector(m.group(1))
+        return {"kind": "gauge", "name": name, "tags": tags}
+    name, tags = parse_selector(expr)
+    return {"kind": "gauge", "name": name, "tags": tags}
+
+
+def expr_metric_names(parsed: Dict[str, Any]) -> Tuple[str, ...]:
+    if parsed["kind"] == "ratio":
+        return (parsed["num"][0], parsed["den"][0])
+    return (parsed["name"],)
+
+
+def eval_expr(
+    store: TimeSeriesStore,
+    parsed: Dict[str, Any],
+    window_s: float,
+    now: Optional[float] = None,
+) -> Optional[float]:
+    """One scalar from the retained history, or None when there is not
+    enough data to say anything (treated as *not violating*)."""
+    kind = parsed["kind"]
+    if kind == "ratio":
+        den = _window_increase(store, *parsed["den"], window_s, now)
+        if den is None or den <= 0:
+            return None  # no traffic: error budget is not burning
+        num = _window_increase(store, *parsed["num"], window_s, now)
+        return (num or 0.0) / den
+    rec = store.query(parsed["name"], parsed["tags"], window_s, now)
+    if rec is None:
+        return None
+    if kind == "quantile":
+        merged = None
+        for samples in rec["series"].values():
+            inc = histogram_increase(samples)
+            if inc is None or not inc["buckets"]:
+                continue
+            if merged is None:
+                merged = inc
+            elif len(merged["buckets"]) == len(inc["buckets"]):
+                merged["buckets"] = [
+                    a + b for a, b in zip(merged["buckets"], inc["buckets"])
+                ]
+        if merged is None or not merged.get("boundaries"):
+            return None
+        return quantile_from_buckets(
+            merged["boundaries"], merged["buckets"], parsed["q"]
+        )
+    if kind == "rate":
+        rates = [
+            r for r in (window_rate(s) for s in rec["series"].values())
+            if r is not None
+        ]
+        return sum(rates) if rates else None
+    # gauge: sum of each matching series' latest value (so e.g. a
+    # per-node 0/1 degraded gauge alerts when ANY node is degraded);
+    # non-scalar values (a gauge() selector over a histogram) are skipped
+    latest = [
+        v for v in (s[-1][1] for s in rec["series"].values() if s)
+        if isinstance(v, (int, float))
+    ]
+    return float(sum(latest)) if latest else None
+
+
+def _window_increase(store, name, tags, window_s, now) -> Optional[float]:
+    rec = store.query(name, tags, window_s, now)
+    if rec is None:
+        return None
+    if rec["type"] == "histogram":
+        incs = [histogram_increase(s) for s in rec["series"].values()]
+        incs = [i for i in incs if i is not None]
+        return sum(i["count"] for i in incs) if incs else None
+    got = False
+    total = 0.0
+    for samples in rec["series"].values():
+        if len(samples) >= 2:
+            got = True
+            total += counter_increase(samples)
+    return total if got else None
+
+
+def window_exemplars(
+    store: TimeSeriesStore,
+    name: str,
+    tags: Optional[Dict[str, str]],
+    window_s: float,
+    now: Optional[float] = None,
+    limit: int = MAX_ALERT_EXEMPLARS,
+) -> List[Dict[str, Any]]:
+    """Trace exemplars from the newest retained histogram samples of
+    ``name`` — slowest observations first, so a firing latency alert
+    links straight to the traces worth feeding ``critical_path()``."""
+    rec = store.query(name, tags, window_s, now)
+    if rec is None:
+        return []
+    rows: Dict[str, Dict[str, Any]] = {}
+    for samples in rec["series"].values():
+        for _, value in reversed(samples):
+            ex = value.get("exemplars") if isinstance(value, dict) else None
+            if not ex:
+                continue
+            for idx, e in ex.items():
+                trace_id = e[0]
+                row = {
+                    "trace_id": trace_id,
+                    "value": e[1] if len(e) > 1 else None,
+                    "ts": _exemplar_ts(e),
+                    "bucket": idx,
+                }
+                old = rows.get(trace_id)
+                if old is None or row["ts"] > old["ts"]:
+                    rows[trace_id] = row
+            break  # newest cumulative sample already holds the latest set
+    out = sorted(rows.values(), key=lambda r: -(r["value"] or 0.0))
+    return out[:limit]
+
+
+# ---------------------------------------------------------------------------
+# SLO rules + burn-rate alerting
+# ---------------------------------------------------------------------------
+
+_STATES = ("ok", "pending", "firing", "resolved")
+
+
+def normalize_rule(rule: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate one rule dict and attach its parsed expression."""
+    if not isinstance(rule, dict):
+        raise ValueError(f"SLO rule must be a mapping, got {type(rule)}")
+    name = rule.get("name")
+    if not name or not isinstance(name, str):
+        raise ValueError("SLO rule needs a 'name'")
+    expr = rule.get("expr")
+    if not expr or not isinstance(expr, str):
+        raise ValueError(f"SLO rule {name!r} needs an 'expr'")
+    parsed = parse_expr(expr)
+    target = rule.get("target")
+    if not isinstance(target, (int, float)):
+        raise ValueError(f"SLO rule {name!r} needs a numeric 'target'")
+    objective = rule.get("objective", "lt")
+    if objective not in ("lt", "gt"):
+        raise ValueError(f"SLO rule {name!r}: objective must be 'lt' or 'gt'")
+    windows = rule.get("windows") or [[300.0, 1.0]]
+    norm_windows: List[Tuple[float, float]] = []
+    for w in windows:
+        if isinstance(w, (int, float)):
+            norm_windows.append((float(w), 1.0))
+        elif isinstance(w, (list, tuple)) and len(w) == 2:
+            norm_windows.append((float(w[0]), float(w[1])))
+        else:
+            raise ValueError(
+                f"SLO rule {name!r}: window must be seconds or "
+                f"[seconds, burn_rate], got {w!r}"
+            )
+    return {
+        "name": name,
+        "expr": expr,
+        "target": float(target),
+        "objective": objective,
+        "windows": norm_windows,
+        "for_s": float(rule.get("for_s", 0.0)),
+        "description": str(rule.get("description", "")),
+        "_parsed": parsed,
+    }
+
+
+def rule_public(rule: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in rule.items() if not k.startswith("_")}
+
+
+class SloEngine:
+    """Holds the rule set and alert states; ``evaluate()`` runs once per
+    metrics fold. Not thread-safe on its own — the caller (GCS fold)
+    serializes access."""
+
+    def __init__(self, store: TimeSeriesStore):
+        self._store = store
+        self._rules: Dict[str, Dict[str, Any]] = {}
+        self._alerts: Dict[str, Dict[str, Any]] = {}
+
+    def define(self, rule: Dict[str, Any]) -> Dict[str, Any]:
+        norm = normalize_rule(rule)
+        self._rules[norm["name"]] = norm
+        self._alerts.setdefault(
+            norm["name"],
+            {"name": norm["name"], "state": "ok", "since": None,
+             "value": None, "windows": [], "exemplars": [], "stale": False},
+        )
+        return rule_public(norm)
+
+    def remove(self, name: str) -> bool:
+        self._alerts.pop(name, None)
+        return self._rules.pop(name, None) is not None
+
+    def rules(self) -> List[Dict[str, Any]]:
+        return [rule_public(r) for r in self._rules.values()]
+
+    def alerts(self) -> List[Dict[str, Any]]:
+        out = []
+        for name, st in self._alerts.items():
+            rule = self._rules.get(name)
+            row = dict(st)
+            if rule is not None:
+                row["expr"] = rule["expr"]
+                row["target"] = rule["target"]
+                row["description"] = rule["description"]
+            out.append(row)
+        return out
+
+    def firing_count(self) -> int:
+        return sum(1 for a in self._alerts.values() if a["state"] == "firing")
+
+    def evaluate(
+        self, now: float, stale_names: FrozenSet[str] = frozenset()
+    ) -> List[Dict[str, Any]]:
+        """Evaluate every rule; returns the transitions that crossed an
+        alerting edge: ``[{"name", "from", "to", "alert": row}, ...]``."""
+        transitions = []
+        for name, rule in self._rules.items():
+            st = self._alerts[name]
+            if any(n in stale_names for n in expr_metric_names(rule["_parsed"])):
+                # reporting node unreachable: hold state, never flap
+                st["stale"] = True
+                st["last_eval_ts"] = now
+                continue
+            st["stale"] = False
+            st["last_eval_ts"] = now
+            windows = []
+            violating = bool(rule["windows"])
+            for window_s, burn in rule["windows"]:
+                try:
+                    value = eval_expr(
+                        self._store, rule["_parsed"], window_s, now
+                    )
+                except Exception:  # noqa: BLE001
+                    # a mistyped rule must not poison the fold for every
+                    # other rule: no signal, not violating
+                    value = None
+                threshold = self._threshold(rule, burn)
+                bad = value is not None and (
+                    value > threshold if rule["objective"] == "lt"
+                    else value < threshold
+                )
+                windows.append(
+                    {"window_s": window_s, "burn": burn,
+                     "value": value, "threshold": threshold, "violating": bad}
+                )
+                violating = violating and bad
+            st["windows"] = windows
+            st["value"] = windows[0]["value"] if windows else None
+            old = st["state"]
+            new = self._step(st, old, violating, rule["for_s"], now)
+            if new != old:
+                st["state"] = new
+                st["since"] = now
+                if new == "firing":
+                    st["exemplars"] = self._capture_exemplars(rule, now)
+                if (new == "firing") or (old == "firing"):
+                    transitions.append(
+                        {"name": name, "from": old, "to": new,
+                         "alert": dict(st)}
+                    )
+        return transitions
+
+    @staticmethod
+    def _threshold(rule, burn: float) -> float:
+        if rule["_parsed"]["kind"] == "ratio":
+            # target is the objective fraction (e.g. 0.999 availability);
+            # the alert threshold is burn_rate x the error budget
+            return burn * (1.0 - rule["target"])
+        return burn * rule["target"]
+
+    @staticmethod
+    def _step(st, state: str, violating: bool, for_s: float, now: float) -> str:
+        if violating:
+            if state in ("ok", "resolved"):
+                st["pending_since"] = now
+                state = "pending"
+            if state == "pending" and now - st.get("pending_since", now) >= for_s:
+                state = "firing"
+            return state
+        if state == "firing":
+            return "resolved"
+        if state == "pending":
+            return "ok"
+        return state  # ok stays ok; resolved stays visible until re-violation
+
+    def _capture_exemplars(self, rule, now) -> List[Dict[str, Any]]:
+        parsed = rule["_parsed"]
+        window_s = max(w for w, _ in rule["windows"]) if rule["windows"] else 300.0
+        if parsed["kind"] == "quantile":
+            return window_exemplars(
+                self._store, parsed["name"], parsed["tags"], window_s, now
+            )
+        if parsed["kind"] == "ratio":
+            # the denominator is usually the latency/total histogram
+            for name, tags in (parsed["den"], parsed["num"]):
+                ex = window_exemplars(self._store, name, tags, window_s, now)
+                if ex:
+                    return ex
+        return []

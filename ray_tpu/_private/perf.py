@@ -489,6 +489,19 @@ def measure_overhead(
             if _trace_mod._active:
                 pass
 
+    hist = user_metrics.Histogram(
+        "ray_tpu_bench_attribution_scratch_hist", "attribution scratch",
+    )
+    bound_hist = hist.bind()
+
+    def loop_exemplar_gate(n):
+        # Histogram.observe with tracing disabled: the exemplar hook must
+        # collapse to the same one-attribute-read gate, i.e. a full
+        # observe() stays within its budget with the hook compiled in
+        observe = bound_hist.observe
+        for _ in range(n):
+            observe(0.01)
+
     try:
         base = _ns_per_op(loop_baseline, iters, repeats)
         raw = {
@@ -501,11 +514,16 @@ def measure_overhead(
             ),
             "rpc_phase_gate": _ns_per_op(loop_phase_gate, iters, repeats),
             "trace_hook_disabled": _ns_per_op(loop_trace_gate, iters, repeats),
+            "exemplar_hook_disabled": _ns_per_op(
+                loop_exemplar_gate, iters, repeats
+            ),
         }
     finally:
         with user_metrics._registry_lock:
             if scratch in user_metrics._registry:
                 user_metrics._registry.remove(scratch)
+            if hist in user_metrics._registry:
+                user_metrics._registry.remove(hist)
         # phase record fills rings for "_attribution"; drop them again
         _client.pop("_attribution", None)
     out = {"loop_baseline": base}
@@ -525,4 +543,7 @@ OVERHEAD_BUDGET_NS = {
     "metrics_inc_bound": 4000.0,
     "rpc_phase_gate": 400.0,
     "trace_hook_disabled": 400.0,
+    # a full BoundHistogram.observe with the trace-exemplar hook gated
+    # off — same ceiling as the bound counter path it rides next to
+    "exemplar_hook_disabled": 4000.0,
 }

@@ -329,6 +329,9 @@ IDEMPOTENT_METHODS = frozenset({
     "wait_for_actor", "wait_placement_group", "placement_group_table",
     "get_jobs", "list_cluster_events", "get_task_events", "locate_worker",
     "get_config", "get_metrics", "chaos_status", "chaos_report",
+    # metrics time-series + SLO plane: reads, plus define/remove which
+    # converge on re-apply (define replaces by name, remove no-ops)
+    "query_metrics", "slo_list", "alerts", "slo_define", "slo_remove",
     # GCS KV / pubsub / metrics
     "kv_get", "kv_multi_get", "kv_keys", "kv_put", "kv_del",
     "subscribe", "report_metrics",

@@ -130,6 +130,15 @@ def shutdown():
         if global_worker is None:
             return
         worker = global_worker
+        try:
+            # final partial-interval metrics: the GCS keeps counters from
+            # exited reporters (tombstones), so this flush is the last
+            # word on this process's totals
+            from ray_tpu.util import metrics as user_metrics
+
+            user_metrics.flush(timeout=2.0)
+        except Exception:
+            pass
         global_worker = None
         try:
             worker.core.shutdown()

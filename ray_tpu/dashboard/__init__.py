@@ -18,7 +18,11 @@ APIs:
   GET /api/perf_profile  (?duration=2&hz=100 — cluster flamegraph as
                           speedscope JSON; save and open at speedscope.app)
   GET /api/serve         (serve-plane status snapshot from the controller)
+  GET /api/metrics_ts    (retained GCS time-series; no ?name= lists names,
+                          ?name=X[&window=S][&tag=k=v] returns samples)
+  GET /api/alerts        (SLO alert states from the GCS burn-rate engine)
   GET /metrics           (Prometheus exposition)
+  GET /metrics/view      (retained-history charts + SLO alert table)
   GET /events            (event log view)
   GET /perf              (RPC phase latency view)
   GET /serve             (serve deployments/models view)
@@ -54,7 +58,8 @@ _PAGE = """<!doctype html>
 <h2>Placement groups</h2><div id="pgs"></div>
 <h2>Events <a href="/events" style="font-size:.75rem">(full log)</a>
 <a href="/perf" style="font-size:.75rem">(rpc perf)</a>
-<a href="/traces" style="font-size:.75rem">(traces)</a></h2>
+<a href="/traces" style="font-size:.75rem">(traces)</a>
+<a href="/metrics/view" style="font-size:.75rem">(metrics/slo)</a></h2>
 <div id="events"></div>
 <script>
 function table(rows, cols){
@@ -163,6 +168,96 @@ async function refresh(){
   }
 }
 refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_METRICS_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu metrics</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} .ok{color:#0a7d2c} .firing{color:#c0232c;font-weight:600}
+ .pending{color:#b45309} select{margin-right:.6rem}
+ #updated{color:#888;font-size:.8rem} .legend{font-size:.75rem;color:#555}
+</style></head><body>
+<h1>metrics history <a href="/" style="font-size:.8rem">dashboard</a>
+<span id="updated"></span></h1>
+<select id="name"></select>
+<select id="window">
+ <option value="300">5 min</option>
+ <option value="1800" selected>30 min</option>
+ <option value="3600">1 h</option>
+</select>
+<div id="chart"></div>
+<h2>SLO alerts</h2><div id="alerts"></div>
+<script>
+const COLORS = ['#2563eb','#0a7d2c','#9333ea','#c0232c','#b45309','#0e7490'];
+function sampleY(type, v){
+  // histograms chart cumulative count; scalars chart the raw value
+  return (type === 'histogram') ? (v.count || 0) : v;
+}
+function chart(rec){
+  if(!rec || !rec.series) return '<em>no data</em>';
+  const entries = Object.entries(rec.series).filter(([,s])=>s.length);
+  if(!entries.length) return '<em>no samples in window</em>';
+  const w = 720, h = 180;
+  let t0 = Infinity, t1 = -Infinity, vmax = 1e-9;
+  for(const [,s] of entries) for(const [ts,v] of s){
+    t0 = Math.min(t0, ts); t1 = Math.max(t1, ts);
+    vmax = Math.max(vmax, sampleY(rec.type, v));
+  }
+  const span = (t1 - t0) || 1;
+  let svg = '', legend = '';
+  entries.forEach(([key, s], i) => {
+    const color = COLORS[i % COLORS.length];
+    const pts = s.map(([ts,v]) =>
+      `${((ts-t0)/span*w).toFixed(1)},` +
+      `${(h - sampleY(rec.type, v)/vmax*h).toFixed(1)}`).join(' ');
+    svg += `<polyline fill="none" stroke="${color}" stroke-width="1.5" `+
+           `points="${pts}"/>`;
+    legend += `<span style="color:${color}">&#9632;</span> ${key} &nbsp; `;
+  });
+  return `<div class="legend">${rec.name} (${rec.type}, max ${vmax.toPrecision(4)}`+
+    `${rec.type==='histogram'?' observations':''}) — ${rec.description}</div>`+
+    `<svg width="${w}" height="${h}" style="background:#fff;`+
+    `border:1px solid #ddd">${svg}</svg><div class="legend">${legend}</div>`;
+}
+async function refresh(){
+  try{
+    const sel = document.getElementById('name');
+    const names = (await (await fetch('/api/metrics_ts')).json()).names || [];
+    for(const n of names)
+      if(![...sel.options].some(o=>o.value===n)) sel.add(new Option(n, n));
+    if(sel.value){
+      const win = document.getElementById('window').value;
+      const rec = await (await fetch(
+        '/api/metrics_ts?name='+encodeURIComponent(sel.value)+
+        '&window='+win)).json();
+      document.getElementById('chart').innerHTML = chart(rec);
+    }
+    const alerts = await (await fetch('/api/alerts')).json();
+    let h = '<table><tr><th>rule</th><th>state</th><th>value</th>'+
+            '<th>threshold</th><th>exemplars</th></tr>';
+    for(const al of alerts){
+      const cls = al.state==='firing'?'firing':(al.state==='pending'?'pending':'ok');
+      const ex = (al.exemplars||[]).map(e=>e.trace_id.slice(0,8)).join(' ');
+      const thr = ((al.windows||[])[0]||{}).threshold;
+      h += `<tr><td>${al.name}</td><td class="${cls}">${al.state}`+
+           `${al.stale?' (stale)':''}</td>`+
+           `<td>${al.value==null?'-':Number(al.value).toPrecision(4)}</td>`+
+           `<td>${thr==null?'-':Number(thr).toPrecision(4)}</td><td>${ex}</td></tr>`;
+    }
+    document.getElementById('alerts').innerHTML =
+      alerts.length ? h+'</table>' : '<em>no SLO rules defined</em>';
+    document.getElementById('updated').textContent =
+      'updated '+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 3000);
 </script></body></html>"""
 
 
@@ -665,6 +760,8 @@ class DashboardServer:
                 return prometheus_text().encode(), "text/plain; version=0.0.4"
             except RuntimeError:
                 return b"", "text/plain"
+        if base0 == "/metrics/view":
+            return _METRICS_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/events":
             return _EVENTS_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/perf":
@@ -749,6 +846,44 @@ class DashboardServer:
         if base == "/api/metrics_history":
             return (
                 json.dumps(list(self._history)).encode(),
+                "application/json",
+            )
+        if base == "/api/metrics_ts":
+            # retained GCS time-series: no ?name= -> the name list;
+            # ?name=X[&window=S][&tag=k=v...] -> samples per series
+            # (tuple series keys JSON-encoded as "k=v,..." strings)
+            from urllib.parse import parse_qs
+
+            q = parse_qs(query)
+            name = (q.get("name") or [""])[0]
+            if not name:
+                names = s._gcs_call(
+                    "query_metrics", {"list": True}, address=a
+                )
+                return json.dumps(names).encode(), "application/json"
+            payload = {"name": name}
+            if q.get("window"):
+                payload["window_s"] = float(q["window"][0])
+            tags = dict(
+                t.split("=", 1) for t in q.get("tag", []) if "=" in t
+            )
+            if tags:
+                payload["tags"] = tags
+            rec = s._gcs_call("query_metrics", payload, address=a)
+            if rec is None:
+                return b"null", "application/json"
+            doc = dict(rec)
+            doc["series"] = {
+                ",".join(f"{k}={v}" for k, v in key) or "<no tags>": samples
+                for key, samples in rec["series"].items()
+            }
+            return (
+                json.dumps(_to_jsonable(doc)).encode(),
+                "application/json",
+            )
+        if base == "/api/alerts":
+            return (
+                json.dumps(_to_jsonable(s.list_alerts(address=a))).encode(),
                 "application/json",
             )
         if base == "/api/task":

@@ -421,6 +421,124 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """``raytpu metrics list|query`` — the retained time-series plane."""
+    from ray_tpu.util import metrics as metrics_mod
+
+    address = _head_address(args.address)
+    if args.metrics_cmd == "list":
+        for name in metrics_mod.list_series(address=address):
+            print(name)
+        return 0
+    tags = dict(kv.split("=", 1) for kv in args.tag) or None
+    if args.quantile is not None:
+        v = metrics_mod.histogram_quantile(
+            args.name, args.quantile, tags, args.window, address=address
+        )
+        print("no data in window" if v is None else f"{v:.6g}")
+        return 0 if v is not None else 1
+    if args.rate:
+        v = metrics_mod.rate(args.name, tags, args.window, address=address)
+        print("no data in window" if v is None else f"{v:.6g}/s")
+        return 0 if v is not None else 1
+    rec = metrics_mod.query(args.name, tags, args.window, address=address)
+    if rec is None:
+        print(f"unknown metric {args.name!r} (see `raytpu metrics list`)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        doc = dict(rec)
+        doc["series"] = {
+            ",".join(f"{k}={v}" for k, v in key) or "<no tags>": samples
+            for key, samples in rec["series"].items()
+        }
+        print(json.dumps(doc, indent=2, default=_json_default))
+        return 0
+    print(f"{rec['name']} ({rec['type']}): {rec['description']}")
+    for key, samples in sorted(rec["series"].items()):
+        label = ",".join(f"{k}={v}" for k, v in key) or "<no tags>"
+        if not samples:
+            print(f"  {label}: no samples in window")
+            continue
+        ts, value = samples[-1]
+        if rec["type"] == "histogram":
+            latest = f"count={value['count']} sum={value['sum']:.6g}"
+        else:
+            latest = f"{value:.6g}"
+        span = samples[-1][0] - samples[0][0]
+        print(f"  {label}: {len(samples)} samples over {span:.0f}s, "
+              f"latest {latest}")
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """``raytpu slo list|apply|remove`` — SLO rules in the GCS."""
+    from ray_tpu import slo as slo_mod
+
+    address = _head_address(args.address)
+    if args.slo_cmd == "apply":
+        rules = slo_mod.load_rules(args.rules)
+        out = slo_mod.apply(rules, address=address)
+        print(f"defined {len(out)} rule(s): "
+              + ", ".join(r["name"] for r in out))
+        return 0
+    if args.slo_cmd == "remove":
+        ok = slo_mod.remove(args.name, address=address)
+        print("removed" if ok else "no such rule")
+        return 0 if ok else 1
+    rules = slo_mod.list(address=address)
+    if args.json:
+        print(json.dumps(rules, indent=2, default=_json_default))
+        return 0
+    if not rules:
+        print("no SLO rules defined (raytpu slo apply rules.yaml, or "
+              "ray_tpu.slo.define(...))")
+        return 0
+    hdr = f"{'name':<28} {'target':>10} {'windows':<20} expr"
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rules:
+        wins = ",".join(
+            f"{int(w)}s" + (f"x{b:g}" if b != 1.0 else "")
+            for w, b in r["windows"]
+        )
+        print(f"{r['name']:<28} {r['target']:>10g} {wins:<20} {r['expr']}")
+    return 0
+
+
+def cmd_alerts(args) -> int:
+    """``raytpu alerts`` — current state of every SLO alert."""
+    from ray_tpu import slo as slo_mod
+
+    rows = slo_mod.alerts(address=_head_address(args.address))
+    if args.json:
+        print(json.dumps(rows, indent=2, default=_json_default))
+        return 0
+    if not rows:
+        print("no SLO rules defined")
+        return 0
+    hdr = f"{'name':<28} {'state':<10} {'value':>12} {'threshold':>12} exemplars"
+    print(hdr)
+    print("-" * len(hdr))
+    firing = 0
+    for a in sorted(rows, key=lambda r: r["name"]):
+        state = a["state"].upper() if a["state"] == "firing" else a["state"]
+        if a["state"] == "firing":
+            firing += 1
+        if a.get("stale"):
+            state += " (stale)"
+        win = (a.get("windows") or [{}])[0]
+        value = a.get("value")
+        thr = win.get("threshold")
+        ex = " ".join(e["trace_id"][:16] for e in a.get("exemplars", ()))
+        print(
+            f"{a['name']:<28} {state:<10} "
+            f"{'-' if value is None else format(value, '.6g'):>12} "
+            f"{'-' if thr is None else format(thr, '.6g'):>12} {ex}"
+        )
+    return 1 if firing else 0
+
+
 def cmd_drain(args) -> int:
     """``raytpu drain NODE`` — gracefully retire a node: it stops taking
     leases, running work gets --deadline seconds to finish, its plasma
@@ -692,6 +810,64 @@ def build_parser() -> argparse.ArgumentParser:
     d = chaos_sub.add_parser("clear", help="disarm everywhere")
     d.add_argument("--address")
     d.set_defaults(fn=cmd_chaos)
+
+    s = sub.add_parser(
+        "metrics",
+        help="query retained metric time-series (rates, quantiles)",
+        description="The GCS keeps per-series history of every reported "
+        "metric (fine ring at metrics_report_period_s resolution plus a "
+        "downsampled coarse ring). `metrics list` names them; `metrics "
+        "query NAME` prints retained samples, a windowed --rate, or a "
+        "windowed --quantile from histogram bucket deltas.",
+    )
+    metrics_sub = s.add_subparsers(dest="metrics_cmd", required=True)
+    d = metrics_sub.add_parser("list", help="metric names with history")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_metrics)
+    d = metrics_sub.add_parser("query", help="retained samples / rate / quantile")
+    d.add_argument("name", help="metric name, e.g. ray_tpu_serve_requests_total")
+    d.add_argument("--tag", action="append", default=[], metavar="K=V",
+                   help="series tag filter (repeatable)")
+    d.add_argument("--window", type=float, default=None,
+                   help="trailing window seconds (default: full history)")
+    d.add_argument("--rate", action="store_true",
+                   help="per-second counter rate over --window (default 60s)")
+    d.add_argument("--quantile", type=float, metavar="Q",
+                   help="histogram quantile in (0,1] over --window")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser(
+        "slo",
+        help="SLO rules: list, apply from YAML/JSON, remove",
+        description="Rules (name + expr + target + burn-rate windows) are "
+        "evaluated in the GCS every metrics report period; transitions "
+        "emit ALERT_FIRING/ALERT_RESOLVED cluster events. See `raytpu "
+        "alerts` for current alert state.",
+    )
+    slo_sub = s.add_subparsers(dest="slo_cmd", required=True)
+    d = slo_sub.add_parser("list", help="defined rules")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_slo)
+    d = slo_sub.add_parser("apply", help="define rules from a YAML/JSON file")
+    d.add_argument("rules", help="path to a rules file "
+                   "(a list of rules or {rules: [...]})")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_slo)
+    d = slo_sub.add_parser("remove", help="drop one rule by name")
+    d.add_argument("name")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_slo)
+
+    s = sub.add_parser(
+        "alerts",
+        help="SLO alert states (exit 1 if any alert is FIRING)",
+    )
+    s.add_argument("--json", action="store_true", help="raw JSON output")
+    s.add_argument("--address")
+    s.set_defaults(fn=cmd_alerts)
 
     s = sub.add_parser(
         "drain",

@@ -113,6 +113,12 @@ def main() -> int:
     stop.wait()
     if dashboard is not None:
         dashboard.stop()
+    try:
+        # push the final partial interval before the raylet's GCS client
+        # goes away (worker-mode nodes report through it)
+        user_metrics.flush(timeout=2.0)
+    except Exception:
+        pass
     node.stop()
     try:
         os.unlink(os.path.join(args.run_dir, f"node-{os.getpid()}.json"))

@@ -88,6 +88,7 @@ class Deployment:
         unknown = set(cfg) - {
             "num_replicas", "user_config", "autoscaling", "resources",
             "max_concurrent_queries", "max_queued_requests", "drain_grace_s",
+            "slo_p99_s", "slo_availability",
         }
         if unknown:
             raise TypeError(f"unknown deployment options: {sorted(unknown)}")
@@ -134,6 +135,8 @@ def deployment(
     max_concurrent_queries: int = 8,
     max_queued_requests: Optional[int] = None,
     drain_grace_s: float = 30.0,
+    slo_p99_s: Optional[float] = None,
+    slo_availability: Optional[float] = None,
 ):
     """``@serve.deployment`` decorator (reference: serve/api.py deployment).
 
@@ -143,7 +146,13 @@ def deployment(
     :class:`BackPressureError` (503 + Retry-After at the proxy). ``None``
     defaults the queue allowance to one full round of executing slots.
     ``drain_grace_s`` is how long a scaled-down replica may finish
-    in-flight work before a forced kill."""
+    in-flight work before a forced kill.
+
+    ``slo_p99_s`` / ``slo_availability`` override the default
+    per-deployment SLO rule targets (``ray_tpu.slo``); the cluster-wide
+    defaults come from ``serve_slo_default_p99_s`` /
+    ``serve_slo_default_availability`` (``serve_default_slos=False``
+    disables the automatic rules entirely)."""
 
     def deco(target):
         return Deployment(
@@ -157,6 +166,8 @@ def deployment(
                 "max_concurrent_queries": max_concurrent_queries,
                 "max_queued_requests": max_queued_requests,
                 "drain_grace_s": drain_grace_s,
+                "slo_p99_s": slo_p99_s,
+                "slo_availability": slo_availability,
             },
         )
 

@@ -88,7 +88,55 @@ class ServeController:
             except Exception:
                 pass
         self._reconcile_once()
+        self._define_default_slos(name, spec)
         return True
+
+    def _define_default_slos(self, name: str, spec: Dict[str, Any]) -> None:
+        """Every deployment gets a p99-latency and an availability SLO
+        rule (ray_tpu.slo) over its replica metrics. Defaults are generous
+        enough to stay silent on a healthy deployment; tighten per
+        deployment via slo_p99_s / slo_availability, or disable with
+        serve_default_slos=False. Best-effort: a metrics-plane hiccup
+        must not fail a deploy."""
+        from ray_tpu._private.config import GlobalConfig
+
+        if not GlobalConfig.serve_default_slos:
+            return
+        try:
+            p99 = spec.get("slo_p99_s") or GlobalConfig.serve_slo_default_p99_s
+            avail = (
+                spec.get("slo_availability")
+                or GlobalConfig.serve_slo_default_availability
+            )
+            sel = f'{{deployment="{name}"}}'
+            rules = [
+                {
+                    "name": f"serve-{name}-p99",
+                    "expr": "histogram_quantile(0.99, "
+                            f"ray_tpu_serve_request_latency_seconds{sel})",
+                    "target": float(p99),
+                    "windows": [30.0],
+                    "for_s": 0.0,
+                    "description": f"p99 latency SLO for deployment {name}",
+                },
+                {
+                    "name": f"serve-{name}-availability",
+                    "expr": (
+                        f"rate(ray_tpu_serve_request_errors_total{sel}) / "
+                        f"rate(ray_tpu_serve_requests_total{sel})"
+                    ),
+                    "target": float(avail),
+                    "windows": [[60.0, 1.0]],
+                    "description": f"availability SLO for deployment {name}",
+                },
+            ]
+            import ray_tpu._private.worker as worker_mod
+
+            worker_mod.global_worker.core.gcs.call(
+                "slo_define", rules, timeout=5.0
+            )
+        except Exception:
+            pass
 
     def delete_deployment(self, name: str) -> bool:
         with self._lock:

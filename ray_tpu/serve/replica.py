@@ -62,6 +62,9 @@ class Replica:
         try:
             target = self._callable if method is None else getattr(self._callable, method)
             return target(*args, **kwargs)
+        except BaseException:
+            self._record_request_error()
+            raise
         finally:
             _current_model_id.reset(token)
             with self._lock:
@@ -94,6 +97,9 @@ class Replica:
                 yield from result
             else:
                 yield result
+        except BaseException:
+            self._record_request_error()
+            raise
         finally:
             _current_model_id.reset(token)
             with self._lock:
@@ -105,6 +111,14 @@ class Replica:
         internal_metrics.set_gauge(
             "ray_tpu_serve_queue_depth",
             float(ongoing),
+            tags={"deployment": self._name},
+        )
+
+    def _record_request_error(self) -> None:
+        # the numerator of the default availability SLO
+        # (rate(errors) / rate(requests), see controller.deploy)
+        internal_metrics.inc(
+            "ray_tpu_serve_request_errors_total",
             tags={"deployment": self._name},
         )
 

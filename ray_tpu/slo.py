@@ -1,0 +1,154 @@
+"""Public SLO surface: rules, burn-rate alerts, and their lifecycle.
+
+Rules live in the GCS and are evaluated against the retained metrics
+time-series every report period (``ray_tpu._private.metrics_ts``). A rule
+is a name + expression + target + windows::
+
+    import ray_tpu
+    from ray_tpu import slo
+
+    ray_tpu.init()
+    slo.define(
+        "serve-p99",
+        'histogram_quantile(0.99, ray_tpu_serve_request_latency_seconds'
+        '{deployment="echo"})',
+        target=0.25,              # p99 must stay under 250 ms
+        windows=[30.0],           # evaluated over a 30 s window
+        for_s=5.0,                # pending this long before FIRING
+    )
+    slo.define(
+        "serve-availability",
+        "rate(ray_tpu_serve_request_errors_total) / "
+        "rate(ray_tpu_serve_requests_total)",
+        target=0.999,             # 99.9% availability objective
+        windows=[[300, 14.4], [3600, 6.0]],   # SRE multiwindow burn rates
+    )
+    print(slo.alerts())           # [{"name", "state", "value", ...}]
+
+Expressions: ``histogram_quantile(q, name{tags})``, ``rate(name{tags})``,
+``rate(bad{...}) / rate(total{...})`` (burn-rate ratio: the threshold is
+``burn × (1 − target)``, the error budget), and ``gauge(name{tags})`` /
+bare ``name{tags}``. Alerts transition ok → PENDING → FIRING → RESOLVED,
+emitting ``ALERT_FIRING`` / ``ALERT_RESOLVED`` cluster events; a firing
+latency alert carries trace exemplars you can open with
+``ray_tpu.trace.get()``. Rules over series whose reporter went silent
+(partitioned node) hold their state instead of flapping.
+
+CLI: ``ray_tpu slo list|apply|remove`` / ``ray_tpu alerts``; YAML rule
+files load via :func:`load_rules` (mirroring ``chaos.load_schedule``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+__all__ = [
+    "define",
+    "apply",
+    "remove",
+    "list",
+    "alerts",
+    "load_rules",
+]
+
+
+def _gcs_call(method: str, payload=None, *,
+              address: Optional[str] = None, timeout: float = 30.0):
+    if address is not None:
+        from ray_tpu.util.state import _cached_client
+
+        return _cached_client(address).call(method, payload, timeout=timeout)
+    import ray_tpu._private.worker as worker_mod
+
+    worker = worker_mod.global_worker
+    if worker is None or worker.core is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized (call ray_tpu.init()) and no "
+            "address= was given"
+        )
+    return worker.core.gcs.call(method, payload, timeout=timeout)
+
+
+def define(
+    name: str,
+    expr: str,
+    target: float,
+    *,
+    windows: Optional[Sequence[Union[float, Sequence[float]]]] = None,
+    for_s: float = 0.0,
+    objective: str = "lt",
+    description: str = "",
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Define (or replace) one SLO rule cluster-wide. ``windows`` entries
+    are seconds or ``[seconds, burn_rate]`` pairs — ALL windows must
+    violate for the alert to leave ok. ``objective="lt"`` means the value
+    must stay below target (latency, error ratio); ``"gt"`` means above
+    (throughput floor). Returns the normalized rule."""
+    rule = {
+        "name": name,
+        "expr": expr,
+        "target": target,
+        "objective": objective,
+        "for_s": for_s,
+        "description": description,
+    }
+    if windows is not None:
+        rule["windows"] = [
+            w if isinstance(w, (int, float)) else [float(w[0]), float(w[1])]
+            for w in windows
+        ]
+    # validate locally first so bad rules fail with a full traceback
+    # instead of a remote error string
+    from ray_tpu._private import metrics_ts
+
+    metrics_ts.normalize_rule(rule)
+    return _gcs_call("slo_define", rule, address=address)
+
+
+def apply(rules: Sequence[Dict[str, Any]], *,
+          address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Define a batch of rule dicts (e.g. from :func:`load_rules`)."""
+    from ray_tpu._private import metrics_ts
+
+    rules = [dict(r) for r in rules]
+    for r in rules:
+        metrics_ts.normalize_rule(r)
+    return _gcs_call("slo_define", rules, address=address)
+
+
+def remove(name: str, *, address: Optional[str] = None) -> bool:
+    """Drop a rule (and its alert state). Returns True if it existed."""
+    return _gcs_call("slo_remove", name, address=address)
+
+
+def list(*, address: Optional[str] = None) -> List[Dict[str, Any]]:  # noqa: A001
+    """Every defined rule, normalized."""
+    return _gcs_call("slo_list", address=address)
+
+
+def alerts(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Current alert state per rule: ``{"name", "state", "since",
+    "value", "windows": [{window_s, burn, value, threshold, violating}],
+    "exemplars": [{trace_id, value, bucket}], "stale"}``."""
+    return _gcs_call("alerts", address=address)
+
+
+def load_rules(path: str) -> List[Dict[str, Any]]:
+    """Load rules from a YAML or JSON file (by extension): either a list
+    of rule mappings or ``{"rules": [...]}``."""
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        data = yaml.safe_load(text)
+    else:
+        data = json.loads(text)
+    if isinstance(data, dict):
+        data = data.get("rules")
+    if not isinstance(data, type([])):
+        raise ValueError(f"{path}: expected a list of rules or "
+                         "a mapping with a 'rules' list")
+    return data

@@ -11,11 +11,14 @@ scraping.
 
 from __future__ import annotations
 
+import atexit
 import bisect
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import trace as _tr
 
 DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -49,6 +52,17 @@ def _ensure_reporter():
         _reporter_started = True
     t = threading.Thread(target=_report_loop, name="metrics-report", daemon=True)
     t.start()
+    # a process that exits between reporter ticks would lose its final
+    # partial interval (counts since the last 5 s flush) — push one last
+    # snapshot on interpreter exit, best-effort and short-deadline
+    atexit.register(_final_flush)
+
+
+def _final_flush():
+    try:
+        flush(timeout=2.0)
+    except Exception:
+        pass  # already disconnected / GCS gone: nothing to save
 
 
 def _gcs_client():
@@ -69,8 +83,9 @@ def _report_loop():
             pass  # not connected / GCS down: keep recording locally
 
 
-def flush():
-    """Push the current snapshot now (also called by the reporter loop)."""
+def flush(timeout: float = 5.0):
+    """Push the current snapshot now (also called by the reporter loop
+    and, with a short deadline, by the atexit/shutdown paths)."""
     import ray_tpu._private.worker as worker_mod
 
     gcs = _gcs_client()
@@ -86,7 +101,7 @@ def flush():
         records = [m._snapshot() for m in _registry]
     records = [r for r in records if r["series"]]
     if records:
-        call("report_metrics", (reporter, records), timeout=5.0)
+        call("report_metrics", (reporter, records), timeout=timeout)
 
 
 class Metric:
@@ -159,15 +174,7 @@ class BoundHistogram:
         self._metric = metric
         self._boundaries = metric.boundaries
         with metric._lock:
-            state = metric._series.get(key)
-            if state is None:
-                state = {
-                    "buckets": [0] * (len(metric.boundaries) + 1),
-                    "sum": 0.0,
-                    "count": 0,
-                }
-                metric._series[key] = state
-            self._state = state
+            self._state = metric._series_state(key)
 
     def observe(self, value: float):
         idx = bisect.bisect_left(self._boundaries, value)
@@ -176,6 +183,8 @@ class BoundHistogram:
             state["buckets"][idx] += 1
             state["sum"] += value
             state["count"] += 1
+            if _tr._active:
+                _attach_exemplar(state, idx, value)
 
 
 class Counter(Metric):
@@ -202,6 +211,20 @@ class Gauge(Metric):
             self._series[self._key(tags)] = float(value)
 
 
+def _attach_exemplar(state: Dict[str, Any], idx: int, value: float):
+    """Trace exemplar: the observation happened under a sampled
+    TraceContext, so remember (trace_id, value) for its bucket — bounded
+    latest-per-bucket, carried through report -> aggregate -> query so a
+    firing latency alert links to a trace ``critical_path()`` can open.
+    Caller holds the metric lock; the ``_tr._active`` gate keeps the
+    disabled cost to one module-attribute read."""
+    ctx = _tr.current()
+    if ctx is not None and ctx.sampled:
+        state.setdefault("exemplars", {})[idx] = (
+            ctx.trace_id, value, time.time(),
+        )
+
+
 class Histogram(Metric):
     TYPE = "histogram"
 
@@ -211,25 +234,29 @@ class Histogram(Metric):
         super().__init__(name, description, tag_keys)
         self.boundaries = tuple(sorted(boundaries))
 
+    def _series_state(self, key):
+        """Find-or-init one series' state (caller holds ``self._lock``) —
+        the single init block shared with ``BoundHistogram.__init__``."""
+        state = self._series.get(key)
+        if state is None:
+            state = {
+                "buckets": [0] * (len(self.boundaries) + 1),
+                "sum": 0.0,
+                "count": 0,
+            }
+            self._series[key] = state
+        return state
+
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         key = self._key(tags)
+        idx = bisect.bisect_left(self.boundaries, value)
         with self._lock:
-            state = self._series.get(key)
-            if state is None:
-                state = {
-                    "buckets": [0] * (len(self.boundaries) + 1),
-                    "sum": 0.0,
-                    "count": 0,
-                }
-                self._series[key] = state
-            idx = len(self.boundaries)
-            for i, b in enumerate(self.boundaries):
-                if value <= b:
-                    idx = i
-                    break
+            state = self._series_state(key)
             state["buckets"][idx] += 1
             state["sum"] += value
             state["count"] += 1
+            if _tr._active:
+                _attach_exemplar(state, idx, value)
         # exported with boundaries so aggregation can merge
         return value
 
@@ -238,7 +265,19 @@ class Histogram(Metric):
         return BoundHistogram(self, self._key(tags))
 
     def _export(self, value):
-        return {**value, "boundaries": self.boundaries}
+        # copy the mutable pieces: the snapshot is pickled after the
+        # metric lock is released, while observes keep mutating the live
+        # buckets/exemplars
+        out = {
+            "buckets": list(value["buckets"]),
+            "sum": value["sum"],
+            "count": value["count"],
+            "boundaries": self.boundaries,
+        }
+        exemplars = value.get("exemplars")
+        if exemplars:
+            out["exemplars"] = dict(exemplars)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +294,102 @@ def get_metrics(name: Optional[str] = None) -> List[Dict[str, Any]]:
     flush()
     records = gcs.call("get_metrics", name, timeout=10.0)
     return records
+
+
+def _query_call(payload, address: Optional[str]):
+    if address is not None:
+        from ray_tpu.util.state import _cached_client
+
+        return _cached_client(address).call("query_metrics", payload, timeout=10.0)
+    gcs = _gcs_client()
+    if gcs is None:
+        raise RuntimeError(
+            "not connected — call ray_tpu.init() first or pass address="
+        )
+    flush()  # fold in this process's latest interval before asking
+    return gcs.call("query_metrics", payload, timeout=10.0)
+
+
+def list_series(*, address: Optional[str] = None) -> List[str]:
+    """Names of every metric with retained history in the GCS."""
+    return _query_call({"list": True}, address)["names"]
+
+
+def query(
+    name: str,
+    tags: Optional[Dict[str, str]] = None,
+    window_s: Optional[float] = None,
+    *,
+    address: Optional[str] = None,
+) -> Optional[Dict[str, Any]]:
+    """Retained time-series samples from the GCS: every series of
+    ``name`` whose tags are a superset of ``tags``, clipped to the
+    trailing ``window_s`` (None = full retained horizon). Returns
+    ``{"name", "type", "description", "series": {key: [(ts, value),
+    ...]}}`` with cumulative values, or None if the metric is unknown."""
+    return _query_call(
+        {"name": name, "tags": tags, "window_s": window_s}, address
+    )
+
+
+def rate(
+    name: str,
+    tags: Optional[Dict[str, str]] = None,
+    window_s: float = 60.0,
+    *,
+    address: Optional[str] = None,
+) -> Optional[float]:
+    """Per-second increase of a counter over the trailing window, summed
+    across matching series, with Prometheus-style counter-reset
+    detection (a restarted reporter contributes its new cumulative value,
+    not a negative spike). None until two samples exist in the window."""
+    from ray_tpu._private import metrics_ts
+
+    rec = query(name, tags, window_s, address=address)
+    if rec is None:
+        return None
+    rates = [
+        r
+        for r in (metrics_ts.window_rate(s) for s in rec["series"].values())
+        if r is not None
+    ]
+    return sum(rates) if rates else None
+
+
+def histogram_quantile(
+    name: str,
+    q: float,
+    tags: Optional[Dict[str, str]] = None,
+    window_s: float = 60.0,
+    *,
+    address: Optional[str] = None,
+) -> Optional[float]:
+    """Windowed quantile from histogram bucket deltas (what Prometheus's
+    ``histogram_quantile(q, rate(..._bucket[w]))`` computes): bucket
+    increases over the trailing window, merged across matching series,
+    interpolated inside the bucket holding rank q. None until the window
+    spans two samples with observations between them."""
+    from ray_tpu._private import metrics_ts
+
+    rec = query(name, tags, window_s, address=address)
+    if rec is None:
+        return None
+    merged = None
+    for samples in rec["series"].values():
+        inc = metrics_ts.histogram_increase(samples)
+        if inc is None or not inc["buckets"]:
+            continue
+        if merged is None:
+            merged = inc
+        elif len(merged["buckets"]) == len(inc["buckets"]):
+            merged["buckets"] = [
+                a + b for a, b in zip(merged["buckets"], inc["buckets"])
+            ]
+    if merged is None or not merged.get("boundaries"):
+        return None
+    return metrics_ts.quantile_from_buckets(
+        merged["boundaries"], merged["buckets"], q
+    )
 
 
 def prometheus_text() -> str:

@@ -27,12 +27,14 @@ __all__ = [
     "format_stack_report",
     "get_log",
     "list_actors",
+    "list_alerts",
     "list_cluster_events",
     "list_jobs",
     "list_logs",
     "list_nodes",
     "list_objects",
     "list_placement_groups",
+    "list_slo_rules",
     "list_tasks",
     "read_log_chunk",
     "list_trace_spans",
@@ -412,6 +414,21 @@ def list_cluster_events(
     return _gcs_call(
         "list_cluster_events", payload or None, address=address
     )
+
+
+def list_alerts(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Current SLO alert states (one row per rule defined via
+    ``ray_tpu.slo``): ``name``, ``state`` (ok/pending/firing/resolved),
+    latest evaluated ``value`` vs ``threshold``, and any captured trace
+    ``exemplars`` — the burn-rate evaluation happens inside the GCS each
+    metrics report period."""
+    return _gcs_call("alerts", address=address)
+
+
+def list_slo_rules(*, address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The SLO rules currently registered in the GCS (see
+    ``ray_tpu.slo.define`` / ``ray_tpu.slo.load_rules``)."""
+    return _gcs_call("slo_list", address=address)
 
 
 def timeline(

@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""Per-PR SLO-plane smoke (<60 s): retained metrics history, burn-rate
+alerting, and trace exemplars end to end against a real serve deployment.
+
+Hard-fails (nonzero exit) when any leg breaks:
+  1. Deploying with a tight ``slo_p99_s`` auto-registers the default
+     p99 + availability rules in the GCS.
+  2. ``histogram_quantile(ray_tpu_serve_request_latency_seconds, 0.99,
+     window_s=30)`` moves under a seeded open-loop load (None before,
+     above the 10 ms target during).
+  3. The p99 alert FIRES with at least one trace exemplar that
+     ``ray_tpu.trace.get()`` resolves to real spans, and an
+     ALERT_FIRING cluster event is recorded.
+  4. After the load stops the alert RESOLVES (zero traffic burns no
+     budget) and ALERT_RESOLVED lands in the event log.
+
+Usage: env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 20260808
+LAT_METRIC = "ray_tpu_serve_request_latency_seconds"
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL slo_smoke: {msg}")
+    sys.exit(1)
+
+
+def wait_for(pred, timeout: float, what: str, interval: float = 0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    fail(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+def main() -> None:
+    t_start = time.time()
+    import ray_tpu
+    from ray_tpu import serve, slo, trace
+    from ray_tpu.serve import loadgen
+    from ray_tpu.util import metrics
+    from ray_tpu.util.state import list_cluster_events
+
+    ray_tpu.init(
+        num_cpus=8,
+        log_level="ERROR",
+        _system_config={"metrics_report_period_s": 0.5, "trace_sample": 1.0},
+    )
+    try:
+        # --- leg 1: deploy with a deliberately unachievable p99 target
+        # (the Sleeper takes >= 30 ms per request, target is 10 ms)
+        dep = serve.deployment(
+            name="slo-sleeper", num_replicas=2, slo_p99_s=0.01
+        )(loadgen.Sleeper)
+        handle = serve.run(dep.bind(30.0))
+        rule_names = {r["name"] for r in slo.list()}
+        for want in ("serve-slo-sleeper-p99", "serve-slo-sleeper-availability"):
+            if want not in rule_names:
+                fail(f"default SLO rule {want!r} not registered: {rule_names}")
+        print(f"OK   deploy: default SLO rules registered {sorted(rule_names)}")
+        # shrink the p99 window so the resolve leg fits the smoke budget
+        # (slo.define replaces by name; the 30 s default is for production)
+        slo.define(
+            "serve-slo-sleeper-p99",
+            "histogram_quantile(0.99, "
+            'ray_tpu_serve_request_latency_seconds{deployment="slo-sleeper"})',
+            target=0.01,
+            windows=[8.0],
+            description="smoke: tightened window for fast resolve",
+        )
+
+        q_before = metrics.histogram_quantile(LAT_METRIC, 0.99, window_s=30.0)
+
+        # --- leg 2: seeded open-loop load; every request runs under a
+        # sampled root span so replica-side latency observations carry
+        # trace exemplars
+        def submit(i: int):
+            with trace.start("slo-req"):
+                return handle.remote({"i": i}).result(timeout=30.0)
+
+        burst = loadgen.open_loop(
+            submit, rate_rps=25.0, duration_s=6.0, seed=SEED,
+            join_timeout_s=30.0,
+        )
+        if burst["stuck"]:
+            fail(f"{burst['stuck']} loadgen requests never completed")
+
+        q_during = wait_for(
+            lambda: metrics.histogram_quantile(LAT_METRIC, 0.99, window_s=30.0),
+            timeout=15.0,
+            what="windowed p99 over the serve latency histogram",
+        )
+        if q_during <= 0.01:
+            fail(f"p99 {q_during:.4f}s did not exceed the 10 ms target")
+        print(f"OK   quantile moved: p99 {q_before} -> {q_during:.3f}s "
+              f"under load ({burst['sent']} requests)")
+
+        # --- leg 3: the alert fires and its exemplars resolve to traces
+        def firing():
+            rows = {a["name"]: a for a in slo.alerts()}
+            a = rows.get("serve-slo-sleeper-p99")
+            return a if a and a["state"] == "firing" else None
+
+        alert = wait_for(firing, timeout=20.0, what="p99 alert to fire")
+        if not alert["exemplars"]:
+            fail(f"firing alert carried no trace exemplars: {alert}")
+        tid = alert["exemplars"][0]["trace_id"]
+        t = trace.get(tid)
+        if not t["spans"]:
+            fail(f"exemplar trace {tid} resolved to zero spans")
+        wait_for(
+            lambda: [e for e in list_cluster_events(type="ALERT_FIRING")
+                     if e.get("rule") == "serve-slo-sleeper-p99"] or None,
+            timeout=10.0,
+            what="ALERT_FIRING cluster event",
+        )
+        print(f"OK   alert fired: value={alert['value']:.3f}s "
+              f"threshold={alert['windows'][0]['threshold']:.3f}s, "
+              f"exemplar trace {tid[:16]} -> {len(t['spans'])} spans")
+
+        # --- leg 4: load is gone; the window drains and the alert resolves
+        def resolved():
+            rows = {a["name"]: a for a in slo.alerts()}
+            a = rows.get("serve-slo-sleeper-p99")
+            return a if a and a["state"] == "resolved" else None
+
+        wait_for(resolved, timeout=25.0, what="p99 alert to resolve")
+        wait_for(
+            lambda: [e for e in list_cluster_events(type="ALERT_RESOLVED")
+                     if e.get("rule") == "serve-slo-sleeper-p99"] or None,
+            timeout=10.0,
+            what="ALERT_RESOLVED cluster event",
+        )
+        print("OK   alert resolved after the load stopped")
+        print(f"PASS slo_smoke in {time.time() - t_start:.1f}s")
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,10 @@ env JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 # tracing plane end to end: cross-node assembly, critical path within 10%
 # of e2e, planted straggler flagged, unsampled hook under budget
 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+# SLO plane end to end: retained quantile moves under load, tight p99 SLO
+# fires with a resolvable trace exemplar, resolves when the load stops
+env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
     tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py \
-    tests/test_perf_plane.py tests/test_trace.py "$@"
+    tests/test_perf_plane.py tests/test_trace.py tests/test_metrics_ts.py \
+    tests/test_slo.py "$@"

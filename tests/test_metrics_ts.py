@@ -1,0 +1,273 @@
+"""Metrics time-series plane: retained rings, window math, cluster query.
+
+(reference: Prometheus TSDB semantics — increase()/rate() with counter
+reset detection, histogram_quantile over windowed bucket deltas — folded
+into the GCS as bounded per-series rings; plus OpenMetrics exemplars
+carried from a sampled trace through report -> aggregate -> query.)
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import metrics_ts as mts
+
+
+# ---------------------------------------------------------------------------
+# rings (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_series_ring_bounds_and_downsampling():
+    ring = mts.SeriesRing(fine_cap=5, coarse_cap=3)
+    for i in range(40):
+        ring.append(float(i), float(i), coarse_every=4)
+    # hard caps hold regardless of how many folds happened
+    assert len(ring.fine) == 5
+    assert len(ring.coarse) == 3
+    assert list(ring.fine) == [(float(i), float(i)) for i in range(35, 40)]
+    # coarse keeps every 4th fold (ts 3, 7, ... capped to the last 3)
+    assert list(ring.coarse) == [(31.0, 31.0), (35.0, 35.0), (39.0, 39.0)]
+    # splice: coarse history strictly before the fine ring, no overlap
+    samples = ring.samples()
+    assert samples == [(31.0, 31.0)] + list(ring.fine)
+    assert [t for t, _ in samples] == sorted(t for t, _ in samples)
+    # window clip is relative to `now`
+    assert ring.samples(window_s=3.0, now=39.0) == [
+        (36.0, 36.0), (37.0, 37.0), (38.0, 38.0), (39.0, 39.0)
+    ]
+
+
+def _counter_rec(name, value, key=()):
+    return {"name": name, "type": "counter", "description": "d",
+            "series": {key: value}}
+
+
+def test_store_max_series_cap_counts_drops():
+    store = mts.TimeSeriesStore(fine_cap=8, coarse_cap=4, coarse_every=2,
+                                max_series=2)
+    recs = [
+        _counter_rec("m_total", 1.0, (("k", str(i)),)) for i in range(4)
+    ]
+    store.append_records(100.0, recs)
+    assert store.series_count() == 2
+    assert store.dropped_series == 2
+    # existing series keep folding; overflow keys stay dropped
+    store.append_records(101.0, recs)
+    assert store.series_count() == 2
+    assert store.dropped_series == 4
+    rec = store.query("m_total")
+    assert sum(len(s) for s in rec["series"].values()) == 4
+
+
+# ---------------------------------------------------------------------------
+# window math (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_rate_across_counter_reset():
+    # reporter restarts at t=20: 100 -> 40 means the restarted cumulative
+    # value IS the increase since the reset (Prometheus increase())
+    samples = [(0.0, 0.0), (10.0, 100.0), (20.0, 40.0)]
+    assert mts.counter_increase(samples) == pytest.approx(140.0)
+    assert mts.window_rate(samples) == pytest.approx(7.0)
+    # no delta information yet
+    assert mts.window_rate([(0.0, 5.0)]) is None
+    assert mts.window_rate([]) is None
+
+
+def _hist(boundaries, buckets, count, total):
+    return {"boundaries": list(boundaries), "buckets": list(buckets),
+            "count": count, "sum": total}
+
+
+def test_histogram_quantile_window_vs_exact():
+    bounds = (0.1, 0.5, 1.0)
+    # 100 old observations below 0.1s, then the window adds 8 in
+    # (0.1, 0.5] and 2 in (0.5, 1.0] — the quantile must see ONLY the
+    # windowed delta, not the cumulative distribution
+    s0 = _hist(bounds, [100, 0, 0, 0], 100, 5.0)
+    s1 = _hist(bounds, [100, 4, 1, 0], 105, 6.6)
+    s2 = _hist(bounds, [100, 8, 2, 0], 110, 8.4)
+    inc = mts.histogram_increase([(0.0, s0), (5.0, s1), (10.0, s2)])
+    assert inc["buckets"] == [0.0, 8.0, 2.0, 0.0]
+    assert inc["count"] == 10.0
+    assert inc["sum"] == pytest.approx(3.4)
+    # median rank 5 sits at 5/8 of the (0.1, 0.5] bucket
+    assert mts.quantile_from_buckets(
+        bounds, inc["buckets"], 0.5
+    ) == pytest.approx(0.1 + 0.4 * 5 / 8)
+    # p95 rank 9.5 -> 1.5/2 into the (0.5, 1.0] bucket
+    assert mts.quantile_from_buckets(
+        bounds, inc["buckets"], 0.95
+    ) == pytest.approx(0.5 + 0.5 * 1.5 / 2)
+    # +Inf bucket clamps to the highest finite boundary
+    assert mts.quantile_from_buckets(bounds, [0, 0, 0, 5], 0.9) == 1.0
+    # empty distribution has no quantile
+    assert mts.quantile_from_buckets(bounds, [0, 0, 0, 0], 0.5) is None
+
+
+def test_histogram_increase_across_reset():
+    bounds = (1.0,)
+    s0 = _hist(bounds, [10, 12], 12, 20.0)
+    s1 = _hist(bounds, [2, 3], 3, 4.0)  # reporter restarted
+    inc = mts.histogram_increase([(0.0, s0), (5.0, s1)])
+    assert inc["buckets"] == [2.0, 3.0]
+    assert inc["count"] == 3.0
+    assert inc["sum"] == pytest.approx(4.0)
+
+
+def test_exemplar_merge_newest_wins():
+    bounds = (1.0,)
+    a = _hist(bounds, [1, 1], 1, 0.5)
+    a["exemplars"] = {0: ("trace-old", 0.4, 10.0), 1: ("trace-a", 2.0, 50.0)}
+    b = _hist(bounds, [2, 0], 2, 0.9)
+    b["exemplars"] = {0: ("trace-new", 0.6, 20.0)}
+    merged = mts.merge_value("histogram", a, b)
+    assert merged["buckets"] == [3, 1]
+    assert merged["exemplars"][0] == ("trace-new", 0.6, 20.0)
+    assert merged["exemplars"][1] == ("trace-a", 2.0, 50.0)
+    # merge_value returns fresh objects: mutating the merge must not
+    # alias back into either input (tombstones/rings share inputs)
+    merged["buckets"][0] = 999
+    assert a["buckets"][0] == 1 and b["buckets"][0] == 2
+
+
+# ---------------------------------------------------------------------------
+# cluster: report -> fold -> query (+ exemplar round trip, tombstones)
+# ---------------------------------------------------------------------------
+
+
+def _wait_for(pred, timeout=25.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def fast_report_traced_cluster():
+    """Cluster with a fast fold cadence and the trace plane on — and the
+    process-wide config/trace state restored afterwards (GlobalConfig
+    persists across init/shutdown; a leaked trace_sample would pollute
+    the legacy-tracing tests that run later in the same process)."""
+    worker = ray_tpu.init(
+        num_cpus=2,
+        log_level="WARNING",
+        _system_config={"metrics_report_period_s": 0.2, "trace_sample": 1.0},
+    )
+    yield worker
+    ray_tpu.shutdown()
+    from ray_tpu._private import trace as _tr
+    from ray_tpu._private.config import GlobalConfig
+
+    GlobalConfig.initialize(
+        {"metrics_report_period_s": 5.0, "trace_sample": 0.0}
+    )
+    _tr.disable()
+
+
+def test_cluster_query_rate_quantile_and_exemplars(
+    fast_report_traced_cluster,
+):
+    from ray_tpu import trace
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_ts_reqs_total", "reqs")
+    h = metrics.Histogram(
+        "test_ts_lat_seconds", "lat", boundaries=(0.01, 0.1, 1.0)
+    )
+    bh = h.bind()
+    trace_ids = []
+    # spread observations across several report periods: windowed
+    # increases only see deltas BETWEEN retained samples
+    for _ in range(6):
+        with trace.start("ts-req") as span:
+            trace_ids.append(span.trace_id)
+            bh.observe(0.05)
+        c.inc(10.0)
+        metrics.flush(timeout=5.0)
+        time.sleep(0.25)
+
+    assert "test_ts_lat_seconds" in metrics.list_series()
+
+    def _two_samples():
+        rec = metrics.query("test_ts_lat_seconds", window_s=30.0)
+        if rec and any(len(s) >= 2 for s in rec["series"].values()):
+            return rec
+        return None
+
+    rec = _wait_for(_two_samples)
+    assert rec["type"] == "histogram"
+
+    # all 6 observations landed in the (0.01, 0.1] bucket
+    q99 = _wait_for(
+        lambda: metrics.histogram_quantile(
+            "test_ts_lat_seconds", 0.99, window_s=30.0
+        )
+    )
+    assert 0.01 < q99 <= 0.1
+
+    r = _wait_for(
+        lambda: metrics.rate("test_ts_reqs_total", window_s=30.0)
+    )
+    assert r > 0
+
+    # exemplar round trip: the retained sample carries (trace_id,
+    # value, ts) and the trace plane resolves that id to real spans
+    def _exemplar():
+        rec = metrics.query("test_ts_lat_seconds", window_s=30.0)
+        for samples in rec["series"].values():
+            for _, v in reversed(samples):
+                if isinstance(v, dict) and v.get("exemplars"):
+                    return v["exemplars"]
+        return None
+
+    exemplars = _wait_for(_exemplar)
+    tid, value, _ts = next(iter(exemplars.values()))
+    assert tid in trace_ids
+    assert value == pytest.approx(0.05)
+    t = trace.get(tid)
+    assert t["spans"], t
+
+
+def test_tombstones_keep_pruned_reporters_monotonic(ray_start_regular):
+    """A reporter idle past the prune horizon is folded into the tombstone
+    accumulator: its counters stay in the aggregate forever (monotonic),
+    while its gauges — meaningless without a live reporter — drop out."""
+    import ray_tpu._private.worker as worker_mod
+    from ray_tpu._private.config import GlobalConfig
+
+    gcs = worker_mod.global_worker.node.gcs
+    period = GlobalConfig.metrics_report_period_s
+    old_ts = time.time() - 13 * period  # past the 12-period prune horizon
+
+    dead = [
+        _counter_rec("test_tomb_total", 5.0),
+        {"name": "test_tomb_gauge", "type": "gauge", "description": "d",
+         "series": {(): 7.0}},
+    ]
+    with gcs._lock:
+        gcs._metrics["deadbeef:999"] = (old_ts, dead)
+
+    agg = {r["name"]: r for r in gcs._aggregate_metrics()}
+    assert agg["test_tomb_total"]["series"][()] == 5.0
+    assert "test_tomb_gauge" not in agg
+    with gcs._lock:
+        assert "deadbeef:999" not in gcs._metrics  # pruned into tombstones
+
+    # still there on the next aggregation (tombstones never expire) and a
+    # later reporter's counts stack on top instead of resetting
+    with gcs._lock:
+        gcs._metrics["cafe:1"] = (
+            time.time(), [_counter_rec("test_tomb_total", 3.0)]
+        )
+    agg = {r["name"]: r for r in gcs._aggregate_metrics()}
+    assert agg["test_tomb_total"]["series"][()] == 8.0
+    with gcs._lock:
+        del gcs._metrics["cafe:1"]
+        gcs._metrics_tombstones.clear()

@@ -1,0 +1,352 @@
+"""SLO rules + burn-rate alerting over the retained metrics history.
+
+(reference: the Google SRE workbook multi-window burn-rate pattern —
+ALL windows must burn before an alert fires; PromQL-shaped rule exprs;
+alert lifecycle ok -> pending -> firing -> resolved with cluster events
+and trace exemplars captured at the firing edge.)
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import metrics_ts as mts
+
+
+# ---------------------------------------------------------------------------
+# expression language (no cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_expr_forms():
+    p = mts.parse_expr('rate(errs_total{dep="a"}) / rate(reqs_total{dep="a"})')
+    assert p["kind"] == "ratio"
+    assert p["num"] == ("errs_total", {"dep": "a"})
+    assert p["den"] == ("reqs_total", {"dep": "a"})
+
+    p = mts.parse_expr('histogram_quantile(0.99, lat_seconds{route="/x"})')
+    assert p["kind"] == "quantile"
+    assert p["q"] == 0.99
+    assert p["name"] == "lat_seconds" and p["tags"] == {"route": "/x"}
+
+    assert mts.parse_expr("rate(reqs_total)")["kind"] == "rate"
+    assert mts.parse_expr("gauge(depth{n='1'})") == {
+        "kind": "gauge", "name": "depth", "tags": {"n": "1"}
+    }
+    # a bare selector is a gauge read
+    assert mts.parse_expr("depth")["kind"] == "gauge"
+
+    with pytest.raises(ValueError):
+        mts.parse_expr("histogram_quantile(1.5, lat)")
+    with pytest.raises(ValueError):
+        mts.parse_expr("rate(bad name!)")
+
+
+def test_normalize_rule_validation_and_thresholds():
+    rule = mts.normalize_rule({
+        "name": "avail",
+        "expr": "rate(errs_total) / rate(reqs_total)",
+        "target": 0.999,
+        "windows": [[300, 14.4], [3600, 6.0]],
+    })
+    assert rule["objective"] == "lt"
+    assert rule["windows"] == [(300.0, 14.4), (3600.0, 6.0)]
+    # ratio rules alert on burn x error budget
+    assert mts.SloEngine._threshold(rule, 14.4) == pytest.approx(
+        14.4 * 0.001
+    )
+
+    rule = mts.normalize_rule({
+        "name": "p99", "expr": "histogram_quantile(0.99, lat)",
+        "target": 0.25, "windows": [30.0],
+    })
+    assert rule["windows"] == [(30.0, 1.0)]  # bare window -> burn 1.0
+    # scalar rules alert on burn x target
+    assert mts.SloEngine._threshold(rule, 1.0) == pytest.approx(0.25)
+
+    with pytest.raises(ValueError):
+        mts.normalize_rule({"name": "", "expr": "x", "target": 1.0})
+    with pytest.raises(ValueError):
+        mts.normalize_rule({"name": "x", "expr": "rate(", "target": 1.0})
+    with pytest.raises(ValueError):
+        mts.normalize_rule({"name": "x", "expr": "g", "target": 1.0,
+                            "objective": "sideways"})
+
+
+# ---------------------------------------------------------------------------
+# engine lifecycle (synthetic store, controlled clock)
+# ---------------------------------------------------------------------------
+
+
+def _gauge_rec(name, value):
+    return {"name": name, "type": "gauge", "description": "d",
+            "series": {(): value}}
+
+
+def test_engine_pending_firing_resolved_lifecycle():
+    store = mts.TimeSeriesStore(max_series=100)
+    engine = mts.SloEngine(store)
+    engine.define({
+        "name": "depth", "expr": "gauge(test_depth)", "target": 10.0,
+        "windows": [5.0], "for_s": 3.0,
+    })
+
+    store.append_records(100.0, [_gauge_rec("test_depth", 2.0)])
+    assert engine.evaluate(100.0) == []
+    assert engine.alerts()[0]["state"] == "ok"
+
+    # violation starts: pending, no transition yet (for_s not elapsed)
+    store.append_records(101.0, [_gauge_rec("test_depth", 50.0)])
+    assert engine.evaluate(101.0) == []
+    st = engine.alerts()[0]
+    assert st["state"] == "pending"
+    assert st["value"] == 50.0
+    assert st["windows"][0]["threshold"] == 10.0
+
+    # still violating past for_s: FIRING, one transition
+    store.append_records(105.0, [_gauge_rec("test_depth", 60.0)])
+    trans = engine.evaluate(105.0)
+    assert [(t["from"], t["to"]) for t in trans] == [("pending", "firing")]
+    assert engine.firing_count() == 1
+
+    # clear: RESOLVED, one transition out of firing
+    store.append_records(106.0, [_gauge_rec("test_depth", 1.0)])
+    trans = engine.evaluate(106.0)
+    assert [(t["from"], t["to"]) for t in trans] == [("firing", "resolved")]
+    assert engine.firing_count() == 0
+    # resolved is sticky until the next violation, never re-transitions
+    assert engine.evaluate(107.0) == []
+    assert engine.alerts()[0]["state"] == "resolved"
+
+
+def test_engine_brief_blip_never_fires():
+    store = mts.TimeSeriesStore(max_series=100)
+    engine = mts.SloEngine(store)
+    engine.define({
+        "name": "depth", "expr": "gauge(test_depth)", "target": 10.0,
+        "windows": [5.0], "for_s": 3.0,
+    })
+    store.append_records(100.0, [_gauge_rec("test_depth", 50.0)])
+    assert engine.evaluate(100.0) == []  # pending
+    store.append_records(101.0, [_gauge_rec("test_depth", 1.0)])
+    assert engine.evaluate(101.0) == []  # back to ok, silently
+    assert engine.alerts()[0]["state"] == "ok"
+
+
+def test_engine_multiwindow_requires_all_windows():
+    """Short window burns but the long window doesn't: no alert (the SRE
+    multi-window pattern — a spike must also matter at the long horizon)."""
+    store = mts.TimeSeriesStore(max_series=100)
+    engine = mts.SloEngine(store)
+    engine.define({
+        "name": "errs", "expr": "rate(test_mw_errs_total)", "target": 1.0,
+        "windows": [[10.0, 1.0], [100.0, 1.0]], "for_s": 0.0,
+    })
+    # 0 errs/s for 90s, then 5 errs/s over the last 10s:
+    # short-window rate 5 > 1, long-window rate ~0.5 < 1
+    for t in range(0, 10):
+        store.append_records(100.0 + 10 * t,
+                             [_counter("test_mw_errs_total", 0.0)])
+    store.append_records(200.0, [_counter("test_mw_errs_total", 50.0)])
+    assert engine.evaluate(200.0) == []
+    st = engine.alerts()[0]
+    assert st["state"] == "ok"
+    short, long_ = st["windows"]
+    assert short["violating"] is True
+    assert long_["violating"] is False
+
+
+def _counter(name, value):
+    return {"name": name, "type": "counter", "description": "d",
+            "series": {(): value}}
+
+
+def test_engine_stale_hold_no_flap():
+    """A partitioned reporter must not flap its alerts: while the rule's
+    metrics are stale the state is held as-is (chaos-partition case)."""
+    store = mts.TimeSeriesStore(max_series=100)
+    engine = mts.SloEngine(store)
+    engine.define({
+        "name": "depth", "expr": "gauge(test_depth)", "target": 10.0,
+        "windows": [5.0], "for_s": 0.0,
+    })
+    store.append_records(100.0, [_gauge_rec("test_depth", 50.0)])
+    trans = engine.evaluate(100.0)
+    assert [(t["from"], t["to"]) for t in trans] == [("ok", "firing")]
+
+    # reporter goes dark: no new folds, metric marked stale -> the firing
+    # alert holds (no resolve), and nothing re-fires when it comes back
+    for now in (105.0, 110.0, 115.0):
+        assert engine.evaluate(now, frozenset({"test_depth"})) == []
+        st = engine.alerts()[0]
+        assert st["state"] == "firing" and st["stale"] is True
+
+    # back, still violating: state unchanged, stale flag drops
+    store.append_records(120.0, [_gauge_rec("test_depth", 55.0)])
+    assert engine.evaluate(120.0) == []
+    st = engine.alerts()[0]
+    assert st["state"] == "firing" and st["stale"] is False
+
+
+def test_mistyped_rule_is_isolated():
+    """A gauge() selector pointed at a histogram has no scalar to read:
+    the rule evaluates to None (not violating) and must not poison the
+    fold for every other rule."""
+    store = mts.TimeSeriesStore(max_series=100)
+    engine = mts.SloEngine(store)
+    engine.define({"name": "bad", "expr": "gauge(test_iso_lat)",
+                   "target": 1.0, "windows": [60.0]})
+    engine.define({"name": "good", "expr": "gauge(test_iso_depth)",
+                   "target": 10.0, "windows": [60.0]})
+    hist = {"name": "test_iso_lat", "type": "histogram", "description": "d",
+            "series": {(): {"boundaries": [0.1], "buckets": [1, 0],
+                            "count": 1, "sum": 0.05}}}
+    store.append_records(100.0, [hist, _gauge_rec("test_iso_depth", 50.0)])
+    store.append_records(101.0, [hist, _gauge_rec("test_iso_depth", 50.0)])
+    trans = engine.evaluate(101.0)
+    assert [(t["name"], t["to"]) for t in trans] == [("good", "firing")]
+    rows = {a["name"]: a for a in engine.alerts()}
+    assert rows["bad"]["state"] == "ok" and rows["bad"]["value"] is None
+
+
+def test_zero_traffic_resolves_ratio_alert():
+    """No traffic burns no error budget: a ratio rule whose denominator
+    goes quiet evaluates to None -> not violating -> resolves."""
+    store = mts.TimeSeriesStore(max_series=100)
+    engine = mts.SloEngine(store)
+    engine.define({
+        "name": "avail",
+        "expr": "rate(test_zt_errs_total) / rate(test_zt_reqs_total)",
+        "target": 0.9, "windows": [10.0], "for_s": 0.0,
+    })
+    store.append_records(100.0, [_counter("test_zt_errs_total", 0.0),
+                                 _counter("test_zt_reqs_total", 0.0)])
+    store.append_records(105.0, [_counter("test_zt_errs_total", 50.0),
+                                 _counter("test_zt_reqs_total", 100.0)])
+    trans = engine.evaluate(105.0)
+    assert [(t["from"], t["to"]) for t in trans] == [("ok", "firing")]
+    # traffic stops: samples age out of the window entirely
+    trans = engine.evaluate(130.0)
+    assert [(t["from"], t["to"]) for t in trans] == [("firing", "resolved")]
+
+
+# ---------------------------------------------------------------------------
+# public API + cluster end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_load_rules_yaml_and_json(tmp_path):
+    from ray_tpu import slo
+
+    doc = [{"name": "a", "expr": "gauge(x)", "target": 1.0},
+           {"name": "b", "expr": "rate(y_total)", "target": 2.0,
+            "windows": [[60, 2.0]]}]
+    jp = tmp_path / "rules.json"
+    jp.write_text(json.dumps({"rules": doc}))
+    assert [r["name"] for r in slo.load_rules(str(jp))] == ["a", "b"]
+
+    yp = tmp_path / "rules.yaml"
+    yp.write_text(
+        "rules:\n"
+        "- name: a\n  expr: gauge(x)\n  target: 1.0\n"
+        "- name: b\n  expr: rate(y_total)\n  target: 2.0\n"
+        "  windows: [[60, 2.0]]\n"
+    )
+    rules = slo.load_rules(str(yp))
+    assert rules == doc
+
+
+def _wait_for(pred, timeout=25.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+@pytest.fixture
+def fast_report_traced_cluster():
+    """Cluster with a fast fold cadence and the trace plane on — and the
+    process-wide config/trace state restored afterwards (GlobalConfig
+    persists across init/shutdown; a leaked trace_sample would pollute
+    the legacy-tracing tests that run later in the same process)."""
+    worker = ray_tpu.init(
+        num_cpus=2,
+        log_level="WARNING",
+        _system_config={"metrics_report_period_s": 0.2, "trace_sample": 1.0},
+    )
+    yield worker
+    ray_tpu.shutdown()
+    from ray_tpu._private import trace as _tr
+    from ray_tpu._private.config import GlobalConfig
+
+    GlobalConfig.initialize(
+        {"metrics_report_period_s": 5.0, "trace_sample": 0.0}
+    )
+    _tr.disable()
+
+
+def test_cluster_slo_fire_and_resolve_with_events(fast_report_traced_cluster):
+    """End to end: define a tight latency SLO, drive slow observations,
+    watch it FIRE (cluster event + gauge + exemplar), stop the load,
+    watch it RESOLVE."""
+    from ray_tpu import slo, trace
+    from ray_tpu.util import metrics
+    from ray_tpu.util.state import list_cluster_events
+
+    rule = slo.define(
+        "tight-p99",
+        "histogram_quantile(0.99, test_slo_lat_seconds)",
+        target=0.02,
+        windows=[5.0],
+    )
+    assert rule["name"] == "tight-p99"
+    assert [r["name"] for r in slo.list()] == ["tight-p99"]
+
+    h = metrics.Histogram(
+        "test_slo_lat_seconds", "lat", boundaries=(0.01, 0.1, 1.0)
+    )
+    bh = h.bind()
+
+    def drive():
+        with trace.start("slow-req"):
+            bh.observe(0.5)  # way over the 0.02s target
+        metrics.flush(timeout=5.0)
+
+    def until_state(want):
+        def _check():
+            drive() if want == "firing" else None
+            rows = {a["name"]: a for a in slo.alerts()}
+            a = rows["tight-p99"]
+            return a if a["state"] == want else None
+        return _check
+
+    fired = _wait_for(until_state("firing"))
+    assert fired["value"] > 0.02
+    assert fired["windows"][0]["threshold"] == pytest.approx(0.02)
+    # the firing edge captured slowest-first trace exemplars that
+    # resolve to real spans
+    assert fired["exemplars"], fired
+    tid = fired["exemplars"][0]["trace_id"]
+    assert trace.get(tid)["spans"]
+
+    events = _wait_for(
+        lambda: list_cluster_events(type="ALERT_FIRING") or None
+    )
+    assert any(e["rule"] == "tight-p99" for e in events)
+
+    # load stops: the window drains, the quantile evaluates to None,
+    # the alert resolves and says so in the event log
+    resolved = _wait_for(until_state("resolved"), timeout=30.0)
+    assert resolved["state"] == "resolved"
+    events = _wait_for(
+        lambda: list_cluster_events(type="ALERT_RESOLVED") or None
+    )
+    assert any(e["rule"] == "tight-p99" for e in events)
+
+    assert slo.remove("tight-p99") is True
+    assert slo.list() == []
