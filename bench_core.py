@@ -491,6 +491,42 @@ def main():
 
     ray_tpu.shutdown()
 
+    # --- scale sim: virtual-node boot rate + mixed-soak throughput ---
+    # rows have no REFERENCE entry (nothing comparable in the reference's
+    # table); warn-only floors live in scripts/bench_smoke.py. Runs after
+    # shutdown: the sim owns its own GCS and process-global config.
+    try:
+        from ray_tpu.sim import SimCluster
+
+        with SimCluster(num_nodes=100, seed=20260808) as sim:
+            boot_rate = len(sim.nodes) / max(sim.boot_s, 1e-9)
+            results["sim_nodes_boot_per_s"] = boot_rate
+            print(json.dumps({"metric": "sim_nodes_boot_per_s",
+                              "value": round(boot_rate, 1),
+                              "unit": "nodes/s", "vs_baseline": None,
+                              "boot_s": round(sim.boot_s, 4)}), flush=True)
+            dep = sim.deploy("bench", num_replicas=8,
+                             capacity_rps=2000.0)
+            t0 = time.perf_counter()
+            i = 0
+            while time.perf_counter() - t0 < 3.0:
+                for _ in range(500):
+                    dep.submit(i)
+                    i += 1
+                sim.train_step(base_s=0.02)
+                sim.rollout_batch(batch=2000)
+            wall = time.perf_counter() - t0
+            t = sim.totals()
+            soak_rate = (t["serve"] + t["train"] + t["rollout"]) / wall
+            results["sim_soak_requests_per_s"] = soak_rate
+            print(json.dumps({"metric": "sim_soak_requests_per_s",
+                              "value": round(soak_rate, 1),
+                              "unit": "req/s", "vs_baseline": None,
+                              "mix": t}), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "sim_plane",
+                          "error": str(e)[-400:]}), flush=True)
+
     # device object plane: run on the virtual CPU mesh in a subprocess so
     # this driver process never claims the TPU chip
 
@@ -540,7 +576,7 @@ def main():
 
     # archive as a round artifact (reference archives its microbenchmark
     # results under release/release_logs/<version>/microbenchmark.json)
-    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r09.json")
+    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r10.json")
     payload = {
         "results": {
             k: round(v, 4) if isinstance(v, (int, float)) else v

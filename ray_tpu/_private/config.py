@@ -170,6 +170,12 @@ class _Config:
         "serve_default_slos": True,
         "serve_slo_default_p99_s": 60.0,
         "serve_slo_default_availability": 0.9,
+        # --- SLO controller (controller.py, hosted in the GCS) ---
+        # disabled by default: no reconcile thread is started and the hot
+        # paths carry zero controller hooks, so the overhead budget gates
+        # are unaffected until an operator opts in
+        "controller_enabled": False,
+        "controller_period_s": 2.0,
         "log_dir": "",
         # --- TPU topology ---
         "tpu_slice_gang_scheduling": True,

@@ -188,6 +188,13 @@ class GcsServer:
         # clear bumps it so late subscribers can order arm/clear events
         self._chaos_version = 0
         self.server.chaos_identity = self._chaos_identity()
+        # SLO controller (controller.py): hosted next to the SloEngine so
+        # it reads alerts/nodes/traces under the same roof it acts on.
+        # Construction is cheap; its reconcile thread only starts when
+        # controller_enabled is set (config or rpc_controller_enable).
+        from ray_tpu.controller import SloController
+
+        self._controller = SloController(self)
         self._stopped = threading.Event()
         if self._storage is not None:
             self._reload_from_storage()
@@ -1786,6 +1793,23 @@ class GcsServer:
         records rpc-server spans for traced control calls)."""
         return _trace.snapshot()
 
+    # -- SLO controller (controller.py) --------------------------------
+
+    def rpc_controller_enable(self, conn, payload=None):
+        return self._controller.enable()
+
+    def rpc_controller_disable(self, conn, payload=None):
+        return self._controller.disable()
+
+    def rpc_controller_status(self, conn, payload=None):
+        return self._controller.status()
+
+    def rpc_controller_rules(self, conn, payload=None):
+        return self._controller.rule_rows()
+
+    def rpc_controller_log(self, conn, payload=None):
+        return self._controller.log(int((payload or {}).get("limit", 50)))
+
     def rpc_perf_profile(self, conn, payload=None):
         """Cluster sampling profiler, GCS leg: sample THIS process (the
         handler blocks a dispatch-pool thread for the window — the pool
@@ -1801,6 +1825,7 @@ class GcsServer:
 
     def stop(self):
         self._stopped.set()
+        self._controller.shutdown()
         self.server.stop()
         self._actor_sched_pool.shutdown(wait=False)
         self._pg_sched_pool.shutdown(wait=False)

@@ -214,6 +214,22 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "new series rejected by the metrics_ts_max_series cap (history "
         "not retained)",
         ()),
+    # -- SLO controller -----------------------------------------------
+    "ray_tpu_controller_actions_total": (
+        "counter",
+        "control actions taken by the SLO controller "
+        "(action=scale_up|scale_down|drain_node|reroute, "
+        "outcome=applied|failed|skipped)",
+        ("action", "outcome")),
+    "ray_tpu_controller_reconciles_total": (
+        "counter", "SLO controller reconcile loop iterations", ()),
+    # -- scale simulation ---------------------------------------------
+    "ray_tpu_sim_virtual_nodes": (
+        "gauge", "virtual nodes currently alive in an in-process sim", ()),
+    "ray_tpu_sim_requests_total": (
+        "counter",
+        "requests driven through a scale sim (workload=serve|train|rollout)",
+        ("workload",)),
     # -- cancellation / graceful drain --------------------------------
     "ray_tpu_tasks_cancelled_total": (
         "counter",

@@ -1,0 +1,636 @@
+"""Scale-simulation mode: O(100) lightweight virtual nodes, one process.
+
+The pillar this unlocks is "prove millions of users on a laptop": seeded
+load generation (serve/loadgen.py), deterministic chaos
+(fault_injection.py), tracing with straggler attribution (trace.py),
+metrics history + burn-rate alerting (metrics_ts.py), and the SLO
+controller (controller.py) all compose here at a scale no in-process
+test cluster of real raylets could reach.
+
+What is REAL in a sim:
+
+- the GCS — registration, heartbeats, the health loop's DEGRADED/DEAD
+  state machine, KV, pubsub, cluster events, the metrics fold + SLO
+  engine, the drain orchestrator, and the hosted SLO controller;
+- the RPC plane — every virtual node owns a real ``RpcServer``; its
+  heartbeats ride a real ``RpcClient`` over the same-process fast path,
+  so chaos drop/delay/partition/disconnect rules fire on the real
+  client hook sites, per virtual-node identity;
+- the chaos plane — schedules are applied through ``rpc_chaos_apply``
+  (versioned, topology-resolved against the registered virtual nodes);
+  the sim ticker executes ``kill_raylet`` rules by abruptly stopping
+  the victim node, exactly as a process kill would;
+- the metrics registry — simulated request latencies land in the same
+  ``ray_tpu_serve_request_latency_seconds`` histograms (with trace
+  exemplars), flow through ``rpc_report_metrics`` into the time-series
+  store, and drive real burn-rate alerts;
+- the trace ring — sampled requests and training steps record real
+  spans with per-virtual-node attribution, so ``trace.stragglers``
+  (and the controller's straggler scan) see genuine fan-out shapes.
+
+What is STUBBED: device planes, plasma stores, and worker processes.
+Replica work is *modeled*: a request's latency is computed from an
+M/M/1-style load curve (base latency, per-replica capacity, the node's
+``slow_factor``) instead of being slept, so one laptop process drives a
+million-request mixed soak in minutes of wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import fault_injection as fi
+from ray_tpu._private import internal_metrics
+from ray_tpu._private import trace as _trace
+from ray_tpu._private.config import GlobalConfig
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+#: GlobalConfig overrides every sim applies (callers can override the
+#: overrides): compressed control-plane timescales so a sub-minute run
+#: exercises health escalation, metrics folds, SLO evaluation, and
+#: controller reconciles many times over.
+SIM_CONFIG_DEFAULTS: Dict[str, Any] = {
+    "health_check_period_s": 0.5,
+    "health_check_failure_threshold": 3,
+    "degraded_window_s": 3.0,
+    "metrics_report_period_s": 1.0,
+    "metrics_stale_after_s": 60.0,
+    "trace_sample": 0.02,
+    "controller_enabled": True,
+    "controller_period_s": 1.0,
+}
+
+
+class VirtualNode:
+    """One simulated node: a real RPC server + GCS client + heartbeat
+    identity, with no workers, store, or device plane behind it."""
+
+    RPC_INLINE = ("ping",)
+
+    def __init__(self, cluster: "SimCluster", name: str, seed: int):
+        self.cluster = cluster
+        self.name = name
+        self.node_id = NodeID.from_random()
+        self.server = RpcServer(f"sim-{name}")
+        self.chaos_identity = fi.identity_for(
+            self.node_id, self.server.address
+        )
+        self.server.chaos_identity = self.chaos_identity
+        self.rng = random.Random(seed)
+        # knobs the scenario (or chaos) turns
+        self.slow_factor = 1.0  # multiplies modeled latencies on this node
+        self.healthy = True  # False -> failing self-probes -> DEGRADED
+        self.draining = False
+        self.alive = True
+        self._lock = threading.Lock()
+        self.server.register_all(self)
+        self.gcs = RpcClient(cluster.gcs_address, prefer_local=True)
+        self.gcs.chaos_identity = self.chaos_identity
+        self.gcs.call(
+            "register_node",
+            (
+                self.node_id,
+                self.server.address,
+                {"CPU": 4.0, "node": 1.0},
+                {"node_name": name, "sim": "1"},
+            ),
+        )
+
+    # -- rpc surface (what the GCS drain/health planes call) -----------
+
+    def rpc_ping(self, conn, payload=None):
+        return "pong"
+
+    def rpc_drain(self, conn, payload=None):
+        """Drain leg of the GCS drain orchestrator: nothing to migrate
+        (no store), but the node stops taking simulated work."""
+        self.draining = True
+        return {"migrated": {}}
+
+    def rpc_shutdown(self, conn, payload=None):
+        # deferred off the handler thread: stop() joins RPC machinery
+        # that is currently dispatching this very call
+        threading.Thread(
+            target=self.stop, kwargs={"unregister": True},
+            name=f"sim-stop-{self.name}", daemon=True,
+        ).start()
+        return True
+
+    def rpc_chaos_report(self, conn, payload=None):
+        return fi.local_report()
+
+    def rpc_trace_spans(self, conn, payload=None):
+        # every virtual node shares the process span ring; the GCS leg of
+        # a harvest already returns it — per-node legs return empty so a
+        # cluster-wide harvest doesn't duplicate spans N times
+        return {"pid": os.getpid(), "spans": [], "dropped": 0}
+
+    def rpc_dump_stacks(self, conn, payload=None):
+        return {"node": self.name, "stacks": []}
+
+    # -- driven by the cluster ticker ----------------------------------
+
+    def heartbeat(self):
+        """One heartbeat through the real client (chaos hooks included);
+        async so a drop/partition never stalls the shared ticker."""
+        if not self.alive:
+            return
+        probes = {
+            "healthy": self.healthy,
+            "detail": "sim probe",
+        }
+        try:
+            self.gcs.call_async(
+                "heartbeat",
+                (self.node_id, {"CPU": 4.0}, None, [], probes),
+                lambda kind, payload: None,
+                timeout=3.0,
+            )
+        except Exception:
+            pass  # client torn down by chaos disconnect: reconnects next tick
+
+    def stop(self, unregister: bool = True):
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+        if unregister:
+            try:
+                self.gcs.call("unregister_node", self.node_id, timeout=5.0)
+            except Exception:
+                pass
+        try:
+            self.gcs.close()
+        except Exception:
+            pass
+        try:
+            self.server.stop()
+        except Exception:
+            pass
+
+
+class SimDeployment:
+    """A modeled serve deployment: replicas are (virtual node, seed)
+    slots; a request picks one by power-of-two-choices over modeled
+    load and *computes* its latency instead of sleeping it."""
+
+    def __init__(self, cluster: "SimCluster", name: str, *,
+                 num_replicas: int, base_latency_s: float = 0.02,
+                 capacity_rps: float = 200.0, slo_p99_s: float = 0.25,
+                 seed: int = 0):
+        self.cluster = cluster
+        self.name = name
+        self.target = int(num_replicas)
+        self.base_latency_s = float(base_latency_s)
+        self.capacity_rps = float(capacity_rps)
+        self.slo_p99_s = float(slo_p99_s)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.replicas: List[VirtualNode] = []
+        # offered-load accounting: the ticker converts the delta into a
+        # per-replica utilization the latency model reads
+        self._arrivals = 0
+        self._last_arrivals = 0
+        self._last_sample = time.monotonic()
+        self.util = 0.0
+        self.completed = 0
+        self.errors = 0
+        self._hist = internal_metrics.bound_histogram(
+            "ray_tpu_serve_request_latency_seconds",
+            {"deployment": name},
+        )
+        self._reqs = internal_metrics.bound_counter(
+            "ray_tpu_serve_requests_total", {"deployment": name})
+        self._errs = internal_metrics.bound_counter(
+            "ray_tpu_serve_request_errors_total", {"deployment": name})
+        self._sim_reqs = internal_metrics.bound_counter(
+            "ray_tpu_sim_requests_total", {"workload": "serve"})
+
+    # -- control loop side ---------------------------------------------
+
+    def reconcile(self, now: float):
+        """Heal replicas: keep ``max(target, controller floor)`` slots on
+        healthy nodes, dropping slots whose node died/drained and placing
+        replacements on the least-loaded eligible nodes."""
+        floor = self.cluster._controller_floor(self.name)
+        want = max(self.target, floor)
+        with self._lock:
+            kept = [n for n in self.replicas if n.alive and not n.draining]
+            candidates = [
+                n for n in self.cluster.alive_nodes()
+                if not n.draining and n not in kept
+            ]
+            self._rng.shuffle(candidates)
+            while len(kept) < want and candidates:
+                kept.append(candidates.pop())
+            healed = kept != self.replicas
+            self.replicas = kept
+        if healed:
+            self.cluster._publish_serve_status()
+
+    def sample_util(self, now: float):
+        with self._lock:
+            delta = self._arrivals - self._last_arrivals
+            self._last_arrivals = self._arrivals
+            dt = max(now - self._last_sample, 1e-3)
+            self._last_sample = now
+            n = max(len(self.replicas), 1)
+        rate = delta / dt
+        self.util = rate / (n * self.capacity_rps)
+
+    # -- data plane (called from loadgen threads) ----------------------
+
+    def submit(self, i: int) -> Dict[str, Any]:
+        with self._lock:
+            self._arrivals += 1
+            live = [
+                n for n in self.replicas
+                if n.alive and not n.draining
+                and n.node_id.hex() not in self.cluster._avoid_nodes
+            ] or [n for n in self.replicas if n.alive and not n.draining]
+        if not live:
+            self._errs.inc()
+            self._sim_reqs.inc()
+            self.errors += 1
+            raise RuntimeError(f"deployment {self.name}: no live replicas")
+        # power-of-two-choices over the modeled per-node slow factor
+        if len(live) >= 2:
+            a, b = self._rng.sample(live, 2)
+            node = a if a.slow_factor <= b.slow_factor else b
+        else:
+            node = live[0]
+        # chaos: the request's "send" to the replica runs the same
+        # decision procedure a real RPC would, against this node's peer
+        # address, so drop/delay rules shape simulated traffic too
+        extra_s = 0.0
+        decision = fi.decide(
+            "send", "serve_request", fi.addr_key(node.server.address))
+        if decision is not None:
+            if decision["action"] in ("drop", "disconnect"):
+                self._errs.inc()
+                self._sim_reqs.inc()
+                self.errors += 1
+                raise TimeoutError(
+                    f"deployment {self.name}: chaos dropped request {i}")
+            if decision["action"] == "delay":
+                extra_s = decision["delay_ms"] / 1000.0
+        # M/M/1-style latency model: base/(1-util), shaped by the node's
+        # slow factor and seeded jitter. No sleeping — the latency is the
+        # *observation*, which is all the SLO plane consumes.
+        util = min(self.util, 0.95)
+        lat = (
+            self.base_latency_s
+            * node.slow_factor
+            / max(1.0 - util, 0.05)
+            * (0.8 + 0.4 * self._rng.random())
+            + extra_s
+        )
+        ctx = _trace.mint() if _trace._active else None
+        if ctx is not None and ctx.sampled:
+            root = _trace.new_span_id()
+            now = time.time()
+            _trace.record_span(
+                ctx.trace_id, root, None, "sim.serve.request", "server",
+                now, lat, attrs={"deployment": self.name})
+            _trace.record_span(
+                ctx.trace_id, _trace.new_span_id(), root,
+                "sim.replica.handle", "task", now, lat * 0.9,
+                attrs={"node_id": node.node_id.hex()})
+            prev = _trace.set_current(
+                _trace.TraceContext(ctx.trace_id, root, True))
+            try:
+                self._hist.observe(lat)
+            finally:
+                _trace.set_current(prev)
+        else:
+            self._hist.observe(lat)
+        self._reqs.inc()
+        self._sim_reqs.inc()
+        with self._lock:
+            self.completed += 1
+        return {"latency_s": lat, "node": node.name}
+
+    def define_slo(self):
+        sel = f'{{deployment="{self.name}"}}'
+        self.cluster._gcs_call("slo_define", [
+            {
+                "name": f"serve-{self.name}-p99",
+                "expr": "histogram_quantile(0.99, "
+                        f"ray_tpu_serve_request_latency_seconds{sel})",
+                "target": self.slo_p99_s,
+                "windows": [10.0],
+                "for_s": 0.0,
+                "description": f"sim p99 SLO for {self.name}",
+            },
+        ])
+
+
+class SimCluster:
+    """The in-process scale simulation. ``SimCluster(num_nodes=100)``
+    boots a real GCS plus N virtual nodes and starts one shared ticker
+    thread that heartbeats every node, executes chaos kill rules,
+    reconciles deployments against controller directives, and flushes
+    metrics into the SLO plane. Use as a context manager."""
+
+    def __init__(self, num_nodes: int = 24, seed: int = 0,
+                 config: Optional[Dict[str, Any]] = None):
+        from ray_tpu._private.gcs import GcsServer
+        from ray_tpu.util import metrics as user_metrics
+
+        self.seed = int(seed)
+        overrides = dict(SIM_CONFIG_DEFAULTS)
+        overrides.update(config or {})
+        # save-restore: a sim must not leak compressed timescales into
+        # the rest of the process (tests share one interpreter)
+        with GlobalConfig._lock:
+            self._saved_config = dict(GlobalConfig._values)
+        GlobalConfig.initialize(overrides)
+        _trace.init_from_config()
+        self._stopped = threading.Event()
+        self.gcs = GcsServer()
+        self.gcs_address = self.gcs.address
+        self.nodes: List[VirtualNode] = []
+        self.deployments: Dict[str, SimDeployment] = {}
+        self._avoid_nodes: set = set()
+        self._lock = threading.Lock()
+        self._train_steps = 0
+        self._rollouts = 0
+        self._rng = random.Random(self.seed)
+        t0 = time.perf_counter()
+        # boot in parallel: each boot is a socket bind + register RPC
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            self.nodes = list(pool.map(
+                lambda i: VirtualNode(self, f"sim-{i:03d}", self.seed + i),
+                range(int(num_nodes)),
+            ))
+        self.boot_s = time.perf_counter() - t0
+        internal_metrics.set_gauge(
+            "ray_tpu_sim_virtual_nodes", float(len(self.nodes)))
+        # metrics: report this process's registry straight into the sim
+        # GCS (no worker is connected), so folds/SLOs/exemplars flow
+        self._saved_reporter = user_metrics._node_reporter
+        user_metrics.configure_node_reporter(
+            self._metrics_call, f"sim:{os.getpid()}")
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name="sim-ticker", daemon=True)
+        self._ticker.start()
+
+    # -- plumbing ------------------------------------------------------
+
+    def _gcs_call(self, method: str, payload=None):
+        return getattr(self.gcs, f"rpc_{method}")(None, payload)
+
+    def _metrics_call(self, method, payload, timeout=5.0):
+        if self._stopped.is_set():
+            return None
+        return self._gcs_call(method, payload)
+
+    def alive_nodes(self) -> List[VirtualNode]:
+        return [n for n in self.nodes if n.alive]
+
+    def node(self, name: str) -> VirtualNode:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def _controller_floor(self, dep: str) -> int:
+        raw = self._gcs_call("kv_get", ("controller", f"serve:{dep}"))
+        if not raw:
+            return 0
+        try:
+            raw = raw.decode() if isinstance(raw, (bytes, bytearray)) else raw
+            return int(json.loads(raw).get("floor", 0))
+        except Exception:
+            return 0
+
+    def _refresh_avoid(self):
+        raw = self._gcs_call("kv_get", ("controller", "avoid_nodes"))
+        nodes: set = set()
+        if raw:
+            try:
+                raw = (raw.decode()
+                       if isinstance(raw, (bytes, bytearray)) else raw)
+                nodes = set(json.loads(raw).get("nodes") or ())
+            except Exception:
+                nodes = set()
+        self._avoid_nodes = nodes
+
+    def _publish_serve_status(self):
+        """The KV snapshot the real serve controller publishes — the SLO
+        controller reads replica counts from it when scaling."""
+        snapshot = {"ts": time.time(), "models": [], "deployments": {}}
+        for name, dep in self.deployments.items():
+            snapshot["deployments"][name] = {
+                "num_replicas": len(dep.replicas),
+                "target": max(dep.target, self._controller_floor(name)),
+                "draining": 0,
+                "ongoing": 0,
+                "total": dep.completed,
+            }
+        self._gcs_call(
+            "kv_put",
+            ("serve", "status", json.dumps(snapshot).encode(), True),
+        )
+
+    # -- the shared ticker ---------------------------------------------
+
+    def _tick_loop(self):
+        from ray_tpu.util import metrics as user_metrics
+
+        period = max(GlobalConfig.health_check_period_s / 2.0, 0.1)
+        flush_every = GlobalConfig.metrics_report_period_s
+        last_flush = 0.0
+        while not self._stopped.wait(period):
+            now = time.monotonic()
+            try:
+                for node in self.alive_nodes():
+                    node.heartbeat()
+                self._run_chaos_process_actions()
+                self._refresh_avoid()
+                for dep in list(self.deployments.values()):
+                    dep.sample_util(now)
+                    dep.reconcile(now)
+                if now - last_flush >= flush_every:
+                    last_flush = now
+                    self._publish_serve_status()
+                    user_metrics.flush(timeout=5.0)
+            except Exception:
+                logger.exception("sim tick failed")
+
+    def _run_chaos_process_actions(self):
+        """Execute kill rules against virtual nodes: a ``kill_raylet`` /
+        ``kill_worker`` targeting a sim node stops it abruptly (no
+        unregister), so the GCS health loop discovers the death exactly
+        as it would a SIGKILLed raylet."""
+        armed = fi._armed
+        if armed is None:
+            return
+        for node in self.alive_nodes():
+            for action in fi.take_process_actions(armed, node.chaos_identity):
+                logger.info(
+                    "sim chaos: %s kills %s",
+                    action["rule"].get("action"), node.name)
+                threading.Thread(
+                    target=node.stop, kwargs={"unregister": False},
+                    name=f"sim-kill-{node.name}", daemon=True,
+                ).start()
+
+    # -- scenario API --------------------------------------------------
+
+    def deploy(self, name: str, **kwargs) -> SimDeployment:
+        import zlib
+
+        kwargs.setdefault("seed", self.seed ^ zlib.crc32(name.encode()))
+        dep = SimDeployment(self, name, **kwargs)
+        self.deployments[name] = dep
+        dep.reconcile(time.monotonic())
+        dep.define_slo()
+        self._publish_serve_status()
+        return dep
+
+    def chaos_apply(self, schedule: Dict[str, Any]) -> int:
+        reply = self._gcs_call("chaos_apply", schedule)
+        return reply["version"] if isinstance(reply, dict) else reply
+
+    def train_step(self, participants: Optional[List[VirtualNode]] = None,
+                   base_s: float = 0.05):
+        """One modeled synchronous training step: a sampled trace fans a
+        ``sim.train.allreduce`` child out to every participant, so the
+        straggler analyzer (and the controller riding it) can attribute
+        slowness to a node. Counts one 'request' per participant shard."""
+        nodes = participants if participants is not None else self.alive_nodes()
+        nodes = [n for n in nodes if not n.draining]
+        if not nodes:
+            return 0.0
+        ctx = _trace.mint() if _trace._active else None
+        root = _trace.new_span_id() if ctx is not None and ctx.sampled else None
+        now = time.time()
+        durs = []
+        for node in nodes:
+            d = base_s * node.slow_factor * (0.9 + 0.2 * node.rng.random())
+            durs.append(d)
+            if root is not None:
+                _trace.record_span(
+                    ctx.trace_id, _trace.new_span_id(), root,
+                    "sim.train.allreduce", "collective", now, d,
+                    attrs={"node_id": node.node_id.hex()})
+        step_s = max(durs)
+        if root is not None:
+            _trace.record_span(
+                ctx.trace_id, root, None, "sim.train.step", "internal",
+                now, step_s, attrs={"world": len(nodes)})
+        internal_metrics.observe(
+            "ray_tpu_collective_latency_seconds", step_s,
+            tags={"op": "sim_allreduce"})
+        internal_metrics.inc(
+            "ray_tpu_sim_requests_total", float(len(nodes)),
+            tags={"workload": "train"})
+        with self._lock:
+            self._train_steps += 1
+        return step_s
+
+    def rollout_batch(self, batch: int = 256, base_s: float = 0.002) -> int:
+        """A batch of async RL rollout steps spread over the cluster:
+        each step observes the task-execution histogram under
+        ``kind="sim_rollout"``. Returns the number of steps executed."""
+        nodes = [n for n in self.alive_nodes() if not n.draining]
+        if not nodes:
+            return 0
+        hist = internal_metrics.bound_histogram(
+            "ray_tpu_task_exec_latency_seconds", {"kind": "sim_rollout"})
+        for i in range(batch):
+            node = nodes[i % len(nodes)]
+            hist.observe(base_s * node.slow_factor
+                         * (0.5 + node.rng.random()))
+        internal_metrics.inc(
+            "ray_tpu_sim_requests_total", float(batch),
+            tags={"workload": "rollout"})
+        with self._lock:
+            self._rollouts += batch
+        return batch
+
+    # -- observability views -------------------------------------------
+
+    def nodes_by_state(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for view in self._gcs_call("get_nodes"):
+            out[view["state"]] = out.get(view["state"], 0) + 1
+        return out
+
+    def alerts(self) -> List[Dict[str, Any]]:
+        return self._gcs_call("alerts")
+
+    def events(self, type: Optional[str] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        payload: Dict[str, Any] = {}
+        if type:
+            payload["type"] = type
+        if limit:
+            payload["limit"] = limit
+        return self._gcs_call("list_cluster_events", payload or None)
+
+    def controller_actions(self) -> List[Dict[str, Any]]:
+        return self.events(type="CONTROLLER_ACTION")
+
+    def serve_p99_s(self, deployment: str, window_s: float = 10.0) -> float:
+        """The SLO plane's own view of a deployment's p99 over the last
+        window, from the retained time series (not a side channel)."""
+        from ray_tpu._private import metrics_ts
+
+        parsed = metrics_ts.parse_expr(
+            "histogram_quantile(0.99, ray_tpu_serve_request_latency_seconds"
+            f'{{deployment="{deployment}"}})'
+        )
+        with self.gcs._slo_lock:
+            val = metrics_ts.eval_expr(
+                self.gcs._ts_store, parsed, window_s, time.time())
+        return float(val) if val is not None else 0.0
+
+    def totals(self) -> Dict[str, int]:
+        serve = sum(d.completed for d in self.deployments.values())
+        errors = sum(d.errors for d in self.deployments.values())
+        with self._lock:
+            return {
+                "serve": serve,
+                "serve_errors": errors,
+                "train": self._train_steps,
+                "rollout": self._rollouts,
+            }
+
+    # -- lifecycle ------------------------------------------------------
+
+    def shutdown(self):
+        from ray_tpu.util import metrics as user_metrics
+
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        self._ticker.join(timeout=5.0)
+        for node in self.nodes:
+            node.stop(unregister=False)
+        self.gcs.stop()
+        internal_metrics.set_gauge("ray_tpu_sim_virtual_nodes", 0.0)
+        fi.disarm()
+        user_metrics._node_reporter = self._saved_reporter
+        with GlobalConfig._lock:
+            GlobalConfig._values.clear()
+            GlobalConfig._values.update(self._saved_config)
+        _trace.init_from_config()
+
+    def __enter__(self) -> "SimCluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

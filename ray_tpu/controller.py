@@ -1,0 +1,632 @@
+"""SLO controller: the control plane that closes the observability loop.
+
+The stack below this module *observes*: metrics history + burn-rate
+alerting (metrics_ts/SloEngine), distributed tracing with critical-path
+and straggler attribution (trace.py), gray-failure detection
+(DEGRADED in the GCS health loop). This module *acts* on those signals —
+and makes every action itself observable.
+
+Hosted inside the GCS (``GcsServer`` constructs one ``SloController``
+next to the SloEngine), the controller runs a reconcile loop that:
+
+- scales serve deployments up when their latency/availability SLO alerts
+  fire (beyond the serve autoscaler's load-only signal) by publishing a
+  replica *floor* directive to the KV namespace ``("controller",
+  "serve:<deployment>")`` that the serve controller honors;
+- scales back down only after the alert has been continuously OK for a
+  hysteresis window, so an oscillating load trace never flaps replicas;
+- drains DEGRADED nodes through the graceful drain plane
+  (``rpc_drain_node``) instead of waiting for escalation to DEAD;
+- re-routes serve traffic around straggler nodes (trace fan-out
+  attribution) via the ``("controller", "avoid_nodes")`` directive, and
+  drains a node whose straggler attribution persists across reconciles.
+
+Every action is audited three ways, always:
+
+- a durable cluster event ``CONTROLLER_ACTION`` carrying the rule, the
+  action, the target, a human reason, the outcome, and the triggering
+  alert's trace exemplars (so ``ray_tpu controller log`` answers *why*
+  with evidence, not just *what*);
+- the ``ray_tpu_controller_actions_total{action,outcome}`` counter;
+- an in-memory ring surfaced by ``controller.status()`` / the dashboard
+  ``/controller`` view.
+
+Disabled by default (``controller_enabled`` config): no thread starts
+and no hot path carries controller hooks, so the overhead budget gates
+are unaffected until an operator opts in (``ray_tpu controller enable``
+or ``_system_config={"controller_enabled": True}``).
+
+Flap resistance: every (rule, target) pair has a cooldown — at most one
+action per window — and scale-down additionally requires the alert to
+have been OK continuously for ``hysteresis_s``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private import internal_metrics
+from ray_tpu._private.config import GlobalConfig
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SloController",
+    "DEFAULT_RULES",
+    "enable",
+    "disable",
+    "status",
+    "log",
+    "rules",
+]
+
+
+#: default rule set — each rule is one observe→act edge. ``on`` selects
+#: the signal: "alert" (a firing SLO alert matching ``match``),
+#: "alert_ok" (the same alert continuously OK for ``hysteresis_s``),
+#: "degraded" (a node in the gray-failure state), "straggler" (trace
+#: fan-out attribution flags a node). ``cooldown_s`` bounds the action
+#: rate per (rule, target).
+DEFAULT_RULES: List[Dict[str, Any]] = [
+    {
+        "name": "scale-up-on-slo",
+        "on": "alert",
+        "match": "serve-*",
+        "action": "scale_up",
+        "cooldown_s": 30.0,
+        "step": 1,
+        "max_replicas": 16,
+    },
+    {
+        "name": "scale-down-on-recovery",
+        "on": "alert_ok",
+        "match": "serve-*",
+        "action": "scale_down",
+        "cooldown_s": 60.0,
+        "hysteresis_s": 60.0,
+        "step": 1,
+    },
+    {
+        "name": "drain-degraded",
+        "on": "degraded",
+        "action": "drain_node",
+        "cooldown_s": 60.0,
+        "deadline_s": 15.0,
+    },
+    {
+        "name": "reroute-straggler",
+        "on": "straggler",
+        "action": "reroute",
+        "cooldown_s": 20.0,
+    },
+    {
+        "name": "drain-straggler",
+        "on": "straggler",
+        "action": "drain_node",
+        "cooldown_s": 120.0,
+        "streak": 2,
+        "deadline_s": 15.0,
+    },
+]
+
+#: deployment floors and avoid directives live in this KV namespace
+KV_NS = "controller"
+#: avoid-directive entries expire if the straggler signal goes quiet
+AVOID_TTL_S = 60.0
+#: straggler scan looks at traces started within this window
+STRAGGLER_WINDOW_S = 30.0
+#: cap on traces assembled per straggler scan (newest first)
+STRAGGLER_MAX_TRACES = 50
+
+
+def _dep_from_alert(alert_name: str) -> Optional[str]:
+    """serve default SLO rules are named ``serve-<deployment>-p99`` /
+    ``serve-<deployment>-availability``; recover the deployment."""
+    if not alert_name.startswith("serve-"):
+        return None
+    rest = alert_name[len("serve-"):]
+    if "-" not in rest:
+        return None
+    return rest.rsplit("-", 1)[0] or None
+
+
+class SloController:
+    """Reconcile loop hosted in the GCS. Safe to construct always —
+    construction costs a few dicts; the thread only starts when enabled."""
+
+    def __init__(self, gcs, rules: Optional[List[Dict[str, Any]]] = None):
+        self._gcs = gcs
+        self._rules = [dict(r) for r in (rules if rules is not None
+                                         else DEFAULT_RULES)]
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._enabled = False
+        # (rule_name, target) -> timestamp of the last attempted action
+        self._last_action: Dict[tuple, float] = {}
+        # alert name -> timestamp it was last seen transitioning to/being OK
+        self._ok_since: Dict[str, float] = {}
+        # node hex -> consecutive-ish straggler attributions (decays by 1
+        # on a quiet pass so a sampling gap doesn't reset the signal)
+        self._straggler_streak: Dict[str, int] = {}
+        # node hex -> last time the straggler signal flagged it
+        self._avoid: Dict[str, float] = {}
+        self._actions: deque = deque(maxlen=256)
+        self._reconciles = 0
+        # pluggable span source for straggler attribution. Default: this
+        # process's trace ring — in scale-sim mode every virtual node
+        # records into the same process-local ring, so the GCS sees the
+        # whole cluster's spans without a harvest fan-out.
+        self.span_source: Callable[[], List[Dict[str, Any]]] = (
+            self._default_span_source
+        )
+        if GlobalConfig.controller_enabled:
+            self.enable()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def enable(self) -> Dict[str, Any]:
+        with self._lock:
+            self._enabled = True
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, name="gcs-controller", daemon=True
+                )
+                self._thread.start()
+        return self.status()
+
+    def disable(self) -> Dict[str, Any]:
+        with self._lock:
+            self._enabled = False
+            self._stop.set()
+            self._thread = None
+        return self.status()
+
+    def shutdown(self):
+        self._enabled = False
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(GlobalConfig.controller_period_s):
+            try:
+                self.reconcile()
+            except Exception:
+                logger.exception("controller reconcile failed")
+
+    # -- introspection -------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self._enabled,
+                "period_s": GlobalConfig.controller_period_s,
+                "reconciles": self._reconciles,
+                "rules": [dict(r) for r in self._rules],
+                "recent_actions": list(self._actions)[-20:],
+                "avoiding": sorted(self._avoid),
+                "floors": self._floors(),
+            }
+
+    def rule_rows(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._rules]
+
+    def log(self, limit: int = 50) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._actions)
+        return out[-int(limit):]
+
+    def _floors(self) -> Dict[str, int]:
+        out = {}
+        for key in self._gcs.rpc_kv_keys(None, (KV_NS, "serve:")):
+            raw = self._gcs.rpc_kv_get(None, (KV_NS, key))
+            if raw:
+                try:
+                    out[key[len("serve:"):]] = int(
+                        json.loads(_as_str(raw)).get("floor", 0)
+                    )
+                except Exception:
+                    pass
+        return out
+
+    # -- the reconcile pass --------------------------------------------
+
+    def reconcile(
+        self,
+        now: Optional[float] = None,
+        alerts: Optional[List[Dict[str, Any]]] = None,
+    ) -> List[Dict[str, Any]]:
+        """One observe→act pass. ``now``/``alerts`` are injectable so
+        tests can drive cooldown/hysteresis with a fake clock and
+        synthetic alert rows. Returns the actions attempted this pass."""
+        now = time.time() if now is None else now
+        internal_metrics.inc("ray_tpu_controller_reconciles_total")
+        with self._lock:
+            self._reconciles += 1
+        if alerts is None:
+            with self._gcs._slo_lock:
+                alerts = self._gcs._slo_engine.alerts()
+
+        actions: List[Dict[str, Any]] = []
+        firing = [a for a in alerts if a.get("state") == "firing"]
+        for a in alerts:
+            name = a.get("name", "")
+            if a.get("state") in ("firing", "pending"):
+                self._ok_since.pop(name, None)
+            else:
+                self._ok_since.setdefault(name, now)
+
+        straggler_rules = [r for r in self._rules if r["on"] == "straggler"]
+        stragglers: Dict[str, Dict[str, Any]] = {}
+        if straggler_rules:
+            stragglers = self._scan_stragglers()
+            for nid in list(self._straggler_streak):
+                if nid not in stragglers:
+                    s = self._straggler_streak[nid] - 1
+                    if s <= 0:
+                        self._straggler_streak.pop(nid)
+                    else:
+                        self._straggler_streak[nid] = s
+            for nid in stragglers:
+                self._straggler_streak[nid] = (
+                    self._straggler_streak.get(nid, 0) + 1
+                )
+
+        for rule in self._rules:
+            on = rule["on"]
+            if on == "alert":
+                for a in firing:
+                    if fnmatch.fnmatch(a.get("name", ""), rule.get("match", "*")):
+                        self._apply_alert_rule(rule, a, now, actions)
+            elif on == "alert_ok":
+                for a in alerts:
+                    name = a.get("name", "")
+                    if not fnmatch.fnmatch(name, rule.get("match", "*")):
+                        continue
+                    ok_since = self._ok_since.get(name)
+                    if ok_since is None:
+                        continue
+                    if now - ok_since >= float(rule.get("hysteresis_s", 60.0)):
+                        self._apply_alert_ok_rule(rule, a, now, actions)
+            elif on == "degraded":
+                for node_hex, reason in self._degraded_nodes():
+                    self._act(
+                        rule, "drain_node", node_hex, now, actions,
+                        reason=f"node DEGRADED: {reason}",
+                        exemplars=[],
+                        deadline_s=float(rule.get("deadline_s", 15.0)),
+                    )
+            elif on == "straggler":
+                for nid, info in stragglers.items():
+                    if rule["action"] == "drain_node":
+                        if self._straggler_streak.get(nid, 0) < int(
+                            rule.get("streak", 2)
+                        ):
+                            continue
+                    self._act(
+                        rule, rule["action"], nid, now, actions,
+                        reason=(
+                            f"straggler attribution x"
+                            f"{self._straggler_streak.get(nid, 1)}: "
+                            f"{info['count']} flagged spans, worst "
+                            f"{info['worst_s'] * 1e3:.0f}ms vs median "
+                            f"{info['median_s'] * 1e3:.0f}ms"
+                        ),
+                        exemplars=info["exemplars"],
+                        deadline_s=float(rule.get("deadline_s", 15.0)),
+                    )
+
+        self._expire_avoid(now)
+        return actions
+
+    # -- rule application ----------------------------------------------
+
+    def _apply_alert_rule(self, rule, alert, now, actions):
+        if rule["action"] != "scale_up":
+            return
+        dep = _dep_from_alert(alert.get("name", ""))
+        if dep is None:
+            return
+        exemplars = [
+            e["trace_id"] for e in (alert.get("exemplars") or [])
+            if e.get("trace_id")
+        ]
+        self._act(
+            rule, "scale_up", dep, now, actions,
+            reason=(
+                f"alert {alert.get('name')} firing: "
+                f"value={_fmt(alert.get('value'))}"
+            ),
+            exemplars=exemplars,
+        )
+
+    def _apply_alert_ok_rule(self, rule, alert, now, actions):
+        if rule["action"] != "scale_down":
+            return
+        dep = _dep_from_alert(alert.get("name", ""))
+        if dep is None:
+            return
+        if self._floor(dep) <= 0:
+            return  # nothing to release — stay silent
+        self._act(
+            rule, "scale_down", dep, now, actions,
+            reason=(
+                f"alert {alert.get('name')} OK for "
+                f"{now - self._ok_since.get(alert.get('name', ''), now):.0f}s"
+            ),
+            exemplars=[],
+        )
+
+    def _act(self, rule, action, target, now, actions, *, reason,
+             exemplars, deadline_s: float = 15.0):
+        key = (rule["name"], target)
+        last = self._last_action.get(key)
+        if last is not None and now - last < float(rule.get("cooldown_s", 30.0)):
+            return  # in cooldown: at most one action per window, silently
+        self._last_action[key] = now
+        outcome = "failed"
+        try:
+            if action == "scale_up":
+                outcome, reason = self._do_scale(rule, target, +1, reason)
+            elif action == "scale_down":
+                outcome, reason = self._do_scale(rule, target, -1, reason)
+            elif action == "drain_node":
+                outcome, reason = self._do_drain(target, deadline_s, reason)
+            elif action == "reroute":
+                outcome, reason = self._do_reroute(target, now, reason)
+            else:
+                outcome = "skipped"
+                reason = f"unknown action {action!r}"
+        except Exception as e:
+            outcome = "failed"
+            reason = f"{reason}; error: {e!r}"
+            logger.warning("controller %s %s failed: %r", action, target, e)
+        row = self._audit(rule["name"], action, target, reason, outcome,
+                          exemplars)
+        actions.append(row)
+
+    # -- actions -------------------------------------------------------
+
+    def _floor(self, dep: str) -> int:
+        raw = self._gcs.rpc_kv_get(None, (KV_NS, f"serve:{dep}"))
+        if not raw:
+            return 0
+        try:
+            return int(json.loads(_as_str(raw)).get("floor", 0))
+        except Exception:
+            return 0
+
+    def _serve_replicas(self, dep: str) -> Optional[int]:
+        raw = self._gcs.rpc_kv_get(None, ("serve", "status"))
+        if not raw:
+            return None
+        try:
+            d = (json.loads(_as_str(raw)).get("deployments") or {}).get(dep)
+            if d is None:
+                return None
+            return int(d.get("num_replicas", 0))
+        except Exception:
+            return None
+
+    def _do_scale(self, rule, dep, direction, reason):
+        step = int(rule.get("step", 1))
+        floor = self._floor(dep)
+        if direction > 0:
+            base = max(floor, self._serve_replicas(dep) or 1)
+            new = base + step
+            cap = int(rule.get("max_replicas", 16))
+            if new > cap:
+                return "skipped", f"{reason}; already at max_replicas={cap}"
+            self._put_floor(dep, new, rule["name"])
+            return "applied", f"{reason}; replica floor {floor} -> {new}"
+        new = floor - step
+        if new <= 0:
+            self._gcs.rpc_kv_del(None, (KV_NS, f"serve:{dep}"))
+            return "applied", f"{reason}; replica floor {floor} released"
+        self._put_floor(dep, new, rule["name"])
+        return "applied", f"{reason}; replica floor {floor} -> {new}"
+
+    def _put_floor(self, dep, floor, rule_name):
+        self._gcs.rpc_kv_put(None, (
+            KV_NS,
+            f"serve:{dep}",
+            json.dumps({"floor": floor, "rule": rule_name,
+                        "ts": time.time()}).encode(),
+            True,
+        ))
+
+    def _do_drain(self, node_hex, deadline_s, reason):
+        reply = self._gcs.rpc_drain_node(
+            None, {"node_id": node_hex, "deadline_s": deadline_s}
+        ) or {}
+        st = reply.get("status")
+        if st == "draining":
+            return "applied", f"{reason}; drain initiated"
+        if st in ("dead", "not_found"):
+            return "skipped", f"{reason}; node already {st}"
+        return "failed", f"{reason}; drain returned {st!r}"
+
+    def _do_reroute(self, node_hex, now, reason):
+        fresh = node_hex not in self._avoid
+        self._avoid[node_hex] = now
+        self._publish_avoid()
+        verb = "avoiding" if fresh else "still avoiding"
+        return "applied", f"{reason}; {verb} replicas on {node_hex[:8]}"
+
+    def _publish_avoid(self):
+        self._gcs.rpc_kv_put(None, (
+            KV_NS,
+            "avoid_nodes",
+            json.dumps({"nodes": sorted(self._avoid),
+                        "ts": time.time()}).encode(),
+            True,
+        ))
+
+    def _expire_avoid(self, now):
+        expired = [n for n, ts in self._avoid.items()
+                   if now - ts > AVOID_TTL_S]
+        if expired:
+            for n in expired:
+                self._avoid.pop(n, None)
+            self._publish_avoid()
+
+    # -- signal sources ------------------------------------------------
+
+    def _degraded_nodes(self):
+        out = []
+        with self._gcs._lock:
+            for info in self._gcs._nodes.values():
+                if info.alive and info.state == "DEGRADED":
+                    probes = info.probes or {}
+                    failing = [k for k, v in probes.items()
+                               if isinstance(v, dict)
+                               and v.get("healthy") is False]
+                    if not failing and probes.get("healthy") is False:
+                        # flat probe shape (the heartbeat contract the
+                        # health loop itself reads)
+                        failing = [probes.get("detail", "self-probe")]
+                    out.append((
+                        info.node_id.hex(),
+                        f"failing probes: {failing or 'unknown'}",
+                    ))
+        return out
+
+    def _default_span_source(self):
+        from ray_tpu._private import trace as _trace
+
+        return _trace.snapshot().get("spans", [])
+
+    def _scan_stragglers(self) -> Dict[str, Dict[str, Any]]:
+        """Assemble recent traces and attribute stragglers to nodes.
+        Returns node_hex -> {count, worst_s, median_s, exemplars}."""
+        from ray_tpu import trace as trace_mod
+
+        try:
+            spans = self.span_source() or []
+        except Exception:
+            return {}
+        cutoff = time.time() - STRAGGLER_WINDOW_S
+        by_trace: Dict[str, List[Dict[str, Any]]] = {}
+        for s in spans:
+            tid = s.get("trace_id")
+            if tid and (s.get("start_ts") or 0.0) >= cutoff:
+                by_trace.setdefault(tid, []).append(s)
+        newest = sorted(
+            by_trace.items(),
+            key=lambda kv: -max((x.get("start_ts") or 0.0) for x in kv[1]),
+        )[:STRAGGLER_MAX_TRACES]
+        out: Dict[str, Dict[str, Any]] = {}
+        for tid, tspans in newest:
+            trace = {
+                "trace_id": tid,
+                "spans": tspans,
+                "roots": trace_mod._assemble(tspans),
+            }
+            try:
+                rows = trace_mod.stragglers(trace)
+            except Exception:
+                continue
+            for row in rows:
+                nid = row.get("node_id")
+                if not nid:
+                    continue
+                agg = out.setdefault(nid, {
+                    "count": 0, "worst_s": 0.0, "median_s": 0.0,
+                    "exemplars": [],
+                })
+                agg["count"] += 1
+                if row["dur_s"] > agg["worst_s"]:
+                    agg["worst_s"] = row["dur_s"]
+                    agg["median_s"] = row.get("median_s") or 0.0
+                if tid not in agg["exemplars"] and len(agg["exemplars"]) < 5:
+                    agg["exemplars"].append(tid)
+        return out
+
+    # -- audit ---------------------------------------------------------
+
+    def _audit(self, rule, action, target, reason, outcome, exemplars):
+        row = {
+            "ts": time.time(),
+            "rule": rule,
+            "action": action,
+            "target": target,
+            "reason": reason,
+            "outcome": outcome,
+            "exemplars": list(exemplars or []),
+        }
+        with self._lock:
+            self._actions.append(row)
+        internal_metrics.inc(
+            "ray_tpu_controller_actions_total",
+            tags={"action": action, "outcome": outcome},
+        )
+        self._gcs._record_cluster_event(
+            "CONTROLLER_ACTION",
+            f"controller {action} {target[:16]} ({outcome}): {reason}",
+            severity="INFO" if outcome == "applied" else "WARNING",
+            rule=rule,
+            action=action,
+            target=target,
+            reason=reason,
+            outcome=outcome,
+            exemplars=list(exemplars or []),
+        )
+        return row
+
+
+def _as_str(raw) -> str:
+    return raw.decode() if isinstance(raw, (bytes, bytearray)) else str(raw)
+
+
+def _fmt(v) -> str:
+    try:
+        return f"{float(v):.4g}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+# -- public API (mirrors ray_tpu.slo) ----------------------------------
+
+
+def _call(method: str, payload=None, *, address=None):
+    from ray_tpu.util.state import _gcs_call
+
+    return _gcs_call(method, payload, address=address)
+
+
+def enable(*, address=None) -> Dict[str, Any]:
+    """Turn the controller's reconcile loop on (idempotent)."""
+    return _call("controller_enable", address=address)
+
+
+def disable(*, address=None) -> Dict[str, Any]:
+    """Stop the reconcile loop; directives already published remain."""
+    return _call("controller_disable", address=address)
+
+
+def status(*, address=None) -> Dict[str, Any]:
+    """Controller state: enabled, reconcile count, rules, recent
+    actions, active avoid set, and published replica floors."""
+    return _call("controller_status", address=address)
+
+
+def log(limit: int = 50, *, address=None) -> List[Dict[str, Any]]:
+    """The durable action audit trail: CONTROLLER_ACTION cluster events
+    (rule, action, target, reason, outcome, trace exemplars)."""
+    return _call(
+        "list_cluster_events",
+        {"type": "CONTROLLER_ACTION", "limit": int(limit)},
+        address=address,
+    )
+
+
+def rules(*, address=None) -> List[Dict[str, Any]]:
+    """The active rule set."""
+    return _call("controller_rules", address=address)

@@ -59,7 +59,8 @@ _PAGE = """<!doctype html>
 <h2>Events <a href="/events" style="font-size:.75rem">(full log)</a>
 <a href="/perf" style="font-size:.75rem">(rpc perf)</a>
 <a href="/traces" style="font-size:.75rem">(traces)</a>
-<a href="/metrics/view" style="font-size:.75rem">(metrics/slo)</a></h2>
+<a href="/metrics/view" style="font-size:.75rem">(metrics/slo)</a>
+<a href="/controller" style="font-size:.75rem">(controller)</a></h2>
 <div id="events"></div>
 <script>
 function table(rows, cols){
@@ -304,6 +305,66 @@ async function refresh(){
       : 'no serve controller running';
   }catch(e){
     document.getElementById('updated').textContent = 'refresh failed: '+e;
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_CONTROLLER_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu controller</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;font-size:.85rem;text-align:left}
+ th{background:#f0f0f0} .ok{color:#0a7d2c} .bad{color:#c0232c}
+ .mono{font-family:ui-monospace,monospace;font-size:.8rem}
+ #state{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>SLO controller <a href="/" style="font-size:.8rem">dashboard</a>
+<span id="state"></span></h1>
+<h2>Rules</h2><div id="rules"></div>
+<h2>Action audit trail</h2><div id="log"></div>
+<script>
+async function refresh(){
+  try{
+    const st = await (await fetch('/api/controller')).json();
+    const s = st.status || {};
+    document.getElementById('state').textContent =
+      (s.enabled ? 'ENABLED' : 'disabled')
+      + ` / period ${s.period_s}s / ${s.reconciles} reconciles`
+      + (Object.keys(s.floors||{}).length
+         ? ' / floors: '+Object.entries(s.floors).map(
+             ([k,v])=>`${k}=${v.floor??v}`).join(' ')
+         : '')
+      + ((s.avoiding||[]).length
+         ? ' / avoiding: '+s.avoiding.map(n=>n.slice(0,12)).join(' ') : '');
+    let h = '<table><tr><th>rule</th><th>signal</th><th>action</th>'+
+            '<th>cooldown</th><th>match</th></tr>';
+    for(const r of (s.rules||[]))
+      h += `<tr><td>${r.name}</td><td>${r.on}</td><td>${r.action}</td>`+
+           `<td>${r.cooldown_s}s</td><td>${r.match||'*'}</td></tr>`;
+    document.getElementById('rules').innerHTML =
+      (s.rules||[]).length ? h+'</table>' : '<em>no rules</em>';
+    const evs = st.log || [];
+    let g = '<table><tr><th>time</th><th>rule</th><th>action</th>'+
+            '<th>target</th><th>outcome</th><th>reason</th>'+
+            '<th>trace exemplars</th></tr>';
+    for(const e of evs.slice().reverse()){
+      const cls = e.outcome === 'applied' ? 'ok' : 'bad';
+      const ex = (e.exemplars||[]).map(t=>
+        `<a class="mono" href="/traces">${String(t).slice(0,16)}</a>`).join(' ');
+      g += `<tr><td>${new Date(e.ts*1000).toLocaleTimeString()}</td>`+
+           `<td>${e.rule}</td><td>${e.action}</td>`+
+           `<td class="mono">${String(e.target).slice(0,16)}</td>`+
+           `<td class="${cls}">${e.outcome}</td><td>${e.reason}</td>`+
+           `<td>${ex||'-'}</td></tr>`;
+    }
+    document.getElementById('log').innerHTML =
+      evs.length ? g+'</table>' : '<em>no actions recorded</em>';
+  }catch(e){
+    document.getElementById('state').textContent = 'refresh failed: '+e;
   }
 }
 refresh(); setInterval(refresh, 2000);
@@ -770,6 +831,8 @@ class DashboardServer:
             return _TRACES_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/serve":
             return _SERVE_PAGE.encode(), "text/html; charset=utf-8"
+        if base0 == "/controller":
+            return _CONTROLLER_PAGE.encode(), "text/html; charset=utf-8"
         if base0 == "/logs":
             return _LOGS_PAGE.encode(), "text/html; charset=utf-8"
         if base0.startswith("/logs/"):
@@ -879,6 +942,25 @@ class DashboardServer:
             }
             return (
                 json.dumps(_to_jsonable(doc)).encode(),
+                "application/json",
+            )
+        if base == "/api/controller":
+            # controller status + the CONTROLLER_ACTION audit trail in one
+            # round trip for the /controller view
+            try:
+                status = s._gcs_call("controller_status", address=a)
+            except Exception:
+                status = {}
+            try:
+                log = s._gcs_call(
+                    "list_cluster_events",
+                    {"type": "CONTROLLER_ACTION", "limit": 100},
+                    address=a,
+                )
+            except Exception:
+                log = []
+            return (
+                json.dumps(_to_jsonable({"status": status, "log": log})).encode(),
                 "application/json",
             )
         if base == "/api/alerts":

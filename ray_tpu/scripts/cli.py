@@ -161,11 +161,23 @@ def cmd_stop(args) -> int:
 
 
 def cmd_status(args) -> int:
+    """One-shot cluster health summary: nodes by state, firing alerts,
+    slowest RPC methods, and the controller's most recent actions."""
     from ray_tpu.util.state import list_nodes
 
     address = _head_address(args.address)
     nodes = list_nodes(address=address)
-    print(f"cluster at {address}: {sum(n['alive'] for n in nodes)} alive node(s)")
+    by_state: Dict[str, int] = {}
+    for n in nodes:
+        state = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
+        by_state[state] = by_state.get(state, 0) + 1
+    counts = " ".join(
+        f"{s}={by_state[s]}"
+        for s in ("ALIVE", "DEGRADED", "DRAINING", "DEAD")
+        if s in by_state
+    )
+    print(f"cluster at {address}: {sum(n['alive'] for n in nodes)} "
+          f"alive node(s)  [{counts}]")
     for n in nodes:
         state = n.get("state") or ("ALIVE" if n["alive"] else "DEAD")
         state = f"{state:<8}"
@@ -174,6 +186,140 @@ def cmd_status(args) -> int:
             for k, v in sorted(n["resources"].items())
         )
         print(f"  [{state}] {n['node_id'].hex()[:12]} @ {n['address'][0]}:{n['address'][1]}  {res}")
+
+    # firing alerts (best-effort: planes may have no data yet)
+    try:
+        from ray_tpu import slo as slo_mod
+
+        firing = [a for a in slo_mod.alerts(address=address)
+                  if a["state"] == "firing"]
+    except Exception:
+        firing = []
+    if firing:
+        print(f"alerts: {len(firing)} FIRING")
+        for a in firing:
+            ex = " ".join(e["trace_id"][:16] for e in a.get("exemplars", ()))
+            print(f"  !! {a['name']}: value={_fmt_opt(a.get('value'))}"
+                  + (f"  exemplars: {ex}" if ex else ""))
+    else:
+        print("alerts: none firing")
+
+    # top-3 slowest RPC methods by request p99 (perf plane)
+    try:
+        from ray_tpu.util.state import summarize_rpcs
+
+        stats = summarize_rpcs(address=address)
+    except Exception:
+        stats = {}
+    rows = []
+    for method, phases in stats.items():
+        row = phases.get("request") or next(iter(phases.values()), None)
+        if row:
+            rows.append((row["p99_s"], method, row["count"]))
+    rows.sort(reverse=True)
+    if rows:
+        print("slowest RPCs (p99):")
+        for p99, method, count in rows[:3]:
+            print(f"  {method:<28} {_fmt_us(p99):>9}  ({count} calls)")
+
+    # recent controller actions (audit trail)
+    try:
+        from ray_tpu import controller as controller_mod
+
+        actions = controller_mod.log(limit=5, address=address)
+    except Exception:
+        actions = []
+    if actions:
+        print("recent controller actions:")
+        for ev in actions:
+            print(f"  {_fmt_ev_ts(ev.get('ts'))} {ev.get('rule', '?'):<22} "
+                  f"{ev.get('action', '?'):<11} {str(ev.get('target', ''))[:14]:<14} "
+                  f"{ev.get('outcome', '')}")
+    return 0
+
+
+def _fmt_opt(v) -> str:
+    return "-" if v is None else format(v, ".6g")
+
+
+def _fmt_ev_ts(ts) -> str:
+    if not ts:
+        return "-" * 8
+    return time.strftime("%H:%M:%S", time.localtime(float(ts)))
+
+
+def cmd_controller(args) -> int:
+    """``raytpu controller status|enable|disable|rules|log`` — the SLO
+    controller hosted in the GCS."""
+    from ray_tpu import controller as controller_mod
+
+    address = _head_address(args.address)
+    if args.controller_cmd == "enable":
+        out = controller_mod.enable(address=address)
+        print(f"controller enabled (period {out.get('period_s', '?')}s)")
+        return 0
+    if args.controller_cmd == "disable":
+        controller_mod.disable(address=address)
+        print("controller disabled")
+        return 0
+    if args.controller_cmd == "rules":
+        rows = controller_mod.rules(address=address)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=_json_default))
+            return 0
+        hdr = f"{'rule':<26} {'on':<11} {'action':<11} {'cooldown':>9} match"
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            print(f"{r['name']:<26} {r.get('on', ''):<11} "
+                  f"{r.get('action', ''):<11} "
+                  f"{r.get('cooldown_s', 0):>8g}s {r.get('match', '*')}")
+        return 0
+    if args.controller_cmd == "log":
+        events = controller_mod.log(limit=args.limit, address=address)
+        if args.json:
+            print(json.dumps(events, indent=2, default=_json_default))
+            return 0
+        if not events:
+            print("no controller actions recorded")
+            return 0
+        hdr = (f"{'time':<9} {'rule':<24} {'action':<11} {'target':<16} "
+               f"{'outcome':<8} reason")
+        print(hdr)
+        print("-" * len(hdr))
+        for ev in events:
+            ex = " ".join(str(e)[:16] for e in ev.get("exemplars", ()))
+            line = (f"{_fmt_ev_ts(ev.get('ts')):<9} {ev.get('rule', '?'):<24} "
+                    f"{ev.get('action', '?'):<11} "
+                    f"{str(ev.get('target', ''))[:16]:<16} "
+                    f"{ev.get('outcome', ''):<8} {ev.get('reason', '')}")
+            if ex:
+                line += f"  [traces: {ex}]"
+            print(line)
+        return 0
+    # status
+    doc = controller_mod.status(address=address)
+    if args.json:
+        print(json.dumps(doc, indent=2, default=_json_default))
+        return 0
+    state = "ENABLED" if doc.get("enabled") else "disabled"
+    print(f"controller: {state}  period={doc.get('period_s', '?')}s  "
+          f"reconciles={doc.get('reconciles', 0)}")
+    floors = doc.get("floors") or {}
+    if floors:
+        print("replica floors: "
+              + " ".join(f"{k}={v.get('floor', v)}" if isinstance(v, dict)
+                         else f"{k}={v}" for k, v in sorted(floors.items())))
+    avoiding = doc.get("avoiding") or []
+    if avoiding:
+        print("avoiding nodes: " + " ".join(str(a)[:12] for a in avoiding))
+    recent = doc.get("recent_actions") or []
+    if recent:
+        print(f"recent actions ({len(recent)}):")
+        for a in recent[-10:]:
+            print(f"  {_fmt_ev_ts(a.get('ts'))} {a.get('rule', '?'):<22} "
+                  f"{a.get('action', '?'):<11} "
+                  f"{str(a.get('target', ''))[:14]:<14} {a.get('outcome', '')}")
     return 0
 
 
@@ -667,9 +813,46 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--force", action="store_true")
     s.set_defaults(fn=cmd_stop)
 
-    s = sub.add_parser("status", help="cluster resource overview")
+    s = sub.add_parser(
+        "status",
+        help="one-shot cluster health summary",
+        description="Nodes by state (ALIVE/DEGRADED/DRAINING/DEAD), firing "
+        "SLO alerts with trace exemplars, the three slowest RPC methods by "
+        "p99, and the SLO controller's most recent actions.",
+    )
     s.add_argument("--address")
     s.set_defaults(fn=cmd_status)
+
+    s = sub.add_parser(
+        "controller",
+        help="SLO controller: status, enable/disable, rules, action log",
+        description="The GCS-hosted SLO controller consumes firing alerts, "
+        "metric windows, and trace straggler attributions and acts — "
+        "scaling serve replicas, draining DEGRADED/straggler nodes, "
+        "re-routing around slow replicas — with per-rule cooldowns and "
+        "hysteresis. Every action is a CONTROLLER_ACTION cluster event "
+        "carrying the rule, reason, outcome, and trace exemplars.",
+    )
+    controller_sub = s.add_subparsers(dest="controller_cmd", required=True)
+    d = controller_sub.add_parser("status", help="enabled state, floors, recent actions")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_controller)
+    d = controller_sub.add_parser("enable", help="start the reconcile loop")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_controller)
+    d = controller_sub.add_parser("disable", help="stop the reconcile loop")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_controller)
+    d = controller_sub.add_parser("rules", help="the active rule set")
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_controller)
+    d = controller_sub.add_parser("log", help="the action audit trail")
+    d.add_argument("--limit", type=int, default=50)
+    d.add_argument("--json", action="store_true", help="raw JSON output")
+    d.add_argument("--address")
+    d.set_defaults(fn=cmd_controller)
 
     s = sub.add_parser("list", help="list cluster state")
     s.add_argument(

@@ -48,6 +48,10 @@ class ServeController:
         # model id -> pinned ObjectRef of registered weights
         self._models: Dict[str, Any] = {}
         self._version = 0
+        # SLO-controller directives (ray_tpu/controller.py via GCS KV):
+        # replica actor ids routed around because their node is in the
+        # controller's straggler avoid set
+        self._avoid_replicas: set = set()
         self._stop = threading.Event()
         self._loop = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True
@@ -165,8 +169,16 @@ class ServeController:
             for aid, m in metrics.items():
                 for mid in m.get("models") or ():
                     model_locations.setdefault(mid, []).append(aid)
+            replicas = list(dep["replicas"])
+            if self._avoid_replicas:
+                kept = [
+                    r for r in replicas
+                    if r._actor_id not in self._avoid_replicas
+                ]
+                if kept:  # never route into the void: avoid is best-effort
+                    replicas = kept
             return {
-                "replicas": list(dep["replicas"]),
+                "replicas": replicas,
                 "version": self._version,
                 # controller-observed per-replica in-flight counts: the
                 # handle folds these into its po2 scores so load skew from
@@ -251,8 +263,13 @@ class ServeController:
         spec = dep["spec"]
         auto = spec.get("autoscaling")
         if not auto:
-            return int(spec.get("num_replicas", 1))
-        return int(dep.get("autoscale_target", auto.get("min_replicas", 1)))
+            base = int(spec.get("num_replicas", 1))
+        else:
+            base = int(dep.get("autoscale_target", auto.get("min_replicas", 1)))
+        # the SLO controller's replica floor wins over the load-only
+        # autoscale signal (it fires on latency/availability burn, which
+        # queue depth alone can miss)
+        return max(base, int(dep.get("controller_floor", 0)))
 
     def _reconcile_once(self):
         with self._reconcile_lock:
@@ -448,6 +465,69 @@ class ServeController:
             )
         dep["autoscale_target"] = desired
 
+    # -- SLO controller directives ----------------------------------------
+
+    def _poll_directives_once(self):
+        """Consume the SLO controller's GCS-KV directives: a per-deployment
+        replica *floor* (``("controller", "serve:<name>")``) and the
+        cluster-wide straggler avoid set (``("controller",
+        "avoid_nodes")``). Best-effort — a KV hiccup must not stall
+        reconciliation."""
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            if global_worker is None:
+                return
+            gcs = global_worker.core.gcs
+            with self._lock:
+                names = list(self._deployments)
+            for name in names:
+                raw = gcs.call(
+                    "kv_get", ("controller", f"serve:{name}"), timeout=5.0)
+                floor = 0
+                if raw:
+                    try:
+                        floor = int(json.loads(_as_str(raw)).get("floor", 0))
+                    except Exception:
+                        floor = 0
+                with self._lock:
+                    dep = self._deployments.get(name)
+                    if dep is None:
+                        continue
+                    if floor > 0:
+                        dep["controller_floor"] = floor
+                    else:
+                        dep.pop("controller_floor", None)
+            raw = gcs.call("kv_get", ("controller", "avoid_nodes"), timeout=5.0)
+            nodes: set = set()
+            if raw:
+                try:
+                    nodes = set(json.loads(_as_str(raw)).get("nodes") or ())
+                except Exception:
+                    nodes = set()
+            self._refresh_avoided_replicas(nodes)
+        except Exception:
+            pass
+
+    def _refresh_avoided_replicas(self, node_hexes: set):
+        if not node_hexes:
+            if self._avoid_replicas:
+                with self._lock:
+                    self._avoid_replicas = set()
+                    self._version += 1
+            return
+        from ray_tpu.util.state import list_actors
+
+        avoided = set()
+        for row in list_actors():
+            nid = row.get("node_id")
+            if nid is not None and nid.hex() in node_hexes:
+                avoided.add(row["actor_id"])
+        with self._lock:
+            if avoided != self._avoid_replicas:
+                self._avoid_replicas = avoided
+                self._version += 1
+
     # -- dashboard feed ----------------------------------------------------
 
     def _publish_status(self):
@@ -492,8 +572,13 @@ class ServeController:
         while not self._stop.wait(interval):
             try:
                 self._poll_metrics_once()
+                self._poll_directives_once()
                 self._reconcile_once()
                 self._reap_draining()
                 self._publish_status()
             except Exception:
                 logger.exception("serve reconcile iteration failed")
+
+
+def _as_str(raw) -> str:
+    return raw.decode() if isinstance(raw, (bytes, bytearray)) else str(raw)

@@ -1,0 +1,40 @@
+"""Public scale-simulation API.
+
+Boot O(100) lightweight virtual nodes inside one process — real GCS,
+real RPC (local fast path), real scheduler/heartbeat/degraded state
+machine, real metrics/trace/SLO planes — with stub device planes, so a
+laptop can drive million-request mixed soaks (serve + training + RL
+rollouts) under a chaos schedule and watch the SLO controller act.
+
+Example::
+
+    import ray_tpu.sim as sim
+
+    with sim.SimCluster(num_nodes=100, seed=0) as cluster:
+        dep = cluster.deploy("chat", num_replicas=4)
+        dep.define_slo()
+        for i in range(100_000):
+            dep.submit(i)
+        cluster.train_step()
+        cluster.rollout_batch(batch=512)
+        print(cluster.nodes_by_state(), cluster.controller_actions())
+
+Everything the real cluster exposes — ``ray_tpu status``, alerts,
+cluster events, controller audit log, metrics time series — reads
+identically from a sim because a sim *is* a cluster, minus the device
+planes and the process boundaries.
+"""
+
+from ray_tpu._private.sim import (  # noqa: F401
+    SIM_CONFIG_DEFAULTS,
+    SimCluster,
+    SimDeployment,
+    VirtualNode,
+)
+
+__all__ = [
+    "SIM_CONFIG_DEFAULTS",
+    "SimCluster",
+    "SimDeployment",
+    "VirtualNode",
+]

@@ -43,6 +43,8 @@ CHECKS = (
     "allreduce_gbps",
     "reducescatter_gbps",
     "serve_batched_tokens_per_s",
+    "sim_nodes_boot_per_s",
+    "sim_soak_requests_per_s",
 )
 # lower-is-better rows: warn when the measured value exceeds the archived
 # value divided by FLOOR_FRACTION (the mirror image of the floor checks)
@@ -242,6 +244,34 @@ def main() -> int:
             pass
 
     ray_tpu.shutdown()
+
+    # scale sim (warn rows): 100-virtual-node boot rate + a 2 s mixed
+    # soak at bench_core's parameters. Runs after shutdown — the sim owns
+    # its own GCS and process-global config.
+    try:
+        from ray_tpu.sim import SimCluster
+
+        with SimCluster(num_nodes=100, seed=20260808) as sim:
+            results["sim_nodes_boot_per_s"] = (
+                len(sim.nodes) / max(sim.boot_s, 1e-9)
+            )
+            dep = sim.deploy("bench", num_replicas=8, capacity_rps=2000.0)
+            t0 = time.perf_counter()
+            i = 0
+            while time.perf_counter() - t0 < 2.0:
+                for _ in range(500):
+                    dep.submit(i)
+                    i += 1
+                sim.train_step(base_s=0.02)
+                sim.rollout_batch(batch=2000)
+            wall = time.perf_counter() - t0
+            t = sim.totals()
+            results["sim_soak_requests_per_s"] = (
+                (t["serve"] + t["train"] + t["rollout"]) / wall
+            )
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "sim_plane", "error": str(e)[-300:]}),
+              flush=True)
 
     failed = False
     for key, r05 in R05_VALUES.items():

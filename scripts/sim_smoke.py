@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Per-PR scale-sim + SLO-controller smoke (<90 s): a 24-virtual-node
+in-process sim under mixed load, with one chaos-injected node kill and
+one planted straggler, closing the loop end to end.
+
+Hard-fails (nonzero exit) when any leg breaks:
+  1. 24 virtual nodes boot and register ALIVE through the real RPC
+     plane in under 10 s.
+  2. A chaos ``kill_raylet`` rule kills its named node; the health
+     loop declares it DEAD and the deployment heals its replicas.
+  3. Training-step trace fan-out attributes the planted straggler
+     (one node at 10x slow factor); the controller re-routes around it
+     and then drains it — both actions landing in the audit trail with
+     the triggering rule and trace exemplars.
+  4. Serve p99 recovers to the pre-fault band after the controller's
+     actions settle.
+
+Usage: env JAX_PLATFORMS=cpu python scripts/sim_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 20260808
+SLO_P99_S = 0.3
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL sim_smoke: {msg}")
+    sys.exit(1)
+
+
+def wait_for(pred, timeout: float, what: str, interval: float = 0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    fail(f"timed out after {timeout:.0f}s waiting for {what}")
+
+
+def main() -> None:
+    t_start = time.time()
+    from ray_tpu.sim import SimCluster
+
+    with SimCluster(num_nodes=24, seed=SEED) as sim:
+        # -- leg 1: boot ------------------------------------------------
+        if sim.boot_s > 10.0:
+            fail(f"24-node boot took {sim.boot_s:.1f}s (> 10s)")
+        if sim.nodes_by_state() != {"ALIVE": 24}:
+            fail(f"not all nodes ALIVE after boot: {sim.nodes_by_state()}")
+        print(f"ok  1: 24 virtual nodes ALIVE in {sim.boot_s * 1e3:.0f} ms")
+
+        dep = sim.deploy("smoke", num_replicas=4, base_latency_s=0.02,
+                         capacity_rps=400.0, slo_p99_s=SLO_P99_S)
+        dep.define_slo()
+
+        # plant the straggler on a non-replica node; chaos kills another
+        replicas = set(dep.replicas)
+        spare = [n for n in sim.nodes if n not in replicas]
+        straggler, kill_target = spare[0], spare[1]
+        straggler.slow_factor = 10.0
+        sim.chaos_apply({
+            "version": 1,
+            "seed": SEED,
+            "rules": [{"action": "kill_raylet", "node": kill_target.name}],
+        })
+
+        # -- mixed load: serve + train (straggler fan-out) + rollouts ---
+        def drive(n_serve=150):
+            for i in range(n_serve):
+                try:
+                    dep.submit(i)
+                except Exception:
+                    pass
+            sim.train_step(base_s=0.03)
+            sim.rollout_batch(batch=200)
+
+        # -- leg 2: chaos kill detected, deployment heals ---------------
+        def killed_and_healed():
+            drive()
+            st = sim.nodes_by_state()
+            healed = (len(dep.replicas) == 4
+                      and all(n.alive for n in dep.replicas))
+            return st.get("DEAD", 0) >= 1 and not kill_target.alive and healed
+
+        wait_for(killed_and_healed, 20, "chaos kill + replica heal")
+        print("ok  2: chaos killed "
+              f"{kill_target.name}, health plane saw it, replicas healed")
+
+        # -- leg 3: straggler attributed -> reroute + drain, audited ----
+        def straggler_drained():
+            drive()
+            acts = sim.controller_actions()
+            hexid = straggler.node_id.hex()
+            reroutes = [a for a in acts if a.get("action") == "reroute"
+                        and a.get("target") == hexid]
+            drains = [a for a in acts if a.get("action") == "drain_node"
+                      and a.get("target") == hexid
+                      and a.get("outcome") == "applied"]
+            return (reroutes and drains
+                    and (reroutes[0], drains[0])) or None
+
+        reroute_ev, drain_ev = wait_for(
+            straggler_drained, 45, "controller to reroute + drain straggler")
+        for ev, name in ((reroute_ev, "reroute"), (drain_ev, "drain")):
+            if not ev.get("rule") or "reason" not in ev:
+                fail(f"{name} action missing rule/reason: {ev}")
+        if not reroute_ev.get("exemplars"):
+            fail(f"reroute action carries no trace exemplars: {reroute_ev}")
+        wait_for(lambda: not straggler.alive or straggler.draining, 30,
+                 "straggler node to drain out")
+        print("ok  3: straggler "
+              f"{straggler.name} rerouted then drained "
+              f"(rule={drain_ev['rule']}, "
+              f"exemplars={len(reroute_ev['exemplars'])})")
+
+        # -- leg 4: p99 recovers ----------------------------------------
+        def p99_recovered():
+            drive()
+            p99 = sim.serve_p99_s("smoke", window_s=10.0)
+            return p99 if 0 < p99 <= SLO_P99_S else None
+
+        p99 = wait_for(p99_recovered, 30, "serve p99 back inside budget")
+        print(f"ok  4: serve p99 recovered to {p99 * 1e3:.0f} ms "
+              f"(budget {SLO_P99_S * 1e3:.0f} ms)")
+
+        totals = sim.totals()
+
+    took = time.time() - t_start
+    if took > 90.0:
+        fail(f"smoke took {took:.0f}s (> 90s budget)")
+    print(f"PASS sim_smoke in {took:.1f}s  "
+          f"(serve={totals['serve']} train={totals['train']} "
+          f"rollout={totals['rollout']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,9 @@ env JAX_PLATFORMS=cpu python scripts/trace_smoke.py
 # SLO plane end to end: retained quantile moves under load, tight p99 SLO
 # fires with a resolvable trace exemplar, resolves when the load stops
 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+# scale sim + SLO controller closed loop: 24 virtual nodes, chaos kill,
+# planted straggler rerouted + drained by the controller, p99 recovers
+env JAX_PLATFORMS=cpu python scripts/sim_smoke.py
 exec env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow' \
     tests/test_observability.py tests/test_profiling.py tests/test_log_plane.py \
     tests/test_perf_plane.py tests/test_trace.py tests/test_metrics_ts.py \

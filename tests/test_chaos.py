@@ -396,9 +396,17 @@ def test_partition_degrades_then_recovers():
         assert "DEAD" not in states.values(), states
         report = chaos.report(address=addr)
         assert report["total_injected"] > 0
-        assert any(
-            e["type"] == "NODE_DEGRADED" for e in report["events"]
-        ), report["events"]
+        # the health loop flips node state under the GCS lock but records
+        # the cluster event after releasing it, so poll rather than assert
+        # on a single report snapshot
+        _await(
+            lambda: any(
+                e["type"] == "NODE_DEGRADED"
+                for e in chaos.report(address=addr)["events"]
+            ),
+            15,
+            "NODE_DEGRADED in chaos report",
+        )
         chaos.clear(address=addr)
         _await(
             lambda: all(
@@ -407,8 +415,14 @@ def test_partition_degrades_then_recovers():
             30,
             "recovery to ALIVE",
         )
-        report = chaos.report(address=addr)
-        assert any(e["type"] == "NODE_RECOVERED" for e in report["events"])
+        _await(
+            lambda: any(
+                e["type"] == "NODE_RECOVERED"
+                for e in chaos.report(address=addr)["events"]
+            ),
+            15,
+            "NODE_RECOVERED in chaos report",
+        )
     finally:
         _teardown_cluster(cluster, saved)
 

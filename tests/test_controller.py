@@ -1,0 +1,241 @@
+"""SLO controller: observe -> act with cooldowns, hysteresis, and a
+durable audit trail.
+
+The controller is driven directly via ``reconcile(now=..., alerts=[...])``
+with a fake clock, against a real GcsServer — so the tests cover the
+real action paths (KV floor directives, drain RPCs, cluster events,
+metrics) without waiting out wall-clock cooldown windows.
+"""
+
+import json
+import time
+
+import pytest
+
+from ray_tpu._private.gcs import GcsServer
+from ray_tpu.controller import DEFAULT_RULES, SloController
+
+
+@pytest.fixture
+def gcs():
+    server = GcsServer()
+    yield server
+    server.stop()
+
+
+def _firing(name="serve-echo-p99", value=0.5, exemplars=("aa11", "bb22")):
+    return {
+        "name": name,
+        "state": "firing",
+        "value": value,
+        "exemplars": [{"trace_id": t, "value": value} for t in exemplars],
+    }
+
+
+def _ok(name="serve-echo-p99"):
+    return {"name": name, "state": "ok", "value": 0.01, "exemplars": []}
+
+
+def _floor(gcs, dep="echo"):
+    raw = gcs.rpc_kv_get(None, ("controller", f"serve:{dep}"))
+    return json.loads(raw)["floor"] if raw else None
+
+
+def test_firing_alert_one_action_per_cooldown_window(gcs):
+    ctl = SloController(gcs)
+    t0 = time.time()
+
+    acts = ctl.reconcile(now=t0, alerts=[_firing()])
+    ups = [a for a in acts if a["action"] == "scale_up"]
+    assert len(ups) == 1 and ups[0]["outcome"] == "applied"
+    floor_after_first = _floor(gcs)
+    assert floor_after_first >= 2
+
+    # same alert still firing inside the cooldown window: no new action
+    for dt in (1.0, 10.0, 29.0):
+        acts = ctl.reconcile(now=t0 + dt, alerts=[_firing()])
+        assert not [a for a in acts if a["action"] == "scale_up"]
+    assert _floor(gcs) == floor_after_first
+
+    # cooldown expired (30s rule default): exactly one more step
+    acts = ctl.reconcile(now=t0 + 31.0, alerts=[_firing()])
+    ups = [a for a in acts if a["action"] == "scale_up"]
+    assert len(ups) == 1
+    assert _floor(gcs) == floor_after_first + 1
+
+
+def test_hysteresis_prevents_flapping_under_oscillating_load(gcs):
+    ctl = SloController(gcs)
+    t0 = time.time()
+    ctl.reconcile(now=t0, alerts=[_firing()])
+    assert _floor(gcs) is not None
+
+    # alert oscillates firing <-> ok every 10s: the 60s hysteresis
+    # window never elapses while continuously OK, so the controller
+    # must never scale down (and cooldown bounds scale-ups)
+    downs = []
+    for k in range(1, 13):  # 2 minutes of oscillation
+        alert = _ok() if k % 2 else _firing()
+        acts = ctl.reconcile(now=t0 + 10.0 * k, alerts=[alert])
+        downs += [a for a in acts if a["action"] == "scale_down"]
+    assert downs == []
+
+    # continuously OK for the full hysteresis window: now it may step down
+    base = t0 + 130.0
+    downs = []
+    for dt in (0.0, 30.0, 61.0):
+        acts = ctl.reconcile(now=base + dt, alerts=[_ok()])
+        downs += [a for a in acts if a["action"] == "scale_down"]
+    assert len(downs) == 1
+
+
+def test_action_event_carries_rule_and_exemplars(gcs):
+    ctl = SloController(gcs)
+    ctl.reconcile(now=time.time(), alerts=[_firing(exemplars=("t-1", "t-2"))])
+
+    events = gcs.rpc_list_cluster_events(None, {"type": "CONTROLLER_ACTION"})
+    assert events, "controller action must be recorded as a cluster event"
+    ev = events[-1]
+    assert ev["rule"] == "scale-up-on-slo"
+    assert ev["action"] == "scale_up"
+    assert ev["target"] == "echo"
+    assert ev["outcome"] == "applied"
+    assert ev["exemplars"] == ["t-1", "t-2"]
+    assert "reason" in ev and "serve-echo-p99" in ev["reason"]
+
+
+def test_degraded_node_drained_once(gcs):
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.rpc import RpcServer
+
+    # a real raylet-shaped endpoint so the drain orchestration completes
+    srv = RpcServer("fake-raylet")
+
+    def rpc_drain(conn, payload):
+        return {"migrated": {}}
+
+    def rpc_shutdown(conn, payload=None):
+        return True
+
+    srv.register("drain", rpc_drain)
+    srv.register("shutdown", rpc_shutdown)
+    node_id = NodeID.from_random()
+    from ray_tpu._private.rpc import RpcClient
+
+    client = RpcClient(gcs.address)
+    client.call(
+        "register_node",
+        (node_id, srv.address, {"CPU": 1.0}, {"node_name": "n0"}),
+    )
+    with gcs._lock:
+        info = gcs._nodes[node_id]
+        info.state = "DEGRADED"
+        info.probes = {"healthy": False, "detail": "store wedged"}
+
+    ctl = SloController(gcs)
+    t0 = time.time()
+    acts = ctl.reconcile(now=t0, alerts=[])
+    drains = [a for a in acts if a["action"] == "drain_node"]
+    assert len(drains) == 1
+    assert drains[0]["target"] == node_id.hex()
+    assert "store wedged" in drains[0]["reason"]
+
+    # second pass inside the cooldown: no repeat drain
+    with gcs._lock:
+        if node_id in gcs._nodes:
+            gcs._nodes[node_id].state = "DEGRADED"
+            gcs._nodes[node_id].alive = True
+    acts = ctl.reconcile(now=t0 + 5.0, alerts=[])
+    assert not [a for a in acts if a["action"] == "drain_node"]
+    client.close()
+    srv.stop()
+
+
+def test_straggler_reroute_then_drain_streak(gcs):
+    """Straggler attribution: reroute fires immediately; drain_node only
+    after the node stays flagged for `streak` consecutive passes."""
+    node_hex = "ab" * 16
+    now0 = time.time()
+
+    def spans():
+        # 5 same-name siblings, one 10x slower, attributed to node_hex
+        out = []
+        t = time.time() - 1.0
+        for i in range(5):
+            dur = 1.0 if i == 0 else 0.1
+            out.append({
+                "trace_id": "t-strag", "span_id": f"s{i}",
+                "parent_span_id": "root", "name": "allreduce",
+                "kind": "collective", "start_ts": t, "dur_s": dur,
+                "status": "ok",
+                "attrs": {"node_id": node_hex if i == 0 else ("cd" * 16)},
+            })
+        out.append({
+            "trace_id": "t-strag", "span_id": "root",
+            "parent_span_id": None, "name": "step", "kind": "train",
+            "start_ts": t, "dur_s": 1.1, "status": "ok", "attrs": {},
+        })
+        return out
+
+    ctl = SloController(gcs)
+    ctl.span_source = spans
+
+    acts = ctl.reconcile(now=now0, alerts=[])
+    assert [a["action"] for a in acts] == ["reroute"]
+    assert acts[0]["target"] == node_hex
+    assert acts[0]["exemplars"] == ["t-strag"]
+
+    # avoid set published for the serve controller to consume
+    raw = gcs.rpc_kv_get(None, ("controller", "avoid_nodes"))
+    assert node_hex in json.loads(raw)["nodes"]
+
+    # streak reached on the second flagged pass -> drain
+    acts = ctl.reconcile(now=now0 + 21.0, alerts=[])
+    assert "drain_node" in [a["action"] for a in acts]
+
+
+def test_scale_down_releases_floor(gcs):
+    ctl = SloController(gcs)
+    t0 = time.time()
+    ctl.reconcile(now=t0, alerts=[_firing()])
+    assert _floor(gcs) == 2
+
+    # continuously ok: the hysteresis clock starts at the first OK pass,
+    # then one step down per cooldown until the floor drops to zero, at
+    # which point the directive is deleted entirely
+    t = t0 + 31.0
+    ctl.reconcile(now=t, alerts=[_ok()])  # starts ok_since
+    assert _floor(gcs) == 2
+    ctl.reconcile(now=t + 61.0, alerts=[_ok()])
+    assert _floor(gcs) == 1
+    ctl.reconcile(now=t + 122.0, alerts=[_ok()])
+    assert _floor(gcs) is None
+
+
+def test_controller_rpcs_and_audit_metric(gcs):
+    st = gcs.rpc_controller_status(None)
+    assert st["enabled"] is False  # disabled by default
+    gcs.rpc_controller_enable(None)
+    try:
+        assert gcs.rpc_controller_status(None)["enabled"] is True
+        rules = gcs.rpc_controller_rules(None)
+        assert {r["name"] for r in rules} == {r["name"] for r in DEFAULT_RULES}
+    finally:
+        gcs.rpc_controller_disable(None)
+    assert gcs.rpc_controller_status(None)["enabled"] is False
+
+    # actions audit into the bounded counter and the hosted controller's
+    # own in-memory log (the durable trail is the cluster-event ring,
+    # covered above)
+    before = _counter_total("ray_tpu_controller_actions_total")
+    gcs._controller.reconcile(now=time.time(), alerts=[_firing()])
+    assert _counter_total("ray_tpu_controller_actions_total") > before
+    assert gcs.rpc_controller_log(None, {"limit": 10})
+
+
+def _counter_total(name):
+    from ray_tpu._private import internal_metrics
+
+    m = internal_metrics.get(name)
+    with m._lock:
+        return sum(m._series.values())

@@ -200,17 +200,31 @@ def test_drain_retires_node_with_zero_reconstructions():
             _metric_total("ray_tpu_lineage_reconstructions_total") == recon0
         ), "a graceful drain must not trigger lineage reconstruction"
         assert _metric_total("ray_tpu_tasks_failed_total") == failed0
-        assert (
-            _metric_total(
+        # the orchestration thread stamps its counters and the NODE_DRAINED
+        # event just after the node deregisters — condition-poll instead of
+        # asserting on the deregistration edge (the prior fixed-order
+        # asserts flaked under parallel file load)
+        _await(
+            lambda: _metric_total(
                 "ray_tpu_node_drains_total", tag='outcome="completed"'
             )
-            >= 1
+            >= 1,
+            15,
+            "drain completion counter",
         )
-        assert _metric_total("ray_tpu_drain_migrated_objects_total") >= 6
-
-        types = {e["type"] for e in list_cluster_events(limit=200)}
-        assert "NODE_DRAINING" in types
-        assert "NODE_DRAINED" in types
+        _await(
+            lambda: _metric_total("ray_tpu_drain_migrated_objects_total") >= 6,
+            15,
+            "migrated-objects counter to reach 6",
+        )
+        _await(
+            lambda: {
+                e["type"] for e in list_cluster_events(limit=200)
+            }
+            >= {"NODE_DRAINING", "NODE_DRAINED"},
+            15,
+            "NODE_DRAINING + NODE_DRAINED cluster events",
+        )
     finally:
         _teardown_cluster(cluster, saved)
 

@@ -1,0 +1,256 @@
+"""Scale simulation: O(100) virtual nodes in one process, driven through
+the real GCS/RPC/SLO/controller planes.
+
+Fast tests cover boot, failure detection, healing, and chaos-schedule
+integration on small clusters. The slow soak is the acceptance scenario:
+100 virtual nodes, a million mixed requests (serve + training + RL
+rollouts) with a chaos schedule firing mid-run, zero stuck requests,
+serve p99 inside the SLO budget outside bounded post-fault recovery
+windows, and a fully auditable controller action trail.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu._private.sim import SimCluster
+
+
+def _await(pred, timeout, what, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# fast tier-1 tests
+# ---------------------------------------------------------------------------
+
+
+def test_sim_boot_registers_real_nodes():
+    with SimCluster(num_nodes=8, seed=0) as sim:
+        assert len(sim.nodes) == 8
+        assert sim.boot_s < 10.0
+        # every virtual node registered through the real RPC plane
+        views = sim._gcs_call("get_nodes", None)
+        assert len(views) == 8
+        assert all(v["state"] == "ALIVE" for v in views)
+        names = {v["labels"]["node_name"] for v in views}
+        assert len(names) == 8
+        # heartbeats keep flowing: nobody dies within a health window
+        time.sleep(2.0)
+        assert sim.nodes_by_state() == {"ALIVE": 8}
+    # context exit restores process-global config (trace plane off again)
+    from ray_tpu._private import trace as _tr
+
+    assert not _tr._active
+
+
+def test_sim_kill_detected_and_deployment_heals():
+    with SimCluster(num_nodes=6, seed=0) as sim:
+        dep = sim.deploy("echo", num_replicas=3)
+        victim = dep.replicas[0]
+        victim.stop(unregister=False)  # abrupt stop == SIGKILL
+        _await(
+            lambda: sim.nodes_by_state().get("DEAD", 0) == 1,
+            timeout=10,
+            what="health loop to detect the kill",
+        )
+        # the deployment reconciler replaces the dead replica
+        _await(
+            lambda: victim not in dep.replicas and len(dep.replicas) == 3,
+            timeout=10,
+            what="deployment to heal onto a live node",
+        )
+        # traffic keeps flowing after the heal
+        for i in range(50):
+            dep.submit(i)
+        assert dep.completed >= 50
+        ev = sim.events(type="NODE_DIED")
+        assert len(ev) == 1
+
+
+def test_sim_chaos_schedule_kills_named_node():
+    with SimCluster(num_nodes=6, seed=3) as sim:
+        target = sim.nodes[4]
+        sim.chaos_apply({
+            "version": 1,
+            "seed": 7,
+            "rules": [{"action": "kill_raylet", "node": target.name}],
+        })
+        _await(
+            lambda: not target.alive,
+            timeout=10,
+            what="chaos schedule to kill the targeted node",
+        )
+        _await(
+            lambda: sim.nodes_by_state().get("DEAD", 0) == 1,
+            timeout=10,
+            what="GCS to declare the killed node DEAD",
+        )
+
+
+def test_sim_slo_alert_drives_controller_scale_up():
+    with SimCluster(num_nodes=6, seed=0) as sim:
+        # tiny capacity so modest load saturates -> p99 blows the budget
+        dep = sim.deploy("hot", num_replicas=1, capacity_rps=30.0,
+                         slo_p99_s=0.1)
+        dep.define_slo()
+
+        def drive_until_scaled():
+            for i in range(80):
+                dep.submit(i)
+            acts = sim.controller_actions()
+            return [a for a in acts if a.get("action") == "scale_up"] or None
+
+        ups = _await(drive_until_scaled, timeout=25,
+                     what="controller scale-up", interval=0.2)
+        ev = ups[0]
+        # the audit trail carries the full why
+        assert ev["rule"] == "scale-up-on-slo"
+        assert ev["outcome"] == "applied"
+        assert ev["reason"]
+        assert ev["exemplars"], "firing alert exemplars must ride the action"
+        # the deployment reconciler picks the floor up
+        _await(lambda: len(dep.replicas) > 1, timeout=10,
+               what="replica floor to take effect")
+
+
+# ---------------------------------------------------------------------------
+# the acceptance soak
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # ~2-4 min: 100 nodes, >= 1M mixed requests, chaos on
+def test_scale_sim_million_request_mixed_soak():
+    from ray_tpu._private import trace as _trace
+    from ray_tpu.serve import loadgen
+
+    SLO_P99_S = 0.25
+    RECOVERY_WINDOW_S = 20.0
+
+    with SimCluster(num_nodes=100, seed=42) as sim:
+        assert sim.nodes_by_state() == {"ALIVE": 100}
+        dep = sim.deploy("soak", num_replicas=8, base_latency_s=0.02,
+                         capacity_rps=800.0, slo_p99_s=SLO_P99_S)
+        dep.define_slo()
+
+        # chaos throughout: two node kills + a low-probability RPC delay
+        sim.chaos_apply({
+            "version": 1,
+            "seed": 1337,
+            "rules": [
+                {"action": "kill_raylet", "node": sim.nodes[30].name},
+                {"action": "kill_raylet", "node": sim.nodes[60].name},
+                {"action": "delay", "method": "serve_request",
+                 "probability": 0.01, "delay_ms": 40},
+            ],
+        })
+
+        p99_samples = []  # (t, p99)
+        audited = {}      # (ts, rule, target) -> exemplars all resolvable?
+
+        def poll_observability():
+            p99 = sim.serve_p99_s("soak")
+            if p99 > 0:
+                p99_samples.append((time.time(), p99))
+            # audit controller actions NOW, while their exemplar spans
+            # are still in the trace ring
+            ring = None
+            for ev in sim.controller_actions():
+                key = (ev["ts"], ev["rule"], str(ev["target"]))
+                if key in audited:
+                    continue
+                assert ev.get("rule") and ev.get("action")
+                assert ev.get("outcome") in ("applied", "failed", "skipped")
+                assert "reason" in ev
+                ok = True
+                for tid in ev.get("exemplars", ()):
+                    if ring is None:
+                        ring = {s["trace_id"]
+                                for s in _trace.snapshot().get("spans", [])}
+                    ok = ok and tid in ring
+                audited[key] = ok
+
+        # phase 1: serve traffic through the PR-9 load generator
+        # (schedule-driven open loop; its own stuck-request accounting)
+        gen = loadgen.open_loop(
+            lambda i: dep.submit(i), rate_rps=4000.0, duration_s=15.0,
+            seed=42, pool_size=32, join_timeout_s=60.0,
+        )
+        assert gen["stuck"] == 0, "open-loop requests must never wedge"
+        assert gen["sent"] >= 50_000
+        poll_observability()
+
+        # phase 2: mixed load until the combined total crosses 1M —
+        # paced serve bursts (kept under the modeled replica capacity, as
+        # a real client would be — saturating the M/M/1 curve just parks
+        # p99 at the saturation value) + synchronous training steps
+        # (straggler fan-out traces) + async RL rollout batches
+        i = 0
+        while True:
+            t = sim.totals()
+            total = t["serve"] + t["train"] + t["rollout"]
+            if total >= 1_000_000:
+                break
+            burst_t0 = time.monotonic()
+            for _ in range(300):
+                try:
+                    dep.submit(i)
+                except Exception:
+                    pass  # chaos drop: counted as an error, not stuck
+                i += 1
+            sim.train_step(base_s=0.03)
+            sim.rollout_batch(batch=12_000)
+            poll_observability()
+            # ~3000 serve rps against >= 6400 rps of modeled capacity
+            sleep = 0.1 - (time.monotonic() - burst_t0)
+            if sleep > 0:
+                time.sleep(sleep)
+
+        # let the planes fold the tail and the controller settle
+        deadline = time.time() + 8.0
+        while time.time() < deadline:
+            poll_observability()
+            time.sleep(0.5)
+
+        totals = sim.totals()
+        grand = totals["serve"] + totals["train"] + totals["rollout"]
+        assert grand >= 1_000_000, totals
+
+        # zero stuck requests: every submitted request resolved (completed
+        # or counted as an error by the chaos hooks) — nothing in flight
+        assert totals["serve"] >= dep.completed
+        assert dep.completed + dep.errors >= totals["serve"]
+
+        # the chaos kills landed and were detected by the health plane
+        died = sim.events(type="NODE_DIED")
+        assert len(died) >= 2, "both chaos kills must be detected"
+
+        # p99 within the SLO budget outside bounded post-fault recovery
+        # windows (fault edges: node deaths and drains)
+        fault_ts = [e["ts"] for e in died]
+        fault_ts += [e["ts"] for e in sim.events(type="NODE_DRAINING")]
+        ok_samples = [
+            (t, v) for t, v in p99_samples
+            if all(not (ft <= t <= ft + RECOVERY_WINDOW_S)
+                   for ft in fault_ts)
+        ]
+        assert ok_samples, "soak must produce p99 samples outside recovery"
+        violations = [(t, v) for t, v in ok_samples if v > SLO_P99_S]
+        assert not violations, (
+            f"{len(violations)}/{len(ok_samples)} p99 samples over the "
+            f"{SLO_P99_S}s budget outside recovery windows: "
+            f"{violations[:5]}"
+        )
+
+        # every controller action auditable: cluster event with rule +
+        # reason + outcome, and its trace exemplars resolved against the
+        # live trace ring at audit time
+        assert audited, "the soak must produce controller actions"
+        unresolved = [k for k, ok in audited.items() if not ok]
+        assert not unresolved, f"exemplars did not resolve for: {unresolved}"
