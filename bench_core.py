@@ -483,6 +483,35 @@ def main():
     except Exception as e:  # noqa: BLE001
         print(json.dumps({"metric": "serve_plane",
                           "error": str(e)[-400:]}), flush=True)
+
+    # --- LLM engine: paged-KV continuous batching on the real gpt_nano
+    # forward (serve.llm). No REFERENCE entry; warn-only floors live in
+    # scripts/bench_smoke.py. Same parameters as scripts/llm_smoke.py.
+    try:
+        lm = _loadgen.measure_llm(
+            concurrency=8, prompt_len=48, shared_prefix_len=32,
+            max_new_tokens=16, unbatched_requests=4, seed=20260808)
+        results["llm_tokens_per_s"] = lm["batched_tokens_per_s"]
+        results["llm_speedup_x"] = lm["speedup_x"]
+        print(json.dumps({"metric": "llm_tokens_per_s",
+                          "value": round(lm["batched_tokens_per_s"], 1),
+                          "unit": "tokens/s", "vs_baseline": None,
+                          "speedup_x": round(lm["speedup_x"], 2)}),
+              flush=True)
+        results["llm_ttft_p99_ms"] = lm["ttft_p99_s"] * 1e3
+        print(json.dumps({"metric": "llm_ttft_p99_ms",
+                          "value": round(lm["ttft_p99_s"] * 1e3, 1),
+                          "unit": "ms", "vs_baseline": None,
+                          "p50_ms": round(lm["ttft_p50_s"] * 1e3, 1)}),
+              flush=True)
+        results["llm_prefix_hit_rate"] = lm["prefix_hit_rate"]
+        print(json.dumps({"metric": "llm_prefix_hit_rate",
+                          "value": round(lm["prefix_hit_rate"], 3),
+                          "unit": "ratio", "vs_baseline": None,
+                          "hits": lm["prefix_hits"]}), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"metric": "llm_plane",
+                          "error": str(e)[-400:]}), flush=True)
     finally:
         try:
             _serve.shutdown()
@@ -576,7 +605,7 @@ def main():
 
     # archive as a round artifact (reference archives its microbenchmark
     # results under release/release_logs/<version>/microbenchmark.json)
-    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r10.json")
+    artifact = os.environ.get("BENCH_CORE_ARTIFACT", "BENCH_CORE_r11.json")
     payload = {
         "results": {
             k: round(v, 4) if isinstance(v, (int, float)) else v

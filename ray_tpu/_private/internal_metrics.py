@@ -143,6 +143,22 @@ CATALOG: Dict[str, Tuple[str, str, Tuple[str, ...]]] = {
         "counter",
         "replicas drained on scale-down (outcome=graceful|forced)",
         ("outcome",)),
+    # -- llm serving --------------------------------------------------
+    "ray_tpu_llm_kv_blocks_in_use": (
+        "gauge",
+        "paged KV-cache blocks currently referenced (active sequences + "
+        "prefix cache)",
+        ("deployment",)),
+    "ray_tpu_llm_prefix_cache_hits_total": (
+        "counter",
+        "prompt blocks served from the prefix cache (prefill FLOPs skipped)",
+        ("deployment",)),
+    "ray_tpu_llm_prefill_tokens_total": (
+        "counter", "prompt tokens run through bucketed prefill",
+        ("deployment",)),
+    "ray_tpu_llm_ttft_seconds": (
+        "histogram", "time from enqueue to a request's first sampled token",
+        ("deployment",)),
     # -- rpc ----------------------------------------------------------
     "ray_tpu_rpc_pump_failures": (
         "counter", "native poller pump-thread crashes (streams torn down)", ()),

@@ -392,6 +392,141 @@ class LMHead(nn.Module):
 
 
 # ---------------------------------------------------------------------------
+# KV-cache decode path (serve/llm.py)
+# ---------------------------------------------------------------------------
+#
+# The training modules above never materialize a KV cache — they recompute
+# attention over the whole sequence every call, which is the right shape
+# for teacher forcing and the wrong shape for serving. The inference
+# engine instead runs `make_extend_fn(cfg)`: one jitted "extend" step that
+# appends `tc` new tokens per lane to a per-lane cache of `lengths` tokens
+# and attends the new queries over the full (padded) cache. Prefill is an
+# extend with tc = prompt-chunk length; decode is an extend with tc = 1 —
+# the same compiled family, bucketed on (batch, tc, cache capacity) so XLA
+# only ever sees the configured shapes.
+
+
+def unboxed_params(variables):
+    """The raw ``params`` subtree with flax partitioning metadata stripped
+    — the form :func:`make_extend_fn` consumes."""
+    tree = variables["params"] if "params" in variables else variables
+    return nn.meta.unbox(tree)
+
+
+def stacked_layer_params(params, cfg: GPTConfig):
+    """The [num_layers, ...]-stacked per-layer param subtree. scan_layers
+    configs already store it stacked; per-layer trees are stacked here."""
+    blocks = params["blocks"]
+    if "layers" in blocks:
+        return blocks["layers"]
+    per = [blocks[f"layer_{i}"] for i in range(cfg.num_layers)]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per)
+
+
+def init_kv_cache(cfg: GPTConfig, batch: int, capacity: int):
+    """Zeroed K/V cache tensors [layers, batch, capacity, heads, head_dim]."""
+    shape = (cfg.num_layers, batch, capacity, cfg.num_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def make_extend_fn(cfg: GPTConfig):
+    """A jitted ``extend(params, tokens, lengths, k_cache, v_cache)``.
+
+    ``tokens`` [b, tc] are the next tokens of each lane whose cache already
+    holds ``lengths`` [b] tokens; their K/V are written at absolute
+    positions ``lengths + arange(tc)`` and the new queries attend over the
+    updated cache under the mask ``key_pos <= query_pos`` (which also
+    hides never-written padding — anything past a lane's frontier is
+    acausal by construction). Returns ``(logits, hidden, k_new, v_new)``:
+    f32 logits and final-hidden for every fed position (the engine gathers
+    each lane's last *valid* one; hidden feeds LoRA deltas), plus the new
+    K/V chunks [layers, b, tc, heads, head_dim] for the caller to page
+    back into its block pool. Deterministic given identical shapes, which
+    is what makes cached-prefix decode bitwise-equal to uncached decode.
+    """
+    if cfg.moe_num_experts:
+        raise NotImplementedError("KV-cache decode does not support MoE MLPs")
+    dtype = cfg.dtype
+    scale = 1.0 / float(np.sqrt(cfg.head_dim))
+
+    def _ln(x, p):
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = (xf * xf).mean(-1, keepdims=True) - mean * mean
+        y = (xf - mean) * jax.lax.rsqrt(var + 1e-6)
+        y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+        return y.astype(dtype)
+
+    def _mlp(x, p):
+        y = jnp.einsum("btd,df->btf", x, p["wi"]["kernel"].astype(dtype))
+        y = nn.gelu(y + p["wi"]["bias"].astype(dtype))
+        y = jnp.einsum("btf,fd->btd", y, p["wo"]["kernel"].astype(dtype))
+        return y + p["wo"]["bias"].astype(dtype)
+
+    def _attend(p, hidden, positions, kc, vc):
+        q = jnp.einsum("btd,dhk->bthk", hidden, p["q"]["kernel"].astype(dtype))
+        k = jnp.einsum("btd,dhk->bthk", hidden, p["k"]["kernel"].astype(dtype))
+        v = jnp.einsum("btd,dhk->bthk", hidden, p["v"]["kernel"].astype(dtype))
+        q = _rotary(q, positions, cfg.rotary_dim)
+        k = _rotary(k, positions, cfg.rotary_dim)
+        b = positions.shape[0]
+        lane = jnp.arange(b)[:, None]
+        # out-of-capacity writes drop instead of clamping onto slot T-1
+        kc = kc.at[lane, positions].set(k, mode="drop")
+        vc = vc.at[lane, positions].set(v, mode="drop")
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kc, preferred_element_type=jnp.float32
+        ) * scale
+        kpos = jnp.arange(kc.shape[1], dtype=jnp.int32)
+        mask = (kpos[None, None, :] <= positions[:, :, None])[:, None, :, :]
+        scores = jnp.where(mask, scores, jnp.float32(-1e30))
+        w = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w, vc)
+        out = jnp.einsum("bqhd,hde->bqe", out, p["o"]["kernel"].astype(dtype))
+        return out, k, v
+
+    def _block(x, p, positions, kc, vc):
+        if cfg.parallel_residual:
+            hidden = _ln(x, p["ln"])
+            a, k, v = _attend(p["attn"], hidden, positions, kc, vc)
+            return x + a + _mlp(hidden, p["mlp"]), k, v
+        hidden = _ln(x, p["ln1"])
+        a, k, v = _attend(p["attn"], hidden, positions, kc, vc)
+        x = x + a
+        return x + _mlp(_ln(x, p["ln2"]), p["mlp"]), k, v
+
+    @jax.jit
+    def extend(params, tokens, lengths, k_cache, v_cache):
+        tc = tokens.shape[1]
+        positions = (
+            lengths[:, None].astype(jnp.int32)
+            + jnp.arange(tc, dtype=jnp.int32)[None, :]
+        )
+        emb = params["wte"]["embedding"].astype(dtype)
+        x = emb[jnp.clip(tokens, 0, cfg.vocab_size - 1)]
+        layers = stacked_layer_params(params, cfg)
+
+        def body(carry, xs):
+            p, kc, vc = xs
+            y, k, v = _block(carry, p, positions, kc, vc)
+            return y, (k, v)
+
+        x, (k_new, v_new) = jax.lax.scan(body, x, (layers, k_cache, v_cache))
+        x = _ln(x, params["ln_f"])
+        if cfg.tie_embeddings:
+            kernel, bias = emb.T, None
+        else:
+            kernel = params["lm_head"]["kernel"].astype(dtype)
+            bias = params["lm_head"]["bias"]
+        logits = (x @ kernel).astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        return logits, x.astype(jnp.float32), k_new, v_new
+
+    return extend
+
+
+# ---------------------------------------------------------------------------
 # loss / flops helpers
 # ---------------------------------------------------------------------------
 

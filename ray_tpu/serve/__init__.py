@@ -56,6 +56,7 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "list_models",
+    "llm",
     "multiplexed",
     "register_model",
     "run",
@@ -88,7 +89,7 @@ class Deployment:
         unknown = set(cfg) - {
             "num_replicas", "user_config", "autoscaling", "resources",
             "max_concurrent_queries", "max_queued_requests", "drain_grace_s",
-            "slo_p99_s", "slo_availability",
+            "slo_p99_s", "slo_availability", "slo_ttft_p99_s",
         }
         if unknown:
             raise TypeError(f"unknown deployment options: {sorted(unknown)}")
@@ -137,6 +138,7 @@ def deployment(
     drain_grace_s: float = 30.0,
     slo_p99_s: Optional[float] = None,
     slo_availability: Optional[float] = None,
+    slo_ttft_p99_s: Optional[float] = None,
 ):
     """``@serve.deployment`` decorator (reference: serve/api.py deployment).
 
@@ -152,7 +154,10 @@ def deployment(
     per-deployment SLO rule targets (``ray_tpu.slo``); the cluster-wide
     defaults come from ``serve_slo_default_p99_s`` /
     ``serve_slo_default_availability`` (``serve_default_slos=False``
-    disables the automatic rules entirely)."""
+    disables the automatic rules entirely). ``slo_ttft_p99_s`` — for LLM
+    deployments (``serve.llm``) — additionally auto-registers a
+    ``serve-<name>-ttft-p99`` rule over the time-to-first-token
+    histogram."""
 
     def deco(target):
         return Deployment(
@@ -168,6 +173,7 @@ def deployment(
                 "drain_grace_s": drain_grace_s,
                 "slo_p99_s": slo_p99_s,
                 "slo_availability": slo_availability,
+                "slo_ttft_p99_s": slo_ttft_p99_s,
             },
         )
 
@@ -410,3 +416,15 @@ from ray_tpu.serve.dag import (  # noqa: E402
     build as build_graph,
     run_graph,
 )
+
+
+def __getattr__(name: str):
+    # ``serve.llm`` loads lazily: it pulls in jax, which most serve users
+    # (and the serve test matrix) never need at import time
+    if name == "llm":
+        import importlib
+
+        mod = importlib.import_module("ray_tpu.serve.llm")
+        globals()["llm"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

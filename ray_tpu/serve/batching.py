@@ -157,9 +157,18 @@ class _Sequence:
     (e.g. the decode cursor / generated tokens) and calls ``finish()``
     when the sequence is done — the slot frees for a queued request at
     the next step boundary.
+
+    ``enqueued_at`` (monotonic) is stamped at submission so step fns can
+    report queue wait / time-to-first-token. ``on_release`` is an optional
+    zero-arg hook the scheduler invokes exactly once when the sequence
+    leaves the batcher for ANY reason — finish, fail, step poison,
+    cancellation, shutdown — the anchor for resources the step fn leased
+    per sequence (KV-cache blocks) that must never leak on an abandoned
+    request.
     """
 
-    __slots__ = ("item", "state", "_result", "_error", "_done", "_event")
+    __slots__ = ("item", "state", "_result", "_error", "_done", "_event",
+                 "enqueued_at", "cancelled", "on_release", "_released")
 
     def __init__(self, item):
         self.item = item
@@ -168,6 +177,10 @@ class _Sequence:
         self._error: Optional[BaseException] = None
         self._done = False
         self._event = threading.Event()
+        self.enqueued_at = time.monotonic()
+        self.cancelled = False
+        self.on_release: Optional[Callable[[], None]] = None
+        self._released = False
 
     def finish(self, result) -> None:
         self._result = result
@@ -181,12 +194,40 @@ class _Sequence:
     def done(self) -> bool:
         return self._done
 
+    def _release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        cb = self.on_release
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — release hooks must not poison
+                pass
+
+
+def _caller_cancelled() -> bool:
+    """True when the task running the current thread was cooperatively
+    cancelled (``ray_tpu.cancel(force=False)`` — the async proxy's
+    client-EOF path). Blocked batcher callers poll this: a plain
+    ``Event.wait()`` would strand the replica thread (and any per-sequence
+    leases) forever, since a cooperative cancel only sets a flag."""
+    try:
+        from ray_tpu import api as _api
+
+        return _api.get_runtime_context().was_cancelled()
+    except Exception:  # noqa: BLE001 — outside a task / before init
+        return False
+
 
 class _ContinuousBatcher:
     """Iteration-level scheduler: admits queued requests into the active
     set between calls of the step fn (decode-style continuous batching)."""
 
     mode = "continuous"
+
+    #: how often a blocked caller re-checks for cooperative cancellation
+    poll_interval_s = 0.02
 
     def __init__(self, step_fn, max_batch_size, batch_wait_timeout_s,
                  bucket_sizes, name="fn"):
@@ -210,7 +251,22 @@ class _ContinuousBatcher:
                 raise RuntimeError(f"batcher for {self.name!r} is shut down")
             self.queue.append(seq)
             self.cv.notify_all()
-        seq._event.wait()
+        try:
+            while not seq._event.wait(self.poll_interval_s):
+                if not seq.cancelled and _caller_cancelled():
+                    from ray_tpu._private.core_worker import (
+                        TaskCancelledError,
+                    )
+
+                    raise TaskCancelledError(self.name)
+        except BaseException:
+            # the caller is abandoning the sequence — cooperative cancel
+            # noticed above, or a force-cancel injected into this thread:
+            # flag it so the scheduler drops it and runs its release hook
+            seq.cancelled = True
+            with self.cv:
+                self.cv.notify_all()
+            raise
         if seq._error is not None:
             raise seq._error
         return seq._result
@@ -224,6 +280,7 @@ class _ContinuousBatcher:
             self.cv.notify_all()
         for s in orphans:
             s._error = RuntimeError(f"batcher for {self.name!r} shut down")
+            s._release()
             s._event.set()
 
     def _loop(self):
@@ -245,9 +302,26 @@ class _ContinuousBatcher:
                     ):
                         self.cv.wait(self.timeout / 4)
                 # iteration-level admission: every free slot fills from
-                # the queue at each step boundary
+                # the queue at each step boundary (cancelled-while-queued
+                # sequences release without ever entering a step)
                 while self.queue and len(active) < self.max_batch_size:
-                    active.append(self.queue.pop(0))
+                    s = self.queue.pop(0)
+                    if s.cancelled:
+                        s._release()
+                        s._event.set()
+                        continue
+                    active.append(s)
+            # cancelled mid-flight (client EOF / force-cancel): drop before
+            # the step so the release hook (KV blocks etc.) fires now and
+            # exactly once
+            live: List[_Sequence] = []
+            for s in active:
+                if s.cancelled:
+                    s._release()
+                    s._event.set()
+                else:
+                    live.append(s)
+            active = live
             if not active:
                 continue
             step = list(active)
@@ -258,6 +332,7 @@ class _ContinuousBatcher:
                 # no per-sequence result to salvage after a crashed forward
                 for s in step:
                     s._error = e
+                    s._release()
                     s._event.set()
                 active = []
                 continue
@@ -265,6 +340,7 @@ class _ContinuousBatcher:
             active = []
             for s in step:
                 if s._done:
+                    s._release()
                     s._event.set()
                 else:
                     active.append(s)

@@ -134,6 +134,22 @@ class ServeController:
                     "description": f"availability SLO for deployment {name}",
                 },
             ]
+            # LLM deployments (serve.llm) opt in to a time-to-first-token
+            # rule: e2e p99 hides a stalled prefill behind fast decodes
+            ttft = spec.get("slo_ttft_p99_s")
+            if ttft:
+                rules.append({
+                    "name": f"serve-{name}-ttft-p99",
+                    "expr": "histogram_quantile(0.99, "
+                            f"ray_tpu_llm_ttft_seconds{sel})",
+                    "target": float(ttft),
+                    "windows": [30.0],
+                    "for_s": 0.0,
+                    "description": (
+                        f"p99 time-to-first-token SLO for LLM deployment "
+                        f"{name}"
+                    ),
+                })
             import ray_tpu._private.worker as worker_mod
 
             worker_mod.global_worker.core.gcs.call(

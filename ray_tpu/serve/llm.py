@@ -1,0 +1,745 @@
+"""LLM inference engine on the serve plane: paged KV-cache, prefill/decode
+split, prefix caching, and LoRA-scale multiplexing over the real
+``ray_tpu.models.gpt`` forward pass.
+
+What PR 9 proved with synthetic step functions (continuous batching,
+admission control, multiplexing) this module composes on an actual model
+(reference: serve/llm + vLLM's paged attention, and the Gemma-on-TPU
+serving setup from PAPERS.md):
+
+* :class:`KVBlockPool` — the KV cache is paged into fixed-size token
+  blocks in one host-side arena; sequences lease blocks on admission and
+  a :class:`KVLease` frees them **exactly once** on finish / cancel /
+  shed / step poison (the same accounting discipline the handle enforces
+  for concurrency slots). ``ray_tpu_llm_kv_blocks_in_use`` tracks the
+  pool; exhaustion sheds with :class:`~ray_tpu.serve.handle.
+  BackPressureError` *before* anything is written.
+* prefill/decode split — prefill runs as its own bucketed extend call
+  (prompt chunks padded via :func:`~ray_tpu.serve.batching.
+  bucket_pad_size`), decode as a tc=1 call; every engine iteration runs
+  at most one prefill chunk *and* one decode step, so a long prompt can
+  never stall in-flight decode lanes for more than one bounded chunk.
+* prefix caching — full prompt blocks are keyed by a rolling (chained)
+  hash; a new request reuses the longest cached chain copy-on-write
+  (shared blocks are refcounted and cloned before any write), skipping
+  their prefill FLOPs entirely. Reused KV is bitwise-identical to a
+  fresh prefill because the extend fn is deterministic per shape.
+* LoRA multiplexing — base weights load once per replica; per-model
+  low-rank logit deltas ``(A [d,r], B [r,vocab])`` are registered on the
+  object plane via :func:`ray_tpu.serve.register_model` and streamed to
+  replicas on miss through the PR 9 multiplex LRU, so thousands of model
+  ids share one resident base model.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import queue as queue_mod
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu._private import internal_metrics
+from ray_tpu.serve import batching
+from ray_tpu.serve.handle import BackPressureError
+from ray_tpu.serve.multiplex import _MultiplexWrapper
+
+__all__ = [
+    "KVBlockPool", "KVLease", "NoKVBlocksError", "PrefixCache",
+    "LLMEngine", "LLMServer", "make_params", "register_lora", "random_lora",
+]
+
+_STREAM_KEY = "_stream"
+_CANCEL_KEY = "_cancel"
+
+
+class NoKVBlocksError(RuntimeError):
+    """The pool cannot satisfy an allocation even after evicting every
+    idle prefix-cache block — the admission-control signal."""
+
+
+def make_params(cfg=None, seed: int = 0):
+    """Deterministically initialized, unboxed gpt params for ``cfg``
+    (default ``gpt_nano``) — every replica builds bitwise-identical base
+    weights from the same seed."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt
+
+    cfg = cfg or gpt.gpt_nano()
+    model = gpt.GPT(cfg)
+    variables = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )
+    return gpt.unboxed_params(variables)
+
+
+# ---------------------------------------------------------------------------
+# paged KV block pool + exactly-once lease
+# ---------------------------------------------------------------------------
+
+
+class KVBlockPool:
+    """Fixed-size token blocks of K/V storage in one refcounted host arena.
+
+    Layout: ``k_data``/``v_data`` are ``[num_blocks, layers, block_size,
+    heads, head_dim]``; a sequence owns an ordered list of block ids whose
+    concatenation is its cache. Blocks are refcounted so the prefix cache
+    can share full prompt blocks across sequences; a block returns to the
+    free list when its last reference drops."""
+
+    def __init__(self, cfg, *, num_blocks: int = 128, block_size: int = 16,
+                 deployment: str = "llm"):
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.deployment = deployment
+        try:
+            dt = np.dtype(np.float32 if cfg.dtype is None else cfg.dtype)
+        except TypeError:
+            import jax.numpy as jnp
+
+            dt = np.dtype(jnp.zeros((), cfg.dtype).dtype.name)
+        shape = (
+            self.num_blocks, cfg.num_layers, self.block_size,
+            cfg.num_heads, cfg.head_dim,
+        )
+        self.k_data = np.zeros(shape, dt)
+        self.v_data = np.zeros(shape, dt)
+        self._free: List[int] = list(range(self.num_blocks))
+        self._ref: Dict[int, int] = {}
+        self._lock = threading.RLock()
+        self._evict_cb: Optional[Callable[[int], None]] = None
+        self.freed_total = 0
+
+    def set_evict_cb(self, cb: Callable[[int], None]) -> None:
+        """Hook called (under the pool lock) with the shortfall when an
+        allocation would fail — the prefix cache drops idle entries here."""
+        self._evict_cb = cb
+
+    def allocate(self, n: int) -> List[int]:
+        with self._lock:
+            if len(self._free) < n and self._evict_cb is not None:
+                self._evict_cb(n - len(self._free))
+            if len(self._free) < n:
+                raise NoKVBlocksError(
+                    f"need {n} KV blocks, {len(self._free)} free "
+                    f"of {self.num_blocks}"
+                )
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+            self._gauge_locked()
+            return out
+
+    def incref(self, blocks: Sequence[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                self._ref[b] += 1
+
+    def free(self, blocks: Sequence[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                self._decref_locked(b)
+            self._gauge_locked()
+
+    def _decref_locked(self, b: int) -> None:
+        r = self._ref.get(b)
+        if r is None:
+            return
+        if r <= 1:
+            del self._ref[b]
+            self._free.append(b)
+            self.freed_total += 1
+        else:
+            self._ref[b] = r - 1
+
+    def refcount(self, b: int) -> int:
+        with self._lock:
+            return self._ref.get(b, 0)
+
+    def in_use(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def ensure_private(self, blocks: List[int], idx: int) -> int:
+        """Copy-on-write: make ``blocks[idx]`` safe to mutate. A block
+        shared with the prefix cache (or another sequence) is cloned into
+        a fresh block — in place in the caller's block list, which the
+        owning lease aliases — and the shared original is decrefed."""
+        with self._lock:
+            b = blocks[idx]
+            if self._ref.get(b, 0) <= 1:
+                return b
+            new = self.allocate(1)[0]
+            self.k_data[new] = self.k_data[b]
+            self.v_data[new] = self.v_data[b]
+            self._decref_locked(b)
+            blocks[idx] = new
+            self._gauge_locked()
+            return new
+
+    def _gauge_locked(self) -> None:
+        internal_metrics.set_gauge(
+            "ray_tpu_llm_kv_blocks_in_use",
+            self.num_blocks - len(self._free),
+            {"deployment": self.deployment},
+        )
+
+
+class KVLease:
+    """Exactly-once ownership of a sequence's KV blocks (the KV analogue
+    of ``DeploymentResponse._finish_once``): however many of finish, fail,
+    cancel-drop, step-poison and shutdown fire for one sequence, the
+    blocks are decrefed once."""
+
+    def __init__(self, pool: KVBlockPool):
+        self.pool = pool
+        self.blocks: List[int] = []
+        self._released = False
+        self._lock = threading.Lock()
+
+    def add(self, blocks: Sequence[int]) -> None:
+        with self._lock:
+            if self._released:
+                # late add after release (shouldn't happen): don't leak
+                self.pool.free(list(blocks))
+                return
+            self.blocks.extend(blocks)
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def release(self) -> None:
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+            blocks, self.blocks = list(self.blocks), []
+        self.pool.free(blocks)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: rolling hash over full prompt blocks, LRU under pressure
+# ---------------------------------------------------------------------------
+
+
+def chain_hashes(prompt: Sequence[int], block_size: int) -> List[bytes]:
+    """One hash per FULL prompt block, each chained on its predecessor —
+    block i's key commits to tokens [0, (i+1)*block_size), so two prompts
+    share exactly their common full-block prefix and a divergent token
+    anywhere invalidates every later block."""
+    h = b"ray_tpu-llm-prefix-v1"
+    out: List[bytes] = []
+    for i in range(len(prompt) // block_size):
+        blk = np.asarray(
+            prompt[i * block_size:(i + 1) * block_size], np.int64
+        ).tobytes()
+        h = hashlib.sha1(h + blk).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """hash -> block id, LRU-ordered. The cache holds its own reference on
+    every cached block; entries whose block is otherwise idle (refcount 1)
+    are evictable when the pool runs dry."""
+
+    def __init__(self, pool: KVBlockPool, deployment: str = "llm"):
+        self.pool = pool
+        self.deployment = deployment
+        self._map: "OrderedDict[bytes, int]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        pool.set_evict_cb(self._evict_for)
+
+    def match(self, hashes: Sequence[bytes]) -> List[int]:
+        """Block ids of the longest cached prefix chain, increfed for the
+        caller (release through the caller's lease)."""
+        with self.pool._lock:
+            out: List[int] = []
+            for h in hashes:
+                b = self._map.get(h)
+                if b is None:
+                    break
+                self._map.move_to_end(h)
+                out.append(b)
+            if out:
+                self.pool.incref(out)
+                self.hits += len(out)
+                internal_metrics.inc(
+                    "ray_tpu_llm_prefix_cache_hits_total", len(out),
+                    {"deployment": self.deployment},
+                )
+            if len(out) < len(hashes):
+                self.misses += len(hashes) - len(out)
+            return out
+
+    def insert(self, hashes: Sequence[bytes], blocks: Sequence[int]) -> None:
+        """Cache a freshly prefilled chain. First writer wins per hash;
+        the cache takes its own reference on each newly cached block."""
+        with self.pool._lock:
+            for h, b in zip(hashes, blocks):
+                if h in self._map:
+                    continue
+                if self.pool._ref.get(b, 0) <= 0:
+                    continue  # lease already released (cancelled mid-insert)
+                self._map[h] = b
+                self.pool.incref([b])
+
+    def _evict_for(self, shortfall: int) -> None:
+        # called under the pool lock by KVBlockPool.allocate
+        freed = 0
+        for h in list(self._map):
+            if freed >= shortfall:
+                break
+            b = self._map[h]
+            if self.pool._ref.get(b, 0) == 1:  # only the cache holds it
+                del self._map[h]
+                self.pool._decref_locked(b)
+                self.evictions += 1
+                freed += 1
+
+    def __len__(self) -> int:
+        with self.pool._lock:
+            return len(self._map)
+
+
+# ---------------------------------------------------------------------------
+# LoRA adapters: low-rank logit deltas over the pinned base model
+# ---------------------------------------------------------------------------
+
+
+def random_lora(cfg, rank: int = 4, seed: int = 0, scale: float = 1.0):
+    """A deterministic random adapter ``{"A","B","scale"}`` for tests and
+    benches — ``logits += scale * (hidden @ A) @ B``."""
+    rng = np.random.RandomState(seed)
+    return {
+        "A": rng.randn(cfg.embed_dim, rank).astype(np.float32) * 0.1,
+        "B": rng.randn(rank, cfg.vocab_size).astype(np.float32) * 0.1,
+        "scale": float(scale),
+    }
+
+
+def register_lora(model_id: str, adapter: Dict[str, Any], **kw):
+    """Publish a LoRA adapter on the object plane under ``model_id`` —
+    replicas stream it on first use through their multiplex LRU."""
+    from ray_tpu import serve
+
+    return serve.register_model(model_id, adapter, **kw)
+
+
+def _fetch_lora(model_id: str):
+    from ray_tpu import serve
+
+    a = serve.fetch_model(model_id)
+    return (
+        np.asarray(a["A"], np.float32),
+        np.asarray(a["B"], np.float32),
+        float(a.get("scale", 1.0)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class _SeqState:
+    __slots__ = (
+        "prompt", "max_new", "eos", "model_id", "adapter", "lease", "blocks",
+        "pos", "length", "out", "last_token", "cached_tokens", "hashes",
+        "ttft_s", "stream_q", "cancel_ev", "return_logits", "logits",
+    )
+
+
+class LLMEngine:
+    """The scheduler + paged-attention runtime behind ``LLMServer``.
+
+    ``step(seqs)`` is a continuous-batching step function: each call
+    admits new sequences (allocating their KV lease or shedding), runs at
+    most one bucketed prefill chunk and one tc=1 decode over every
+    decoding lane, and finishes/streams tokens. All shapes reaching the
+    jitted extend fn are drawn from the configured buckets."""
+
+    def __init__(self, cfg=None, params=None, *, deployment: str = "llm",
+                 num_blocks: int = 128, block_size: int = 16,
+                 prefill_chunk: int = 32, prefill_lanes: int = 4,
+                 lane_buckets: Sequence[int] = (1, 2, 4, 8, 16),
+                 prefill_token_buckets: Sequence[int] = (8, 16, 32),
+                 cache_buckets: Sequence[int] = (32, 64, 128),
+                 max_adapters: int = 4, adapter_loader=None,
+                 prefix_caching: bool = True, default_max_new_tokens: int = 16,
+                 step_delay_s: float = 0.0, seed: int = 0):
+        from ray_tpu.models import gpt
+
+        self.cfg = cfg or gpt.gpt_nano()
+        self._params = params if params is not None else make_params(
+            self.cfg, seed)
+        self._extend = gpt.make_extend_fn(self.cfg)
+        self.deployment = deployment
+        self.block_size = int(block_size)
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_lanes = int(prefill_lanes)
+        self.lane_buckets = sorted(lane_buckets)
+        self.prefill_token_buckets = sorted(prefill_token_buckets)
+        self.cache_buckets = sorted(cache_buckets)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_context = min(self.cfg.max_seq_len, self.cache_buckets[-1])
+        self.pool = KVBlockPool(
+            self.cfg, num_blocks=num_blocks, block_size=block_size,
+            deployment=deployment,
+        )
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(self.pool, deployment) if prefix_caching else None
+        )
+        loader = adapter_loader or _fetch_lora
+        self._mux = _MultiplexWrapper(loader, None, int(max_adapters))
+        self._np_dtype = self.pool.k_data.dtype
+        #: fault injection: stretch every engine step (chaos / cancellation
+        #: tests need the decode window to outlive a few control RPCs)
+        self.step_delay_s = float(step_delay_s)
+        self.steps = 0
+        self.decode_tokens = 0
+
+    # -- public stats ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "kv_blocks_total": self.pool.num_blocks,
+            "kv_blocks_in_use": self.pool.in_use(),
+            "kv_blocks_freed_total": self.pool.freed_total,
+            "prefix_hits": self.prefix.hits if self.prefix else 0,
+            "prefix_misses": self.prefix.misses if self.prefix else 0,
+            "prefix_evictions": self.prefix.evictions if self.prefix else 0,
+            "prefix_cached_blocks": len(self.prefix) if self.prefix else 0,
+            "adapters_resident": self._mux.loaded_ids(),
+            "steps": self.steps,
+            "decode_tokens": self.decode_tokens,
+        }
+
+    # -- scheduling --------------------------------------------------------
+
+    def step(self, seqs: List[Any]) -> None:
+        try:
+            if self.step_delay_s:
+                time.sleep(self.step_delay_s)
+            self._admit(seqs)
+            self._sweep_cancelled(seqs)
+            self._prefill_step(seqs)
+            self._decode_step(seqs)
+            self.steps += 1
+        except BaseException:
+            # a crashed forward poisons the batch (the batcher fails every
+            # caller) — the leases must not ride down with it
+            for s in seqs:
+                st = s.state
+                if isinstance(st, _SeqState) and st.lease is not None:
+                    st.lease.release()
+            raise
+
+    def _admit(self, seqs) -> None:
+        for s in seqs:
+            if s.state is not None or s.done:
+                continue
+            item = s.item if isinstance(s.item, dict) else {"prompt": s.item}
+            st = _SeqState()
+            st.prompt = [int(t) for t in item.get("prompt", [])]
+            st.max_new = int(
+                item.get("max_new_tokens", self.default_max_new_tokens))
+            st.eos = item.get("eos_token")
+            st.model_id = item.get("model_id")
+            st.adapter = None
+            st.stream_q = item.get(_STREAM_KEY)
+            st.cancel_ev = item.get(_CANCEL_KEY)
+            st.return_logits = bool(item.get("return_logits"))
+            st.logits = [] if st.return_logits else None
+            st.out = []
+            st.last_token = None
+            st.ttft_s = None
+            if not st.prompt or st.max_new < 1:
+                s.fail(ValueError("payload needs a non-empty 'prompt'"))
+                continue
+            total = len(st.prompt) + st.max_new
+            if total > self.max_context:
+                s.fail(ValueError(
+                    f"prompt+max_new_tokens = {total} exceeds the engine "
+                    f"context of {self.max_context}"
+                ))
+                continue
+            lease = KVLease(self.pool)
+            st.lease = lease
+            st.blocks = lease.blocks
+            s.on_release = lease.release
+            bs = self.block_size
+            st.hashes = (
+                chain_hashes(st.prompt, bs) if self.prefix is not None else []
+            )
+            # never reuse the whole prompt: the last prompt token must be
+            # fed through prefill to produce the first sampled token
+            reuse_cap = (len(st.prompt) - 1) // bs
+            cached = (
+                self.prefix.match(st.hashes[:reuse_cap])
+                if self.prefix is not None else []
+            )
+            lease.add(cached)
+            need = math.ceil(len(st.prompt) / bs) - len(cached)
+            try:
+                lease.add(self.pool.allocate(need))
+            except NoKVBlocksError as e:
+                lease.release()
+                s.fail(BackPressureError(str(e), retry_after_s=0.05))
+                continue
+            st.pos = len(cached) * bs       # prompt tokens already cached
+            st.length = st.pos              # tokens resident in the cache
+            st.cached_tokens = st.pos
+            if st.model_id:
+                try:
+                    st.adapter = self._mux.load(st.model_id)
+                except Exception as e:  # noqa: BLE001 — unknown model id
+                    lease.release()
+                    s.fail(e if isinstance(e, KeyError) else RuntimeError(
+                        f"loading adapter {st.model_id!r} failed: {e!r}"))
+                    continue
+            s.state = st
+
+    def _sweep_cancelled(self, seqs) -> None:
+        for s in seqs:
+            st = s.state
+            if (isinstance(st, _SeqState) and not s.done
+                    and st.cancel_ev is not None and st.cancel_ev.is_set()):
+                from ray_tpu._private.core_worker import TaskCancelledError
+
+                st.lease.release()
+                s.fail(TaskCancelledError(f"llm:{self.deployment}"))
+
+    def _live(self, seqs) -> List[Any]:
+        return [
+            s for s in seqs
+            if isinstance(s.state, _SeqState) and not s.done
+        ]
+
+    def _prefill_step(self, seqs) -> None:
+        pending = [
+            s for s in self._live(seqs) if s.state.pos < len(s.state.prompt)
+        ]
+        if not pending:
+            return
+        lanes = pending[:self.prefill_lanes]
+        states = [s.state for s in lanes]
+        chunks = [
+            min(self.prefill_chunk, len(st.prompt) - st.pos) for st in states
+        ]
+        tc = batching.bucket_pad_size(max(chunks), self.prefill_token_buckets)
+        logits, hidden, k_new, v_new, b = self._run_extend(
+            states, [st.prompt[st.pos:st.pos + c]
+                     for st, c in zip(states, chunks)], tc)
+        for i, (s, st, c) in enumerate(zip(lanes, states, chunks)):
+            self._scatter(st, k_new[:, i, :c], v_new[:, i, :c])
+            st.pos += c
+            st.length += c
+            internal_metrics.inc(
+                "ray_tpu_llm_prefill_tokens_total", c,
+                {"deployment": self.deployment},
+            )
+            if st.pos >= len(st.prompt):
+                if self.prefix is not None:
+                    # cache every full prompt block (first writer wins)
+                    self.prefix.insert(
+                        st.hashes, st.blocks[:len(st.hashes)])
+                self._emit(s, st, logits[i, c - 1], hidden[i, c - 1])
+
+    def _decode_step(self, seqs) -> None:
+        decoding = [
+            s for s in self._live(seqs)
+            if s.state.pos >= len(s.state.prompt)
+        ]
+        max_lanes = self.lane_buckets[-1]
+        while decoding:
+            lanes, decoding = decoding[:max_lanes], decoding[max_lanes:]
+            states = []
+            for s in lanes:
+                st = s.state
+                # grow the cache for the token about to be written
+                need_blocks = (st.length // self.block_size) + 1
+                try:
+                    if need_blocks > len(st.blocks):
+                        st.lease.add(self.pool.allocate(
+                            need_blocks - len(st.blocks)))
+                    self.pool.ensure_private(
+                        st.blocks, st.length // self.block_size)
+                except NoKVBlocksError as e:
+                    st.lease.release()
+                    s.fail(BackPressureError(str(e), retry_after_s=0.05))
+                    continue
+                states.append((s, st))
+            if not states:
+                continue
+            sts = [st for _, st in states]
+            logits, hidden, k_new, v_new, b = self._run_extend(
+                sts, [[st.last_token] for st in sts], 1)
+            for i, (s, st) in enumerate(states):
+                self._scatter(st, k_new[:, i, :1], v_new[:, i, :1])
+                st.length += 1
+                self.decode_tokens += 1
+                self._emit(s, st, logits[i, 0], hidden[i, 0])
+
+    # -- device call + paging ---------------------------------------------
+
+    def _run_extend(self, states, token_chunks, tc: int):
+        import jax.numpy as jnp
+
+        b = batching.bucket_pad_size(len(states), self.lane_buckets)
+        t_max = max(
+            st.length + len(ch) for st, ch in zip(states, token_chunks))
+        t_cap = batching.bucket_pad_size(t_max, self.cache_buckets)
+        tokens = np.zeros((b, tc), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i, (st, ch) in enumerate(zip(states, token_chunks)):
+            tokens[i, :len(ch)] = ch
+            lengths[i] = st.length
+        k_cache, v_cache = self._gather(states, b, t_cap)
+        logits, hidden, k_new, v_new = self._extend(
+            self._params, jnp.asarray(tokens), jnp.asarray(lengths),
+            k_cache, v_cache,
+        )
+        return (
+            np.asarray(logits), np.asarray(hidden),
+            np.asarray(k_new), np.asarray(v_new), b,
+        )
+
+    def _gather(self, states, b: int, t_cap: int):
+        import jax.numpy as jnp
+
+        cfg, bs = self.cfg, self.block_size
+        k = np.zeros(
+            (cfg.num_layers, b, t_cap, cfg.num_heads, cfg.head_dim),
+            self._np_dtype,
+        )
+        v = np.zeros_like(k)
+        for i, st in enumerate(states):
+            for j in range(math.ceil(st.length / bs)):
+                lo = j * bs
+                hi = min(st.length, lo + bs)
+                blk = st.blocks[j]
+                k[:, i, lo:hi] = self.pool.k_data[blk][:, :hi - lo]
+                v[:, i, lo:hi] = self.pool.v_data[blk][:, :hi - lo]
+        return jnp.asarray(k), jnp.asarray(v)
+
+    def _scatter(self, st: _SeqState, k_new, v_new) -> None:
+        bs = self.block_size
+        n = k_new.shape[1]
+        j = 0
+        while j < n:
+            pos = st.length + j
+            blk_idx, off = pos // bs, pos % bs
+            run = min(bs - off, n - j)
+            blk = st.blocks[blk_idx]
+            self.pool.k_data[blk][:, off:off + run] = k_new[:, j:j + run]
+            self.pool.v_data[blk][:, off:off + run] = v_new[:, j:j + run]
+            j += run
+
+    # -- sampling / completion --------------------------------------------
+
+    def _emit(self, s, st: _SeqState, logits_row, hidden_row) -> None:
+        if st.adapter is not None:
+            a, bmat, scale = st.adapter
+            logits_row = logits_row + scale * (hidden_row @ a) @ bmat
+        tok = int(np.argmax(logits_row))
+        st.out.append(tok)
+        st.last_token = tok
+        if st.logits is not None:
+            st.logits.append(np.asarray(logits_row, np.float32).copy())
+        if st.ttft_s is None:
+            st.ttft_s = time.monotonic() - s.enqueued_at
+            internal_metrics.observe(
+                "ray_tpu_llm_ttft_seconds", st.ttft_s,
+                {"deployment": self.deployment},
+            )
+        if st.stream_q is not None:
+            st.stream_q.put(("tok", tok))
+        if len(st.out) >= st.max_new or (st.eos is not None
+                                         and tok == st.eos):
+            self._finish(s, st)
+
+    def _finish(self, s, st: _SeqState) -> None:
+        st.lease.release()
+        result: Dict[str, Any] = {
+            "tokens": st.out,
+            "ttft_s": st.ttft_s,
+            "prefix_cached_tokens": st.cached_tokens,
+            "prefill_tokens": len(st.prompt) - st.cached_tokens,
+            "model_id": st.model_id,
+        }
+        if st.logits is not None:
+            result["logits"] = np.stack(st.logits)
+        s.finish(result)
+        if st.stream_q is not None:
+            st.stream_q.put(("end", result))
+
+
+# ---------------------------------------------------------------------------
+# deployment-facing server
+# ---------------------------------------------------------------------------
+
+
+class LLMServer:
+    """Deployment callable: ``__call__(payload) -> result`` (blocking) and
+    ``stream(payload)`` (token generator). Payloads:
+
+    ``{"prompt": [token ids], "max_new_tokens": n, "model_id": "lora:x",
+    "eos_token": id, "return_logits": bool}``
+
+    Results carry ``tokens``, ``ttft_s``, ``prefix_cached_tokens`` and
+    ``prefill_tokens``. Deploy with ``slo_ttft_p99_s=...`` to get the
+    auto-registered ``serve-<name>-ttft-p99`` SLO rule."""
+
+    def __init__(self, cfg=None, **engine_kwargs):
+        self._engine = LLMEngine(cfg, **engine_kwargs)
+
+    @batching.continuous_batch(max_batch_size=16, batch_wait_timeout_s=0.001)
+    def generate(self, seqs):
+        self._engine.step(seqs)
+
+    def __call__(self, payload):
+        return self.generate(payload)
+
+    def stream(self, payload):
+        """Yield tokens as they decode. Closing the generator (client EOF)
+        cancels the sequence and releases its KV blocks."""
+        out: "queue_mod.Queue" = queue_mod.Queue()
+        cancel = threading.Event()
+        payload = dict(payload)
+        payload[_STREAM_KEY] = out
+        payload[_CANCEL_KEY] = cancel
+        err: List[BaseException] = []
+
+        def run():
+            try:
+                self.generate(payload)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+                out.put(("error", e))
+
+        threading.Thread(target=run, daemon=True).start()
+        try:
+            while True:
+                kind, val = out.get(timeout=120.0)
+                if kind == "tok":
+                    yield val
+                elif kind == "end":
+                    return
+                else:
+                    raise val
+        finally:
+            cancel.set()
+
+    def kv_stats(self) -> Dict[str, Any]:
+        return self._engine.stats()

@@ -62,9 +62,14 @@ class DecodeBatched:
         with self._device:
             time.sleep(self._step_s)  # one fused forward for `pad` lanes
         for s in seqs:
-            s.state = (s.state or 0) + 1
-            if s.state >= int(s.item.get("tokens", 1)):
-                s.finish(s.state)
+            if s.state is None:
+                # first token out of this step: TTFT measured from enqueue
+                # (what a streaming client would see), not from completion
+                s.state = {"n": 0, "ttft_s": time.monotonic() - s.enqueued_at}
+            s.state["n"] += 1
+            if s.state["n"] >= int(s.item.get("tokens", 1)):
+                s.finish({"tokens": s.state["n"],
+                          "ttft_s": s.state["ttft_s"]})
 
     def __call__(self, payload):
         return self._step(payload)
@@ -201,22 +206,46 @@ def _post(url: str, payload: Any, timeout: float = 30.0) -> Dict[str, Any]:
 
 
 def _fire_handle(handle, payload, count, timeout_s=120.0):
+    """Fire ``count`` concurrent requests; ``payload`` may be a value or a
+    per-request factory ``payload(i)``. Returns ``(elapsed, out, errs)``
+    where ``out`` holds ``(request_latency_s, result)`` pairs."""
     out: List[Any] = []
     errs: List[BaseException] = []
+    make = payload if callable(payload) else (lambda i: payload)
 
-    def worker():
+    def worker(i):
         try:
-            out.append(handle.remote(payload).result(timeout=timeout_s))
+            t0 = time.monotonic()
+            r = handle.remote(make(i)).result(timeout=timeout_s)
+            out.append((time.monotonic() - t0, r))
         except BaseException as e:  # noqa: BLE001
             errs.append(e)
 
-    threads = [threading.Thread(target=worker) for _ in range(count)]
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(count)
+    ]
     t0 = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=timeout_s)
     return time.monotonic() - t0, out, errs
+
+
+def _ttft_stats(out: List[Any]) -> Dict[str, float]:
+    """p50/p99 TTFT and e2e latency from ``_fire_handle`` output whose
+    results carry ``ttft_s`` (streaming-aware stand-ins and serve.llm)."""
+    lats = [lat for lat, _ in out]
+    ttfts = [
+        r["ttft_s"] for _, r in out
+        if isinstance(r, dict) and r.get("ttft_s") is not None
+    ]
+    return {
+        "ttft_p50_s": _percentile(ttfts, 0.50),
+        "ttft_p99_s": _percentile(ttfts, 0.99),
+        "latency_p50_s": _percentile(lats, 0.50),
+        "latency_p99_s": _percentile(lats, 0.99),
+    }
 
 
 def measure_continuous_batching(
@@ -247,6 +276,7 @@ def measure_continuous_batching(
             raise errs[0]
         result["batched_tokens_per_s"] = concurrency * tokens / elapsed
         result["shapes"] = h.shapes_seen.remote().result(timeout=30)
+        result.update(_ttft_stats(out))
     finally:
         serve.delete("loadgen_batched")
 
@@ -399,3 +429,89 @@ def measure_mux_swap(
         }
     finally:
         serve.delete("loadgen_mux")
+
+
+# ---------------------------------------------------------------------------
+# phase 4: the real LLM engine (serve.llm) — tokens/s, TTFT, prefix hits
+# ---------------------------------------------------------------------------
+
+
+def measure_llm(
+    *,
+    concurrency: int = 8,
+    prompt_len: int = 48,
+    shared_prefix_len: int = 32,
+    max_new_tokens: int = 16,
+    unbatched_requests: int = 4,
+    seed: int = 20260808,
+    timeout: float = 180.0,
+    engine_kwargs: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Batched-vs-sequential decode throughput, streaming TTFT and prefix
+    hit rate of a deployed :class:`ray_tpu.serve.llm.LLMServer` (gpt_nano
+    on CPU unless ``engine_kwargs`` overrides). Every prompt shares a
+    ``shared_prefix_len``-token system prompt, so all requests after the
+    first reuse its KV blocks from the prefix cache."""
+    import random as _random
+
+    kw = {
+        "num_blocks": 96,
+        "block_size": 16,
+        "prefill_lanes": 2,
+        "lane_buckets": (1, 2, 4, 8),
+        "prefill_token_buckets": (16, 32),
+        "cache_buckets": (64, 128),
+        **(engine_kwargs or {}),
+    }
+    from ray_tpu.serve import llm as _llm  # noqa: F401 — validates import
+
+    dep = serve.deployment(
+        _llm.LLMServer,
+        name="loadgen_llm",
+        max_concurrent_queries=max(concurrency, 8),
+        max_queued_requests=4 * max(concurrency, 8),
+    ).bind(None, **kw)
+    h = serve.run(dep, timeout=timeout)
+    rng = _random.Random(seed)
+    system = [rng.randrange(256) for _ in range(shared_prefix_len)]
+
+    def prompt_for(i: int) -> Dict[str, Any]:
+        sfx = _random.Random(seed + 1 + i)
+        suffix = [
+            sfx.randrange(256) for _ in range(prompt_len - shared_prefix_len)
+        ]
+        return {"prompt": system + suffix, "max_new_tokens": max_new_tokens}
+
+    try:
+        # warm: compiles the prefill/decode bucket shapes this run touches
+        _fire_handle(h, prompt_for, min(4, concurrency), timeout_s=timeout)
+
+        t0 = time.monotonic()
+        for i in range(unbatched_requests):   # sequential = batch-of-1
+            h.remote(prompt_for(100 + i)).result(timeout=timeout)
+        seq_elapsed = time.monotonic() - t0
+        unbatched_tps = unbatched_requests * max_new_tokens / seq_elapsed
+
+        elapsed, out, errs = _fire_handle(
+            h, lambda i: prompt_for(200 + i), concurrency, timeout_s=timeout)
+        if errs:
+            raise errs[0]
+        batched_tps = concurrency * max_new_tokens / elapsed
+        stats = h.kv_stats.remote().result(timeout=30)
+        hits, misses = stats["prefix_hits"], stats["prefix_misses"]
+        result = {
+            "concurrency": concurrency,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new_tokens,
+            "batched_tokens_per_s": batched_tps,
+            "unbatched_tokens_per_s": unbatched_tps,
+            "speedup_x": batched_tps / unbatched_tps,
+            "prefix_hit_rate": hits / max(1, hits + misses),
+            "prefix_hits": hits,
+            "kv_blocks_in_use": stats["kv_blocks_in_use"],
+            "prefix_cached_blocks": stats["prefix_cached_blocks"],
+        }
+        result.update(_ttft_stats(out))
+        return result
+    finally:
+        serve.delete("loadgen_llm")

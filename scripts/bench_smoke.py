@@ -43,6 +43,8 @@ CHECKS = (
     "allreduce_gbps",
     "reducescatter_gbps",
     "serve_batched_tokens_per_s",
+    "llm_tokens_per_s",
+    "llm_prefix_hit_rate",
     "sim_nodes_boot_per_s",
     "sim_soak_requests_per_s",
 )
@@ -55,6 +57,9 @@ CEILING_CHECKS = ("sharded_update_step_ms",)
 ABS_CEILINGS = {
     "serve_mux_swap_ms": 1000.0,
     "serve_shed_recovery_s": 5.0,
+    # TTFT at concurrency 8 includes queueing behind in-flight decodes;
+    # the bar catches a stalled-prefill regression, not box noise
+    "llm_ttft_p99_ms": 5000.0,
 }
 
 # hard gate: fraction of the archived r05 value (BENCH_CORE_r05.json) the
@@ -234,6 +239,14 @@ def main() -> int:
             results["serve_shed_recovery_s"] = ov["recovery_s"]
         mux = _loadgen.measure_mux_swap(weight_mb=4.0, n_models=3)
         results["serve_mux_swap_ms"] = mux["cold_swap_ms"]
+        # LLM engine (warn rows): bench_core's parameters, so the tokens/s
+        # and prefix-hit-rate floors compare against the archived round
+        lm = _loadgen.measure_llm(
+            concurrency=8, prompt_len=48, shared_prefix_len=32,
+            max_new_tokens=16, unbatched_requests=4, seed=20260808)
+        results["llm_tokens_per_s"] = lm["batched_tokens_per_s"]
+        results["llm_prefix_hit_rate"] = lm["prefix_hit_rate"]
+        results["llm_ttft_p99_ms"] = lm["ttft_p99_s"] * 1e3
     except Exception as e:  # noqa: BLE001
         print(json.dumps({"metric": "serve_plane", "error": str(e)[-300:]}),
               flush=True)

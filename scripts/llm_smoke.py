@@ -1,0 +1,278 @@
+#!/usr/bin/env python3
+"""Per-PR LLM-serving smoke (<90 s): the serve.llm engine end to end on
+gpt_nano / CPU.
+
+Hard-fails (nonzero exit) when any leg breaks:
+  1. Throughput: continuous-batched decode through a deployed LLMServer
+     beats sequential per-request decode >= 2x, and the shared system
+     prompt hits the prefix cache.
+  2. Prefill/decode split: a long-prompt prefill arriving mid-stream
+     never stalls in-flight decode — p99 inter-token gap stays bounded
+     while the long request overlaps.
+  3. Prefix caching: a repeated prompt skips prefill FLOPs and its
+     cached-KV decode logits are BITWISE equal to the uncached run.
+  4. LoRA multiplexing: 64 registered adapters stream through an
+     8-slot replica LRU; every cache-miss swap completes sub-second.
+  5. KV leak surface: cancel (stream abandoned), shed (pool
+     exhaustion) and a chaos-killed replica all leave zero leaked
+     blocks (pool accounting returns to exactly the prefix-cached set).
+
+Usage: env JAX_PLATFORMS=cpu python scripts/llm_smoke.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 20260808
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL llm_smoke: {msg}")
+    sys.exit(1)
+
+
+def _prompt(rng, n):
+    return [rng.randrange(256) for _ in range(n)]
+
+
+def main() -> None:  # noqa: PLR0915 — one linear smoke script
+    t_start = time.time()
+    import random
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import batching, loadgen
+    from ray_tpu.serve import llm as llm_mod
+
+    rng = random.Random(SEED)
+    ray_tpu.init(num_cpus=8, log_level="ERROR")
+    summary = {}
+    try:
+        # --- leg 1: batched >= 2x unbatched through the serve plane
+        res = loadgen.measure_llm(
+            concurrency=8, prompt_len=48, shared_prefix_len=32,
+            max_new_tokens=16, unbatched_requests=4, seed=SEED)
+        if res["speedup_x"] < 2.0:
+            fail(f"batched decode {res['speedup_x']:.2f}x < 2x sequential "
+                 f"({res['batched_tokens_per_s']:.0f} vs "
+                 f"{res['unbatched_tokens_per_s']:.0f} tok/s)")
+        if res["prefix_hit_rate"] <= 0.0:
+            fail("shared system prompt produced no prefix-cache hits")
+        if not res["ttft_p99_s"] > 0:
+            fail(f"bad TTFT stats: {res!r}")
+        print(f"OK   throughput: {res['batched_tokens_per_s']:.0f} tok/s "
+              f"batched vs {res['unbatched_tokens_per_s']:.0f} sequential "
+              f"({res['speedup_x']:.1f}x), "
+              f"prefix hit rate {res['prefix_hit_rate']:.0%}, "
+              f"ttft p50/p99 {res['ttft_p50_s'] * 1e3:.0f}/"
+              f"{res['ttft_p99_s'] * 1e3:.0f}ms")
+        summary.update(
+            llm_tokens_per_s=round(res["batched_tokens_per_s"], 1),
+            llm_speedup_x=round(res["speedup_x"], 2),
+            llm_ttft_p99_ms=round(res["ttft_p99_s"] * 1e3, 1),
+            llm_prefix_hit_rate=round(res["prefix_hit_rate"], 3),
+        )
+
+        # one in-process server for legs 2-4 (shared jit cache)
+        srv = llm_mod.LLMServer(
+            None, num_blocks=96, block_size=16, prefill_lanes=2,
+            lane_buckets=(1, 2, 4), prefill_token_buckets=(16, 32),
+            cache_buckets=(64, 128), max_adapters=8,
+        )
+
+        # --- leg 2: long-prompt prefill never stalls in-flight decode
+        stream_prompt = _prompt(rng, 16)
+        long_prompt = _prompt(rng, 96)
+
+        def overlap_run():
+            done = {}
+
+            def submit_long():
+                done["t0"] = time.monotonic()
+                done["res"] = srv(
+                    {"prompt": long_prompt, "max_new_tokens": 4})
+                done["t1"] = time.monotonic()
+
+            stamps = []
+            t = None
+            for _tok in srv.stream(
+                    {"prompt": stream_prompt, "max_new_tokens": 60}):
+                stamps.append(time.monotonic())
+                if len(stamps) == 5:  # decode is rolling: inject the prefill
+                    t = threading.Thread(target=submit_long)
+                    t.start()
+            t.join(timeout=60)
+            return stamps, done
+
+        overlap_run()                  # warm: compiles every shape the
+        stamps, long_done = overlap_run()  # measured run touches
+        if "res" not in long_done or len(long_done["res"]["tokens"]) != 4:
+            fail("long-prompt request did not complete during the stream")
+        overlap = [
+            s for s in stamps if long_done["t0"] <= s <= long_done["t1"]
+        ]
+        if not overlap:
+            fail("no decode tokens streamed while the long prompt was in "
+                 "flight — prefill monopolized the engine")
+        gaps = sorted(
+            b - a for a, b in zip(stamps, stamps[1:])
+        )
+        p99 = gaps[min(len(gaps) - 1, int(round(0.99 * (len(gaps) - 1))))]
+        if p99 > 0.35:
+            fail(f"inter-token p99 {p99 * 1e3:.0f}ms > 350ms while a "
+                 f"96-token prompt prefilled (decode stalled)")
+        print(f"OK   prefill/decode split: {len(overlap)} tokens streamed "
+              f"during the 96-token prefill, inter-token p99 "
+              f"{p99 * 1e3:.0f}ms")
+        summary["llm_intertoken_p99_ms"] = round(p99 * 1e3, 1)
+
+        # --- leg 3: prefix cache skips prefill, decode bitwise-identical
+        prompt = _prompt(rng, 40)
+        r1 = srv({"prompt": prompt, "max_new_tokens": 6,
+                  "return_logits": True})
+        r2 = srv({"prompt": prompt, "max_new_tokens": 6,
+                  "return_logits": True})
+        if r1["prefix_cached_tokens"] != 0 or r2["prefix_cached_tokens"] != 32:
+            fail(f"prefix reuse wrong: first={r1['prefix_cached_tokens']} "
+                 f"second={r2['prefix_cached_tokens']} (want 0 then 32)")
+        if r2["prefill_tokens"] != 8:
+            fail(f"cached request prefilled {r2['prefill_tokens']} tokens, "
+                 f"want 8 (FLOPs not skipped)")
+        if not np.array_equal(r1["logits"], r2["logits"]):
+            fail("cached-KV decode logits differ from uncached decode "
+                 "(prefix reuse is not bitwise-faithful)")
+        print(f"OK   prefix cache: 32/40 prompt tokens reused, "
+              f"decode logits bitwise equal "
+              f"({r1['logits'].shape[0]} steps compared)")
+
+        # --- leg 4: 64-model LoRA mux, sub-second swap under eviction
+        n_models = 64
+        for i in range(n_models):
+            llm_mod.register_lora(
+                f"lora:{i}",
+                llm_mod.random_lora(srv._engine.cfg, rank=2, seed=i,
+                                    scale=2.0))
+        mux_prompt = _prompt(rng, 12)
+        base = srv({"prompt": mux_prompt, "max_new_tokens": 1})
+        worst = 0.0
+        changed = 0
+        for i in range(n_models):       # 64 ids through an 8-slot LRU
+            t0 = time.monotonic()
+            r = srv({"prompt": mux_prompt, "max_new_tokens": 1,
+                     "model_id": f"lora:{i}"})
+            worst = max(worst, time.monotonic() - t0)
+            changed += int(r["tokens"] != base["tokens"])
+        resident = srv.kv_stats()["adapters_resident"]
+        if len(resident) > 8:
+            fail(f"{len(resident)} adapters resident > LRU capacity 8")
+        if worst >= 1.0:
+            fail(f"worst adapter swap {worst * 1e3:.0f}ms >= 1s "
+                 f"({n_models} models through 8 slots)")
+        if changed == 0:
+            fail("no adapter changed the sampled tokens — LoRA delta "
+                 "is not being applied")
+        print(f"OK   lora mux: {n_models} models through 8 slots, worst "
+              f"swap {worst * 1e3:.0f}ms, {changed}/{n_models} adapters "
+              f"changed the argmax")
+        summary["llm_lora_worst_swap_ms"] = round(worst * 1e3, 1)
+
+        # --- leg 5a: abandoned stream releases its KV blocks
+        gen = srv.stream({"prompt": _prompt(rng, 30), "max_new_tokens": 80})
+        next(gen)
+        gen.close()                       # client walks away mid-decode
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = srv.kv_stats()
+            leaked = st["kv_blocks_in_use"] - st["prefix_cached_blocks"]
+            if leaked == 0:
+                break
+            time.sleep(0.05)
+        else:
+            fail(f"cancelled stream leaked {leaked} KV blocks")
+        batching.shutdown_batchers(srv)
+        print("OK   cancel: abandoned stream left 0 leaked KV blocks")
+
+        # --- leg 5b: pool exhaustion sheds cleanly, takes nothing
+        tiny = llm_mod.LLMServer(
+            None, num_blocks=2, block_size=16, prefix_caching=False,
+            cache_buckets=(64,))
+        try:
+            tiny({"prompt": _prompt(rng, 40), "max_new_tokens": 4})
+            fail("40-token prompt fit a 2-block pool (no shed)")
+        except serve.BackPressureError:
+            pass
+        if tiny.kv_stats()["kv_blocks_in_use"] != 0:
+            fail(f"shed request leaked "
+                 f"{tiny.kv_stats()['kv_blocks_in_use']} KV blocks")
+        batching.shutdown_batchers(tiny)
+        print("OK   shed: exhausted pool backpressured with 0 blocks taken")
+
+        # --- leg 5c: chaos-kill a replica mid-decode; replacement is clean
+        dep = serve.deployment(
+            llm_mod.LLMServer, name="llm_chaos", max_concurrent_queries=4,
+        ).bind(None, num_blocks=32, block_size=16, lane_buckets=(1, 2),
+               prefill_token_buckets=(16, 32), cache_buckets=(128,),
+               prefix_caching=False, step_delay_s=0.05)
+        h = serve.run(dep)
+        h.remote({"prompt": _prompt(rng, 30),
+                  "max_new_tokens": 2}).result(timeout=120)
+
+        def long_call():
+            try:
+                h.remote({"prompt": _prompt(rng, 30),
+                          "max_new_tokens": 90}).result(timeout=60)
+            except Exception:
+                pass                      # killed mid-flight: expected
+
+        threading.Thread(target=long_call, daemon=True).start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if h.kv_stats.remote().result(timeout=30)["kv_blocks_in_use"]:
+                break
+            time.sleep(0.1)
+        else:
+            fail("chaos leg: decode never became visible in kv_stats")
+        h._refresh(force=True)
+        ray_tpu.kill(h._replicas[0])
+        deadline = time.monotonic() + 60
+        clean = False
+        while time.monotonic() < deadline:
+            try:
+                clean = h.kv_stats.remote().result(
+                    timeout=15)["kv_blocks_in_use"] == 0
+            except Exception:
+                clean = False
+            if clean:
+                break
+            time.sleep(0.2)
+        if not clean:
+            fail("replacement replica never came up with an empty KV pool")
+        r = h.remote({"prompt": _prompt(rng, 20),
+                      "max_new_tokens": 3}).result(timeout=120)
+        if len(r["tokens"]) != 3:
+            fail(f"post-chaos request returned {r!r}")
+        print("OK   chaos: killed replica mid-decode, replacement pool "
+              "clean, traffic restored")
+
+        print(json.dumps(summary))
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+    elapsed = time.time() - t_start
+    if elapsed > 90:
+        fail(f"smoke took {elapsed:.1f}s > 90s budget")
+    print(f"PASS llm_smoke in {elapsed:.1f}s")
+
+
+if __name__ == "__main__":
+    main()

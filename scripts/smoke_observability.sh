@@ -17,6 +17,10 @@ env JAX_PLATFORMS=cpu python scripts/perf_smoke.py
 # serve plane under load: continuous batching >=2x, shed -> recover at 2x
 # capacity, sub-second multiplex swap
 env JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+# LLM serving end to end: batched decode >=2x sequential, prefill never
+# stalls decode, bitwise prefix-cache reuse, 64-model LoRA mux, and zero
+# leaked KV blocks across cancel / shed / chaos-kill
+env JAX_PLATFORMS=cpu python scripts/llm_smoke.py
 # tracing plane end to end: cross-node assembly, critical path within 10%
 # of e2e, planted straggler flagged, unsampled hook under budget
 env JAX_PLATFORMS=cpu python scripts/trace_smoke.py

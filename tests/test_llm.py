@@ -1,0 +1,490 @@
+"""LLM inference engine (serve.llm): paged KV-cache accounting, prefix
+caching correctness (including bitwise cached-vs-uncached decode), the
+prefill/decode split, LoRA multiplexing, and the KV leak surface under
+cancel / shed / chaos-kill."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import gpt
+from ray_tpu.serve import batching
+from ray_tpu.serve.llm import (
+    KVBlockPool,
+    KVLease,
+    LLMServer,
+    NoKVBlocksError,
+    PrefixCache,
+    chain_hashes,
+    random_lora,
+)
+
+CFG = gpt.gpt_nano()
+
+
+def _prompt(seed: int, n: int):
+    return [
+        int(t)
+        for t in np.random.RandomState(seed).randint(0, CFG.vocab_size, n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def llm_server():
+    """One in-process LLMServer shared by the numerics tests (amortizes
+    the jit compiles of the bucketed prefill/decode shapes)."""
+    srv = LLMServer(
+        CFG, num_blocks=64, block_size=16, prefill_lanes=2,
+        lane_buckets=(1, 2, 4), prefill_token_buckets=(16, 32),
+        cache_buckets=(64, 128), prefix_caching=True,
+        adapter_loader=lambda mid: _ADAPTERS[mid],
+    )
+    yield srv
+    batching.shutdown_batchers(srv)
+
+
+_AD = random_lora(CFG, rank=4, seed=3, scale=4.0)
+_ADAPTERS = {"lora:a": (_AD["A"], _AD["B"], _AD["scale"])}
+
+
+@pytest.fixture
+def serve_session(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def _await(predicate, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# KV block pool: refcounts, exactly-once leases, copy-on-write
+# ---------------------------------------------------------------------------
+
+
+def test_kv_pool_allocate_free_refcounts():
+    pool = KVBlockPool(CFG, num_blocks=8, block_size=4)
+    a = pool.allocate(3)
+    assert pool.in_use() == 3
+    pool.incref(a[:1])
+    pool.free(a)                      # drops one ref on each
+    assert pool.in_use() == 1         # a[0] still held by the incref
+    pool.free(a[:1])
+    assert pool.in_use() == 0
+    with pytest.raises(NoKVBlocksError):
+        pool.allocate(9)
+    assert pool.in_use() == 0         # failed allocation takes nothing
+
+
+def test_kv_lease_releases_exactly_once():
+    pool = KVBlockPool(CFG, num_blocks=8, block_size=4)
+    lease = KVLease(pool)
+    lease.add(pool.allocate(4))
+    before = pool.freed_total
+    for _ in range(5):                # finish + cancel + poison + ... races
+        lease.release()
+    assert pool.in_use() == 0
+    assert pool.freed_total == before + 4
+    # a straggler add after release must not leak either
+    lease.add(pool.allocate(1))
+    assert pool.in_use() == 0
+
+
+def test_kv_pool_copy_on_write():
+    pool = KVBlockPool(CFG, num_blocks=8, block_size=4)
+    (shared,) = pool.allocate(1)
+    pool.k_data[shared][:] = 7.0
+    pool.incref([shared])             # second holder (e.g. prefix cache)
+    blocks = [shared]
+    new = pool.ensure_private(blocks, 0)
+    assert new != shared and blocks[0] == new
+    assert np.all(pool.k_data[new] == 7.0)       # contents cloned
+    assert pool.refcount(shared) == 1            # our ref moved off it
+    pool.k_data[new][:] = 9.0
+    assert np.all(pool.k_data[shared] == 7.0)    # original untouched
+    # unshared block: no clone
+    assert pool.ensure_private(blocks, 0) == new
+
+
+# ---------------------------------------------------------------------------
+# prefix cache: chained hashes, LRU eviction under pool pressure
+# ---------------------------------------------------------------------------
+
+
+def test_chain_hashes_commit_to_prefix():
+    a = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9], 4)   # 2 full blocks
+    b = chain_hashes([1, 2, 3, 4, 5, 6, 7, 99], 4)
+    assert len(a) == 2 and len(b) == 2
+    assert a[0] == b[0]               # shared first block
+    assert a[1] != b[1]               # divergent token invalidates block 2
+    # a divergent EARLY token invalidates every later block (chained)
+    c = chain_hashes([9, 2, 3, 4, 5, 6, 7, 8], 4)
+    assert c[0] != a[0] and c[1] != a[1]
+
+
+def test_prefix_cache_match_insert_evict():
+    pool = KVBlockPool(CFG, num_blocks=4, block_size=4)
+    cache = PrefixCache(pool)
+    hashes = chain_hashes(list(range(8)), 4)
+    blocks = pool.allocate(2)
+    cache.insert(hashes, blocks)
+    assert pool.refcount(blocks[0]) == 2
+    got = cache.match(hashes)
+    assert got == blocks and cache.hits == 2
+    pool.free(got)                    # matched refs back
+    pool.free(blocks)                 # original owner done: cache-only now
+    assert pool.in_use() == 2         # cache keeps them resident
+    # pool pressure evicts idle cached blocks LRU-first
+    more = pool.allocate(4)
+    assert len(more) == 4 and len(cache) == 0 and cache.evictions == 2
+
+
+# ---------------------------------------------------------------------------
+# engine numerics: real gpt decode, prefix reuse bitwise-equal
+# ---------------------------------------------------------------------------
+
+
+def test_first_token_matches_full_forward(llm_server):
+    """The engine's first sampled token equals greedy argmax of the full
+    (non-cached) training forward at the last prompt position."""
+    import jax.numpy as jnp
+
+    prompt = _prompt(0, 24)
+    r = llm_server({"prompt": prompt, "max_new_tokens": 1})
+    model = gpt.GPT(CFG)
+    variables = {"params": llm_server._engine._params}
+    ref = model.apply(variables, jnp.asarray([prompt], jnp.int32))
+    assert r["tokens"][0] == int(np.argmax(np.asarray(ref)[0, -1]))
+
+
+def test_prefix_cache_hits_skip_prefill_and_decode_bitwise(llm_server):
+    srv = llm_server
+    prompt = _prompt(1, 40)
+    s0 = srv.kv_stats()
+    r1 = srv({"prompt": prompt, "max_new_tokens": 6, "return_logits": True})
+    assert r1["prefix_cached_tokens"] == 0 and r1["prefill_tokens"] == 40
+    reqs = [
+        srv({"prompt": prompt, "max_new_tokens": 6, "return_logits": True})
+        for _ in range(3)
+    ]
+    s1 = srv.kv_stats()
+    assert s1["prefix_hits"] > s0["prefix_hits"]       # counter increments
+    for r in reqs:
+        assert r["prefix_cached_tokens"] == 32         # 2 of 3 blocks reused
+        assert r["prefill_tokens"] == 8                # prefill FLOPs skipped
+        assert r["tokens"] == r1["tokens"]
+        # cached-KV decode is BITWISE identical to the uncached decode
+        assert np.array_equal(r["logits"], r1["logits"])
+
+
+def test_prefix_cached_decode_matches_cacheless_engine(llm_server):
+    """Cross-engine: logits from the prefix-cached request equal those of
+    a fresh engine with prefix caching disabled, bit for bit."""
+    prompt = _prompt(2, 33)
+    warm = llm_server(
+        {"prompt": prompt, "max_new_tokens": 4, "return_logits": True})
+    hit = llm_server(
+        {"prompt": prompt, "max_new_tokens": 4, "return_logits": True})
+    assert hit["prefix_cached_tokens"] > 0
+    plain = LLMServer(
+        CFG, num_blocks=64, block_size=16, prefill_lanes=2,
+        lane_buckets=(1, 2, 4), prefill_token_buckets=(16, 32),
+        cache_buckets=(64, 128), prefix_caching=False,
+    )
+    try:
+        ref = plain(
+            {"prompt": prompt, "max_new_tokens": 4, "return_logits": True})
+        assert ref["prefix_cached_tokens"] == 0
+        assert np.array_equal(hit["logits"], ref["logits"])
+        assert hit["tokens"] == ref["tokens"] == warm["tokens"]
+    finally:
+        batching.shutdown_batchers(plain)
+
+
+def test_divergent_suffix_invalidates_correctly(llm_server):
+    """Two prompts sharing a system prefix but diverging afterwards reuse
+    only the shared blocks and produce independent (correct) outputs."""
+    system = _prompt(3, 32)
+    pa = system + _prompt(4, 8)
+    pb = system + _prompt(5, 8)
+    ra1 = llm_server({"prompt": pa, "max_new_tokens": 5})
+    rb1 = llm_server({"prompt": pb, "max_new_tokens": 5})
+    ra2 = llm_server({"prompt": pa, "max_new_tokens": 5})
+    rb2 = llm_server({"prompt": pb, "max_new_tokens": 5})
+    assert ra2["prefix_cached_tokens"] >= 32
+    assert rb2["prefix_cached_tokens"] >= 32
+    assert ra1["tokens"] != rb1["tokens"]      # suffix actually matters
+    assert ra1["tokens"] == ra2["tokens"]
+    assert rb1["tokens"] == rb2["tokens"]
+
+
+def test_lora_adapter_changes_logits(llm_server):
+    prompt = _prompt(6, 24)
+    base = llm_server({"prompt": prompt, "max_new_tokens": 6})
+    lora = llm_server(
+        {"prompt": prompt, "max_new_tokens": 6, "model_id": "lora:a"})
+    assert lora["tokens"] != base["tokens"]
+    assert "lora:a" in llm_server.kv_stats()["adapters_resident"]
+
+
+def test_ttft_reported_and_concurrent_batching(llm_server):
+    out = []
+
+    def call(i):
+        out.append(llm_server(
+            {"prompt": _prompt(50 + i, 20), "max_new_tokens": 8}))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(out) == 4
+    for r in out:
+        assert r["ttft_s"] is not None and 0 < r["ttft_s"] < 60
+        assert len(r["tokens"]) == 8
+
+
+# ---------------------------------------------------------------------------
+# leak surface: shed, stream-cancel, batcher cancellation hooks
+# ---------------------------------------------------------------------------
+
+
+def _leaked(stats):
+    return stats["kv_blocks_in_use"] - stats["prefix_cached_blocks"]
+
+
+def test_kv_exhaustion_sheds_without_leak():
+    srv = LLMServer(CFG, num_blocks=2, block_size=16, prefix_caching=False,
+                    cache_buckets=(64,))
+    try:
+        with pytest.raises(serve.BackPressureError) as ei:
+            srv({"prompt": _prompt(7, 40), "max_new_tokens": 4})
+        assert ei.value.retry_after_s > 0
+        assert srv.kv_stats()["kv_blocks_in_use"] == 0
+        # pool drained by an admitted sequence -> later request sheds, then
+        # succeeds after the first finishes
+        r = srv({"prompt": _prompt(8, 20), "max_new_tokens": 4})
+        assert len(r["tokens"]) == 4
+        assert srv.kv_stats()["kv_blocks_in_use"] == 0
+    finally:
+        batching.shutdown_batchers(srv)
+
+
+def test_stream_cancel_releases_kv_exactly_once(llm_server):
+    srv = llm_server
+    before = srv.kv_stats()
+    gen = srv.stream({"prompt": _prompt(9, 30), "max_new_tokens": 80})
+    first = next(gen)
+    assert isinstance(first, int)
+    mid = srv.kv_stats()
+    assert mid["kv_blocks_in_use"] > before["kv_blocks_in_use"]
+    gen.close()                        # client walks away mid-decode
+    _await(
+        lambda: _leaked(srv.kv_stats()) == 0,
+        10, "KV blocks released after stream cancel",
+    )
+    # freed exactly once: pool accounting is exact, not merely <= capacity
+    after = srv.kv_stats()
+    assert after["kv_blocks_in_use"] == after["prefix_cached_blocks"]
+
+
+def test_batcher_release_hook_fires_exactly_once_on_cancel():
+    released = []
+    seen = {}
+
+    def step(seqs):
+        for s in seqs:
+            if s.state is None:
+                s.state = 0
+                s.on_release = lambda s=s: released.append(s)
+                seen[id(s)] = s
+            # never finishes: only cancellation can end it
+
+    b = batching._ContinuousBatcher(step, 4, 0.001, None, name="t")
+    try:
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(r=b.submit("x")), daemon=True)
+        t.start()
+        _await(lambda: seen, 5, "sequence admitted")
+        seq = next(iter(seen.values()))
+        seq.cancelled = True           # what submit does when its caller
+        with b.cv:                     # is cancelled / force-interrupted
+            b.cv.notify_all()
+        _await(lambda: len(released) == 1, 5, "release hook")
+        time.sleep(0.1)                # more steps run: hook must not refire
+        assert len(released) == 1
+        assert seq._event.is_set()
+    finally:
+        b.shutdown(drain=False)
+
+
+def test_batcher_poisoned_step_runs_release_hooks():
+    released = []
+
+    def step(seqs):
+        for s in seqs:
+            s.on_release = lambda: released.append(1)
+        raise RuntimeError("forward crashed")
+
+    b = batching._ContinuousBatcher(step, 4, 0.001, None, name="t")
+    try:
+        with pytest.raises(RuntimeError, match="forward crashed"):
+            b.submit("x")
+        assert released == [1]
+    finally:
+        b.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# serve-level: client EOF via the async proxy, chaos-kill mid-decode
+# ---------------------------------------------------------------------------
+
+_ENGINE_KW = dict(
+    num_blocks=32, block_size=16, prefill_lanes=2, lane_buckets=(1, 2),
+    prefill_token_buckets=(16, 32), cache_buckets=(128,),
+    prefix_caching=False,
+    # stretch each engine step so the decode outlives the kv_stats polls
+    # (a 90-token gpt_nano decode completes in well under a second raw)
+    step_delay_s=0.05,
+)
+
+
+def test_client_eof_releases_kv_blocks(serve_session):
+    """A client that hangs up mid-decode must release the sequence's KV
+    blocks: the proxy cancels the in-flight call cooperatively and the
+    batcher-blocked replica thread notices (the PR 9 slot discipline,
+    extended to the KV lease)."""
+    dep = serve.deployment(
+        LLMServer, name="llmcancel", max_concurrent_queries=4,
+    ).bind(None, **_ENGINE_KW)
+    serve.run(dep)
+    h = serve.get_deployment_handle("llmcancel")
+    proxy = serve.start_http_proxy()
+    try:
+        # warm: compile prefill+decode buckets so the cancel phase is fast
+        warm = h.remote(
+            {"prompt": _prompt(10, 30), "max_new_tokens": 2}).result(
+                timeout=120)
+        assert len(warm["tokens"]) == 2
+        assert h.kv_stats.remote().result(timeout=30)[
+            "kv_blocks_in_use"] == 0
+
+        payload = json.dumps(
+            {"prompt": _prompt(11, 30), "max_new_tokens": 90}).encode()
+        request = (
+            f"POST /llmcancel HTTP/1.1\r\nHost: {proxy.host}\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n"
+        ).encode() + payload
+        conn = socket.create_connection((proxy.host, proxy.port))
+        conn.sendall(request)
+        _await(
+            lambda: h.kv_stats.remote().result(timeout=30)[
+                "kv_blocks_in_use"] > 0,
+            30, "decode in flight",
+        )
+        conn.close()                   # client EOF mid-decode
+        _await(
+            lambda: h.kv_stats.remote().result(timeout=30)[
+                "kv_blocks_in_use"] == 0,
+            30, "KV blocks released after client EOF",
+        )
+    finally:
+        proxy.stop()
+
+
+@pytest.mark.slow
+def test_chaos_kill_replica_mid_decode_fresh_pool(serve_session):
+    """Kill the replica mid-decode: the replacement replica's pool starts
+    empty (no phantom leases) and serves fresh traffic."""
+    dep = serve.deployment(
+        LLMServer, name="llmchaos", max_concurrent_queries=4,
+    ).bind(None, **_ENGINE_KW)
+    h = serve.run(dep)
+    warm = h.remote(
+        {"prompt": _prompt(12, 30), "max_new_tokens": 2}).result(timeout=120)
+    assert len(warm["tokens"]) == 2
+    h._refresh(force=True)
+    victim = h._replicas[0]
+
+    def long_call():
+        try:
+            h.remote(
+                {"prompt": _prompt(13, 30), "max_new_tokens": 90}
+            ).result(timeout=60)
+        except Exception:
+            pass                       # killed mid-flight: expected
+
+    t = threading.Thread(target=long_call, daemon=True)
+    t.start()
+    _await(
+        lambda: h.kv_stats.remote().result(timeout=30)[
+            "kv_blocks_in_use"] > 0,
+        30, "decode in flight",
+    )
+    ray_tpu.kill(victim)
+    t.join(timeout=90)
+    # the controller restarts the replica; its pool must start at zero
+    _await(
+        lambda: _fresh_pool_ok(h), 60, "replacement replica with empty pool")
+    r = h.remote(
+        {"prompt": _prompt(14, 20), "max_new_tokens": 3}).result(timeout=120)
+    assert len(r["tokens"]) == 3
+
+
+def _fresh_pool_ok(h):
+    try:
+        return h.kv_stats.remote().result(
+            timeout=15)["kv_blocks_in_use"] == 0
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# TTFT SLO auto-rule + loadgen TTFT reporting
+# ---------------------------------------------------------------------------
+
+
+def test_ttft_slo_rule_autoregistered(serve_session):
+    from ray_tpu import slo
+
+    dep = serve.deployment(
+        LLMServer, name="llmslo", max_concurrent_queries=4,
+        slo_ttft_p99_s=0.5,
+    ).bind(None, **_ENGINE_KW)
+    serve.run(dep)
+    rules = {r["name"]: r for r in slo.list()}
+    assert "serve-llmslo-ttft-p99" in rules, sorted(rules)
+    rule = rules["serve-llmslo-ttft-p99"]
+    assert "ray_tpu_llm_ttft_seconds" in rule["expr"]
+    assert rule["target"] == 0.5
+    # the TTFT rule is opt-in: only the deployment that set slo_ttft_p99_s
+    # has one (the default p99/availability rules exist regardless)
+    assert [n for n in rules if n.endswith("-ttft-p99")] == [
+        "serve-llmslo-ttft-p99"
+    ]
+
+
+def test_loadgen_reports_ttft_percentiles(serve_session):
+    from ray_tpu.serve import loadgen
+
+    res = loadgen.measure_continuous_batching(
+        concurrency=8, tokens=4, step_ms=2.0)
+    assert res["speedup_x"] > 1.0
+    for key in ("ttft_p50_s", "ttft_p99_s", "latency_p50_s", "latency_p99_s"):
+        assert res[key] == res[key] and res[key] > 0, (key, res)
+    # TTFT is streaming-aware: first token lands well before completion
+    assert res["ttft_p50_s"] <= res["latency_p99_s"]
